@@ -1,0 +1,28 @@
+"""dragonfly2_tpu — a TPU-native P2P content-distribution fabric.
+
+A brand-new implementation of the capabilities of Dragonfly2 (reference:
+/root/reference, d7y.io/dragonfly/v2 v2.2.0, Go), re-designed TPU-first:
+
+- ``pkg/``       shared kernel: IDs, digests, piece math, errors, config,
+                 logging, metrics, DAG, caches, rate limiting.
+- ``rpc/``       drpc: asyncio msgpack-framed RPC (unary + bidi streams),
+                 consistent-hash balancer, resolvers.
+- ``proto/``     message schemas (dataclasses) modeled on the v2 protobuf API.
+- ``source/``    pluggable origin clients keyed by URL scheme (http, file,
+                 gcs, s3 — reference: pkg/source).
+- ``storage/``   per-(task,peer) piece stores with metadata persistence
+                 (reference: client/daemon/storage).
+- ``daemon/``    the data-plane peer daemon: conductor, piece pipeline,
+                 upload server, proxy, object-storage gateway, PEX
+                 (reference: client/daemon).
+- ``scheduler/`` control plane: resource FSMs + peer DAG, filter→score
+                 scheduling, AnnouncePeer stream (reference: scheduler/).
+- ``manager/``   global control plane: clusters, dynconfig, searcher,
+                 preheat jobs (reference: manager/).
+- ``client/``    dfget/dfcache/dfstore client libraries.
+- ``ops/``       TPU compute: HBM piece sink, digest/verify kernels (JAX/Pallas).
+- ``parallel/``  device-mesh plans: ICI ring broadcast of checkpoint shards,
+                 pod topology model.
+"""
+
+__version__ = "0.1.0"

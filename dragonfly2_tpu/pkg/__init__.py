@@ -1,0 +1,1 @@
+"""Shared kernel packages (reference: pkg/ and internal/)."""

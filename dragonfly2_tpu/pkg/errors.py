@@ -1,0 +1,148 @@
+"""Coded errors that cross RPC boundaries.
+
+Reference: internal/dferrors/error.go (coded errors carried over gRPC) and
+the Code enum from d7y.io/api commonv1/v2. We keep a compact integer code
+space so errors serialize through drpc frames and can be re-raised on the
+far side with their semantics intact.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any
+
+
+class Code(enum.IntEnum):
+    """Error/status codes, modeled on commonv1.Code semantics."""
+
+    # Success
+    Success = 200
+
+    # Framework errors
+    ServerUnavailable = 500
+    ResourceLacked = 501
+    BadRequest = 400
+    PeerTaskNotFound = 404
+    UnknownError = 1000
+    RequestTimeout = 1001
+
+    # Scheduler errors
+    SchedError = 5000
+    SchedNeedBackSource = 5001  # peer must fall back to origin
+    SchedPeerGone = 5002        # peer should be terminated
+    SchedPeerNotFound = 5004
+    SchedPeerPieceResultReportFail = 5005
+    SchedTaskStatusError = 5006
+    SchedReregister = 5007      # peer should re-register (scheduler restarted)
+
+    # CDN / seed-peer errors
+    CDNTaskRegistryFail = 6001
+    CDNTaskNotFound = 6404
+
+    # Client errors
+    ClientError = 4000
+    ClientPieceRequestFail = 4001  # piece download request failed
+    ClientScheduleTimeout = 4002
+    ClientContextCanceled = 4003
+    ClientWaitPieceReady = 4004
+    ClientPieceDownloadFail = 4005
+    ClientRequestLimitFail = 4006
+    ClientConnectionError = 4007
+    ClientBackSourceError = 4008
+    ClientPieceNotFound = 4404
+
+    # Manager errors
+    ManagerError = 7000
+    InvalidResourceType = 7001
+
+    # Storage errors
+    StorageError = 8000
+    StoragePieceNotFound = 8404
+    StorageTaskNotFound = 8405
+
+    # Source / origin errors
+    BackToSourceAborted = 9000
+    UnsupportedProtocol = 9001
+    SourceNotFound = 9404
+    SourceForbidden = 9403
+    SourceRangeUnsupported = 9416
+
+
+class DfError(Exception):
+    """Base coded error. Serializable across drpc.
+
+    Attributes:
+        code: machine-readable code (Code enum value).
+        message: human message.
+        metadata: optional structured details (JSON-safe).
+    """
+
+    def __init__(self, code: Code | int, message: str = "", metadata: dict[str, Any] | None = None):
+        # Unknown codes (newer peers) must not crash the decoder: degrade to
+        # UnknownError and keep the raw value for diagnostics.
+        try:
+            parsed = Code(code)
+        except ValueError:
+            parsed = Code.UnknownError
+            metadata = dict(metadata or {})
+            metadata["raw_code"] = int(code)
+        super().__init__(message or parsed.name)
+        self.code = parsed
+        self.message = message or parsed.name
+        self.metadata = metadata or {}
+
+    def to_wire(self) -> dict[str, Any]:
+        return {"code": int(self.code), "message": self.message, "metadata": self.metadata}
+
+    @classmethod
+    def from_wire(cls, d: dict[str, Any]) -> "DfError":
+        return cls(d.get("code", Code.UnknownError), d.get("message", ""), d.get("metadata") or {})
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"DfError({self.code.name}, {self.message!r})"
+
+
+class NeedBackSourceError(DfError):
+    """Scheduler instructs the peer to fetch from origin itself."""
+
+    def __init__(self, message: str = ""):
+        super().__init__(Code.SchedNeedBackSource, message)
+
+
+class PeerGoneError(DfError):
+    def __init__(self, message: str = ""):
+        super().__init__(Code.SchedPeerGone, message)
+
+
+class RescheduleError(DfError):
+    """Raised internally when the current parents are unusable and the
+    conductor should ask the scheduler for new ones."""
+
+    def __init__(self, message: str = "", candidates_gone: list[str] | None = None):
+        super().__init__(Code.SchedError, message, {"candidates_gone": candidates_gone or []})
+
+
+class StorageError(DfError):
+    def __init__(self, message: str = "", code: Code = Code.StorageError):
+        super().__init__(code, message)
+
+
+class SourceError(DfError):
+    """Origin fetch failure. ``temporary`` guides retry policy."""
+
+    def __init__(self, message: str = "", code: Code = Code.BackToSourceAborted, temporary: bool = False):
+        super().__init__(code, message, {"temporary": temporary})
+        self.temporary = temporary
+
+
+def is_back_source_code(code: int) -> bool:
+    return code == Code.SchedNeedBackSource
+
+
+def error_from_wire(d: dict[str, Any]) -> DfError:
+    code = d.get("code", int(Code.UnknownError))
+    if code == Code.SchedNeedBackSource:
+        return NeedBackSourceError(d.get("message", ""))
+    if code == Code.SchedPeerGone:
+        return PeerGoneError(d.get("message", ""))
+    return DfError.from_wire(d)

@@ -1,0 +1,109 @@
+"""Task / peer / host ID generation.
+
+Reference: pkg/idgen/task_id.go:36-101, peer_id.go:24-39, host_id.go:24-29.
+Task IDs are content addresses: sha256 over the filtered URL plus
+distinguishing metadata, so identical content maps to one task cluster-wide.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from urllib.parse import parse_qsl, urlencode, urlsplit, urlunsplit
+
+from dragonfly2_tpu.pkg import digest as pkgdigest
+
+FILTERED_QUERY_PARAMS_SEPARATOR = "&"
+
+
+def filter_query_params(url: str, filtered: list[str] | None) -> str:
+    """Remove named query params and sort the rest for a canonical URL
+    (reference pkg/net/url FilterQueryParams used by task_id.go:59,95)."""
+    if not filtered:
+        filtered = []
+    try:
+        parts = urlsplit(url)
+        pairs = parse_qsl(parts.query, keep_blank_values=True)
+        kept = [(k, v) for k, v in pairs if k not in set(filtered)]
+        # Canonical ordering so param order never changes the task ID.
+        kept.sort()
+        return urlunsplit((parts.scheme, parts.netloc, parts.path, urlencode(kept), ""))
+    except ValueError:
+        return ""
+
+
+def parse_filtered_query_params(raw: str | None) -> list[str]:
+    """Split '&'-separated filter string (reference task_id.go:85-91)."""
+    if not raw or not raw.strip():
+        return []
+    return raw.split(FILTERED_QUERY_PARAMS_SEPARATOR)
+
+
+def task_id_v1(
+    url: str,
+    *,
+    digest: str = "",
+    tag: str = "",
+    application: str = "",
+    filters: str = "",
+    range_header: str = "",
+    ignore_range: bool = False,
+) -> str:
+    """v1 task ID (reference task_id.go:46-82): sha256 over filtered URL +
+    digest + range + tag + application (present fields only)."""
+    u = filter_query_params(url, parse_filtered_query_params(filters))
+    data = [u]
+    if digest:
+        data.append(digest)
+    if not ignore_range and range_header:
+        data.append(range_header)
+    if tag:
+        data.append(tag)
+    if application:
+        data.append(application)
+    return pkgdigest.sha256_from_strings(*data)
+
+
+def parent_task_id_v1(url: str, **kwargs) -> str:
+    """Task ID ignoring the range — used to look up whole-file parents for
+    ranged requests (reference task_id.go:40-44)."""
+    kwargs["ignore_range"] = True
+    return task_id_v1(url, **kwargs)
+
+
+def task_id_v2(url: str, tag: str = "", application: str = "", filtered_query_params: list[str] | None = None) -> str:
+    """v2 task ID (reference task_id.go:94-101)."""
+    u = filter_query_params(url, filtered_query_params or [])
+    return pkgdigest.sha256_from_strings(u, tag, application)
+
+
+def persistent_cache_task_id(content_digest: str, tag: str = "", application: str = "") -> str:
+    """Persistent-cache tasks are addressed by content digest, not URL."""
+    return pkgdigest.sha256_from_strings(content_digest, tag, application)
+
+
+def peer_id_v1(ip: str) -> str:
+    """``ip-pid-uuid`` (reference peer_id.go:27-29)."""
+    return f"{ip}-{os.getpid()}-{uuid.uuid4()}"
+
+
+def seed_peer_id_v1(ip: str) -> str:
+    """Seed-peer IDs carry a ``_Seed`` suffix (reference peer_id.go:32-34);
+    the scheduler uses this marker to identify seed-originated peers."""
+    return f"{peer_id_v1(ip)}_Seed"
+
+
+def peer_id_v2() -> str:
+    return str(uuid.uuid4())
+
+
+def is_seed_peer_id(peer_id: str) -> bool:
+    return peer_id.endswith("_Seed")
+
+
+def host_id(hostname: str, port: int | None = None) -> str:
+    """Host ID (reference host_id.go:24-29): hostname, or hostname-port for
+    multi-daemon hosts."""
+    if port is None:
+        return hostname
+    return f"{hostname}-{port}"

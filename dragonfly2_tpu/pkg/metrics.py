@@ -1,0 +1,68 @@
+"""Prometheus metrics helpers.
+
+Reference: every binary serves Prometheus (scheduler/metrics/metrics.go,
+client/daemon/metrics/metrics.go, manager/metrics). We wrap
+prometheus_client so subsystems can declare metrics without worrying about
+duplicate registration in tests.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+    CONTENT_TYPE_LATEST,
+)
+
+_NAMESPACE = "dragonfly_tpu"
+_lock = threading.Lock()
+_registry = CollectorRegistry()
+_metrics: dict[str, object] = {}
+
+
+def _get_or_create(kind: type, name: str, factory):
+    """Metric names are unique per registry regardless of kind; a name reused
+    with a different kind is a programming error surfaced eagerly."""
+    with _lock:
+        existing = _metrics.get(name)
+        if existing is not None:
+            if not isinstance(existing, kind):
+                raise ValueError(
+                    f"metric {name!r} already registered as {type(existing).__name__}, not {kind.__name__}"
+                )
+            return existing
+        m = factory()
+        _metrics[name] = m
+        return m
+
+
+def counter(name: str, doc: str, labels: tuple[str, ...] = ()) -> "Counter":
+    return _get_or_create(
+        Counter, name, lambda: Counter(name, doc, labels, namespace=_NAMESPACE, registry=_registry)
+    )
+
+
+def gauge(name: str, doc: str, labels: tuple[str, ...] = ()) -> "Gauge":
+    return _get_or_create(
+        Gauge, name, lambda: Gauge(name, doc, labels, namespace=_NAMESPACE, registry=_registry)
+    )
+
+
+def histogram(name: str, doc: str, labels: tuple[str, ...] = (), buckets=None) -> "Histogram":
+    def factory():
+        kwargs = {"namespace": _NAMESPACE, "registry": _registry}
+        if buckets is not None:
+            kwargs["buckets"] = buckets
+        return Histogram(name, doc, labels, **kwargs)
+
+    return _get_or_create(Histogram, name, factory)
+
+
+def render() -> tuple[bytes, str]:
+    """Render the registry for an HTTP /metrics endpoint."""
+    return generate_latest(_registry), CONTENT_TYPE_LATEST

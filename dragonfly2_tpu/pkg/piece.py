@@ -1,0 +1,177 @@
+"""Piece-size math and piece records.
+
+Reference: internal/util/util.go:22-49 (size scaling law) and the piece
+metadata carried in commonv1.PieceInfo / commonv2.Piece. Pieces are the unit
+of transfer, verification, scheduling and HBM landing.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+DEFAULT_PIECE_SIZE = 4 * 1024 * 1024
+PIECE_SIZE_LIMIT = 15 * 1024 * 1024
+
+
+def compute_piece_size(length: int) -> int:
+    """Piece size scaling (reference util.go:33-44): 4 MiB up to 200 MiB of
+    content, then +1 MiB per extra 100 MiB, capped at 15 MiB."""
+    if length <= 200 * 1024 * 1024:
+        return DEFAULT_PIECE_SIZE
+    gap_count = length // (100 * 1024 * 1024)
+    mp_size = (gap_count - 2) * 1024 * 1024 + DEFAULT_PIECE_SIZE
+    return min(mp_size, PIECE_SIZE_LIMIT)
+
+
+def compute_piece_count(length: int, piece_size: int) -> int:
+    """ceil(length / piece_size) (reference util.go:47-49)."""
+    return math.ceil(length / piece_size)
+
+
+def piece_offset(piece_num: int, piece_size: int) -> int:
+    return piece_num * piece_size
+
+
+def piece_length(piece_num: int, piece_size: int, content_length: int) -> int:
+    """Length of piece ``piece_num`` given total content length."""
+    start = piece_num * piece_size
+    if content_length < 0:
+        return piece_size
+    return max(0, min(piece_size, content_length - start))
+
+
+@dataclass
+class PieceInfo:
+    """Metadata for one piece (reference commonv1.PieceInfo)."""
+
+    piece_num: int
+    range_start: int
+    range_size: int
+    digest: str = ""            # "md5:..." / "crc32c:..." string form
+    download_cost_ms: int = 0   # observed cost, feeds bad-node detection
+    dst_peer_id: str = ""       # which parent served it
+
+    def to_wire(self) -> dict:
+        return {
+            "piece_num": self.piece_num,
+            "range_start": self.range_start,
+            "range_size": self.range_size,
+            "digest": self.digest,
+            "download_cost_ms": self.download_cost_ms,
+            "dst_peer_id": self.dst_peer_id,
+        }
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "PieceInfo":
+        return cls(
+            piece_num=d["piece_num"],
+            range_start=d["range_start"],
+            range_size=d["range_size"],
+            digest=d.get("digest", ""),
+            download_cost_ms=d.get("download_cost_ms", 0),
+            dst_peer_id=d.get("dst_peer_id", ""),
+        )
+
+
+@dataclass
+class Range:
+    """HTTP byte range [start, start+length). Parsed from ``bytes=a-b``."""
+
+    start: int
+    length: int
+
+    @classmethod
+    def parse_http(cls, header: str, content_length: int = -1) -> "Range | None":
+        """Parse single-range ``bytes=a-b`` / ``bytes=a-`` / ``bytes=-n``."""
+        if not header:
+            return None
+        value = header.strip()
+        if value.startswith("bytes="):
+            value = value[len("bytes="):]
+        if "," in value:
+            raise ValueError("multi-range not supported")
+        start_s, sep, end_s = value.partition("-")
+        if not sep:
+            raise ValueError(f"invalid range {header!r}")
+        if not start_s:  # suffix range: last N bytes
+            if content_length < 0:
+                raise ValueError("suffix range needs content length")
+            n = int(end_s)
+            return cls(max(0, content_length - n), min(n, content_length))
+        start = int(start_s)
+        if not end_s:
+            if content_length < 0:
+                return cls(start, -1)
+            return cls(start, max(0, content_length - start))
+        end = int(end_s)
+        if end < start:
+            raise ValueError(f"inverted range {header!r}")
+        return cls(start, end - start + 1)
+
+    def to_http(self) -> str:
+        if self.length < 0:
+            return f"bytes={self.start}-"
+        return f"bytes={self.start}-{self.start + self.length - 1}"
+
+
+class SizeScope:
+    """Task size classes driving registration shortcuts
+    (reference scheduler/resource/standard/task.go:468-490)."""
+
+    NORMAL = "normal"   # > piece size: full piece machinery
+    SMALL = "small"     # one piece: single-piece shortcut
+    TINY = "tiny"       # <= 128 bytes: inlined in scheduler response
+    EMPTY = "empty"     # zero bytes
+    UNKNOW = "unknow"   # unknown content length
+
+    TINY_FILE_SIZE = 128
+
+    @classmethod
+    def of(cls, content_length: int, piece_size: int, total_piece_count: int | None = None) -> str:
+        if content_length < 0:
+            return cls.UNKNOW
+        if content_length == 0:
+            return cls.EMPTY
+        if content_length <= cls.TINY_FILE_SIZE:
+            return cls.TINY
+        if total_piece_count is None:
+            total_piece_count = compute_piece_count(content_length, piece_size)
+        if total_piece_count == 1:
+            return cls.SMALL
+        return cls.NORMAL
+
+
+@dataclass
+class PieceBitmap:
+    """Tracks which pieces are present; persisted with task metadata
+    (reference client/daemon/storage metadata piece map)."""
+
+    total: int = -1  # -1 while content length unknown
+    _bits: set[int] = field(default_factory=set)
+
+    def mark(self, piece_num: int) -> None:
+        self._bits.add(piece_num)
+
+    def has(self, piece_num: int) -> bool:
+        return piece_num in self._bits
+
+    def count(self) -> int:
+        return len(self._bits)
+
+    def complete(self) -> bool:
+        return self.total >= 0 and len(self._bits) >= self.total
+
+    def missing(self) -> list[int]:
+        if self.total < 0:
+            return []
+        return [i for i in range(self.total) if i not in self._bits]
+
+    def to_wire(self) -> dict:
+        return {"total": self.total, "bits": sorted(self._bits)}
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "PieceBitmap":
+        bm = cls(total=d.get("total", -1))
+        bm._bits = set(d.get("bits", []))
+        return bm

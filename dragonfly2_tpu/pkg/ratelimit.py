@@ -1,0 +1,96 @@
+"""Async token-bucket rate limiting.
+
+Reference: golang.org/x/time/rate as used by the piece manager
+(client/daemon/peer/piece_manager.go waitLimit), the upload manager
+(upload/upload_manager.go:79 WithLimiter) and the traffic shaper
+(traffic_shaper.go). Limits are bytes/second with a burst bucket.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+INF = float("inf")
+
+
+class Limiter:
+    """Token bucket. ``limit`` tokens/second, bucket size ``burst``.
+
+    asyncio-native: waiters sleep exactly until their reservation matures,
+    which keeps a single-core daemon responsive under load.
+    """
+
+    def __init__(self, limit: float = INF, burst: int | None = None):
+        self._limit = limit
+        if burst is None:
+            burst = int(limit) if limit != INF else 1 << 62
+        self._burst = max(1, burst)
+        self._tokens = float(self._burst)
+        self._last = time.monotonic()
+        self._lock = asyncio.Lock()
+
+    @property
+    def limit(self) -> float:
+        return self._limit
+
+    def set_limit(self, limit: float, burst: int | None = None) -> None:
+        """Dynamic re-allocation (traffic shaper re-tunes per-task limits)."""
+        self._advance()
+        self._limit = limit
+        if burst is not None:
+            self._burst = burst
+        elif limit != INF:
+            self._burst = max(int(limit), 1)
+        self._tokens = min(self._tokens, float(self._burst))
+
+    def _advance(self) -> None:
+        now = time.monotonic()
+        if self._limit != INF:
+            self._tokens = min(float(self._burst), self._tokens + (now - self._last) * self._limit)
+        else:
+            self._tokens = float(self._burst)
+        self._last = now
+
+    def allow(self, n: int = 1) -> bool:
+        self._advance()
+        if self._tokens >= n:
+            self._tokens -= n
+            return True
+        return False
+
+    async def wait(self, n: int = 1) -> float:
+        """Block until ``n`` tokens are available; returns seconds waited."""
+        if self._limit == INF:
+            return 0.0
+        if self._limit <= 0:
+            # x/time/rate semantics: limit 0 blocks until cancelled (the
+            # traffic shaper uses this to pause a task).
+            await asyncio.Event().wait()
+        if n > self._burst:
+            # A single request larger than the bucket: pay for it across
+            # multiple bucket fills rather than deadlocking.
+            waited = 0.0
+            remaining = n
+            while remaining > 0:
+                chunk = min(remaining, self._burst)
+                waited += await self.wait(chunk)
+                remaining -= chunk
+            return waited
+        start = time.monotonic()
+        async with self._lock:  # lock held through the sleep → FIFO fairness
+            self._advance()
+            if self._tokens >= n:
+                self._tokens -= n
+                return 0.0
+            deficit = n - self._tokens
+            delay = deficit / self._limit
+            self._tokens -= n  # reserve (goes negative; matures over time)
+            try:
+                await asyncio.sleep(delay)
+            except asyncio.CancelledError:
+                # Cancelled waiters must not consume budget (x/time/rate
+                # returns the reservation on ctx cancel).
+                self._tokens += n
+                raise
+        return time.monotonic() - start

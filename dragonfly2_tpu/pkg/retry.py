@@ -1,0 +1,44 @@
+"""Retry helper with exponential backoff (reference: pkg/retry/retry.go)."""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Awaitable, Callable, TypeVar
+
+T = TypeVar("T")
+
+
+async def run(
+    fn: Callable[[], Awaitable[T]],
+    *,
+    init_backoff: float = 0.2,
+    max_backoff: float = 5.0,
+    max_attempts: int = 5,
+    cancel: asyncio.Event | None = None,
+    retryable: Callable[[Exception], bool] | None = None,
+) -> T:
+    """Run ``fn`` until success, with jittered exponential backoff.
+
+    Raises the last error after ``max_attempts``. ``retryable`` can mark
+    errors as terminal (returns False → raise immediately).
+    """
+    backoff = init_backoff
+    last: Exception | None = None
+    for attempt in range(max_attempts):
+        if cancel is not None and cancel.is_set():
+            raise asyncio.CancelledError()
+        try:
+            return await fn()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            last = e
+            if retryable is not None and not retryable(e):
+                raise
+            if attempt == max_attempts - 1:
+                break
+            await asyncio.sleep(backoff * (0.5 + random.random()))
+            backoff = min(backoff * 2, max_backoff)
+    assert last is not None
+    raise last

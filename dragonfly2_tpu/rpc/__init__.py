@@ -1,0 +1,25 @@
+"""drpc — asyncio msgpack-framed RPC with unary and bidirectional streams.
+
+Replaces the reference's gRPC surfaces (pkg/rpc): same roles (scheduler
+AnnouncePeer bidi stream, daemon SyncPieceTasks stream, manager KeepAlive
+stream, unary CRUD), but implemented natively on asyncio for a
+single-core-friendly, dependency-free stack. Payload transfers (pieces) do
+NOT ride drpc — they use HTTP range GETs like the reference
+(client/daemon/pieces via upload server).
+"""
+
+from dragonfly2_tpu.rpc.framing import Frame, FrameReader, FrameWriter
+from dragonfly2_tpu.rpc.server import Server, ServerStream, RpcContext
+from dragonfly2_tpu.rpc.client import Client, ClientStream, RpcError
+
+__all__ = [
+    "Frame",
+    "FrameReader",
+    "FrameWriter",
+    "Server",
+    "ServerStream",
+    "RpcContext",
+    "Client",
+    "ClientStream",
+    "RpcError",
+]

@@ -1,0 +1,325 @@
+"""Unit tests for the shared kernel (pkg/)."""
+
+import io
+
+import pytest
+
+from dragonfly2_tpu.pkg import digest, idgen, piece
+from dragonfly2_tpu.pkg.cache import TTLCache
+from dragonfly2_tpu.pkg.dag import DAG, CycleError, DAGError
+from dragonfly2_tpu.pkg.errors import Code, DfError, NeedBackSourceError, error_from_wire
+from dragonfly2_tpu.pkg.fsm import FSM, EventDesc, TransitionError
+from dragonfly2_tpu.pkg.types import HostType, parse_size
+from dragonfly2_tpu.rpc.balancer import HashRing
+
+
+class TestDigest:
+    def test_parse_roundtrip(self):
+        d = digest.parse("sha256:" + "a" * 64)
+        assert d.algorithm == "sha256"
+        assert str(d) == "sha256:" + "a" * 64
+
+    def test_parse_rejects_bad(self):
+        with pytest.raises(digest.InvalidDigestError):
+            digest.parse("sha256:xyz")
+        with pytest.raises(digest.InvalidDigestError):
+            digest.parse("nosep")
+        with pytest.raises(digest.InvalidDigestError):
+            digest.parse("whirlpool:" + "a" * 64)
+
+    def test_hash_bytes_known_vector(self):
+        d = digest.hash_bytes("md5", b"hello")
+        assert d.encoded == "5d41402abc4b2a76b9719d911017c592"
+        d = digest.hash_bytes("sha256", b"")
+        assert d.encoded == "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855"
+
+    def test_crc32c_known_vectors(self):
+        # RFC 3720 test vectors.
+        assert digest.crc32c(b"") == 0x00000000
+        assert digest.crc32c(b"\x00" * 32) == 0x8A9136AA
+        assert digest.crc32c(bytes(range(32))) == 0x46DD794E
+
+    def test_crc32c_incremental(self):
+        data = bytes(range(256)) * 7
+        whole = digest.crc32c(data)
+        c = digest.crc32c(data[:100])
+        c = digest.crc32c(data[100:], c)
+        assert c == whole
+
+    def test_hashing_reader(self):
+        r = digest.HashingReader(io.BytesIO(b"hello world"), "sha256")
+        assert r.read() == b"hello world"
+        assert r.digest().encoded == digest.hash_bytes("sha256", b"hello world").encoded
+
+    def test_sha256_from_strings(self):
+        assert digest.sha256_from_strings("a", "b") == digest.sha256_from_strings("ab")
+
+
+class TestIdgen:
+    def test_task_id_v2_stable(self):
+        a = idgen.task_id_v2("http://x/y?b=2&a=1", "tag", "app")
+        b = idgen.task_id_v2("http://x/y?a=1&b=2", "tag", "app")
+        assert a == b  # param order canonicalized
+
+    def test_task_id_filters(self):
+        a = idgen.task_id_v2("http://x/y?sig=123&a=1", filtered_query_params=["sig"])
+        b = idgen.task_id_v2("http://x/y?sig=999&a=1", filtered_query_params=["sig"])
+        assert a == b
+
+    def test_task_id_v1_range(self):
+        whole = idgen.task_id_v1("http://x/f")
+        ranged = idgen.task_id_v1("http://x/f", range_header="bytes=0-9")
+        parent = idgen.parent_task_id_v1("http://x/f", range_header="bytes=0-9")
+        assert whole != ranged
+        assert whole == parent
+
+    def test_peer_ids(self):
+        pid = idgen.peer_id_v1("1.2.3.4")
+        assert pid.startswith("1.2.3.4-")
+        assert not idgen.is_seed_peer_id(pid)
+        assert idgen.is_seed_peer_id(idgen.seed_peer_id_v1("1.2.3.4"))
+
+    def test_host_id(self):
+        assert idgen.host_id("h1") == "h1"
+        assert idgen.host_id("h1", 8080) == "h1-8080"
+
+
+class TestPiece:
+    def test_piece_size_scaling(self):
+        # reference internal/util/util.go semantics
+        assert piece.compute_piece_size(-1) == 4 << 20
+        assert piece.compute_piece_size(100 << 20) == 4 << 20
+        assert piece.compute_piece_size(200 << 20) == 4 << 20
+        assert piece.compute_piece_size(300 << 20) == 5 << 20
+        assert piece.compute_piece_size(500 << 20) == 7 << 20
+        assert piece.compute_piece_size(10 << 30) == 15 << 20  # capped
+
+    def test_piece_count(self):
+        assert piece.compute_piece_count(10, 4) == 3
+        assert piece.compute_piece_count(8, 4) == 2
+
+    def test_piece_length(self):
+        assert piece.piece_length(0, 4, 10) == 4
+        assert piece.piece_length(2, 4, 10) == 2
+        assert piece.piece_length(3, 4, 10) == 0
+
+    def test_range_parse(self):
+        r = piece.Range.parse_http("bytes=0-99")
+        assert (r.start, r.length) == (0, 100)
+        r = piece.Range.parse_http("bytes=10-", content_length=50)
+        assert (r.start, r.length) == (10, 40)
+        r = piece.Range.parse_http("bytes=-10", content_length=50)
+        assert (r.start, r.length) == (40, 10)
+        assert piece.Range(0, 100).to_http() == "bytes=0-99"
+
+    def test_size_scope(self):
+        assert piece.SizeScope.of(0, 4 << 20) == piece.SizeScope.EMPTY
+        assert piece.SizeScope.of(100, 4 << 20) == piece.SizeScope.TINY
+        assert piece.SizeScope.of(1 << 20, 4 << 20) == piece.SizeScope.SMALL
+        assert piece.SizeScope.of(100 << 20, 4 << 20) == piece.SizeScope.NORMAL
+        assert piece.SizeScope.of(-1, 4 << 20) == piece.SizeScope.UNKNOW
+
+    def test_bitmap(self):
+        bm = piece.PieceBitmap(total=3)
+        bm.mark(0)
+        bm.mark(2)
+        assert not bm.complete()
+        assert bm.missing() == [1]
+        bm.mark(1)
+        assert bm.complete()
+        rt = piece.PieceBitmap.from_wire(bm.to_wire())
+        assert rt.complete()
+
+
+class TestErrors:
+    def test_wire_roundtrip(self):
+        e = DfError(Code.SchedNeedBackSource, "go to source")
+        e2 = error_from_wire(e.to_wire())
+        assert isinstance(e2, NeedBackSourceError)
+        assert e2.code == Code.SchedNeedBackSource
+
+
+class TestDAG:
+    def test_edges_and_cycles(self):
+        d = DAG()
+        for v in "abc":
+            d.add_vertex(v, v.upper())
+        d.add_edge("a", "b")
+        d.add_edge("b", "c")
+        with pytest.raises(CycleError):
+            d.add_edge("c", "a")
+        assert not d.can_add_edge("c", "a")
+        assert d.can_add_edge("a", "c")
+        with pytest.raises(DAGError):
+            d.add_edge("a", "b")  # duplicate
+
+    def test_delete_vertex_cleans_edges(self):
+        d = DAG()
+        for v in "abc":
+            d.add_vertex(v, None)
+        d.add_edge("a", "b")
+        d.add_edge("b", "c")
+        d.delete_vertex("b")
+        assert d.get_vertex("a").out_degree() == 0
+        assert d.get_vertex("c").in_degree() == 0
+
+    def test_delete_in_edges(self):
+        d = DAG()
+        for v in "abc":
+            d.add_vertex(v, None)
+        d.add_edge("a", "c")
+        d.add_edge("b", "c")
+        d.delete_vertex_in_edges("c")
+        assert d.get_vertex("c").in_degree() == 0
+        assert d.get_vertex("a").out_degree() == 0
+
+    def test_random_sampling(self):
+        d = DAG()
+        for i in range(20):
+            d.add_vertex(str(i), i)
+        sample = d.random_vertices(5)
+        assert len(sample) == 5
+        assert len(d.random_vertices(50)) == 20
+
+
+class TestFSM:
+    def test_transitions(self):
+        f = FSM("pending", [
+            EventDesc("run", ("pending",), "running"),
+            EventDesc("done", ("running",), "succeeded"),
+        ])
+        assert f.can("run")
+        f.event("run")
+        assert f.current == "running"
+        with pytest.raises(TransitionError):
+            f.event("run")
+        f.event("done")
+        assert f.is_state("succeeded")
+
+
+class TestTTLCache:
+    def test_expiry(self):
+        c = TTLCache()
+        c.set("a", 1, ttl=1000)
+        v, ok = c.get("a")
+        assert ok and v == 1
+        c.set("b", 2, ttl=-1)  # no expiration
+        _, ok = c.get("b")
+        assert ok
+        c.set("c", 3, ttl=0.0)
+        import time
+
+        time.sleep(0.01)
+        _, ok = c.get("c")
+        assert not ok
+
+
+class TestHashRing:
+    def test_pick_stability(self):
+        ring = HashRing(["s1", "s2", "s3"])
+        key = "task-abc"
+        first = ring.pick(key)
+        for _ in range(10):
+            assert ring.pick(key) == first
+
+    def test_remove_minimal_disruption(self):
+        ring = HashRing(["s1", "s2", "s3"])
+        keys = [f"task-{i}" for i in range(200)]
+        before = {k: ring.pick(k) for k in keys}
+        ring.remove("s2")
+        moved = sum(1 for k in keys if before[k] != ring.pick(k) and before[k] != "s2")
+        assert moved == 0  # only keys owned by s2 move
+        assert all(ring.pick(k) != "s2" for k in keys)
+
+    def test_pick_n(self):
+        ring = HashRing(["s1", "s2", "s3"])
+        picks = ring.pick_n("k", 3)
+        assert sorted(picks) == ["s1", "s2", "s3"]
+
+
+class TestTypes:
+    def test_host_type(self):
+        assert HostType.parse("super") == HostType.SUPER_SEED
+        assert HostType.SUPER_SEED.is_seed()
+        assert not HostType.NORMAL.is_seed()
+
+    def test_parse_size(self):
+        assert parse_size("4MiB") == 4 << 20
+        assert parse_size("1.5K") == 1536
+        assert parse_size(42) == 42
+
+
+class TestLimiter:
+    def test_burst_floor_never_hangs(self, run_async):
+        from dragonfly2_tpu.pkg.ratelimit import Limiter
+
+        async def body():
+            lim = Limiter(limit=0.5)  # would be burst=0 without the floor
+            assert lim._burst >= 1
+            lim2 = Limiter(limit=10_000, burst=0)
+            await lim2.wait(3)  # must terminate
+
+        run_async(body(), timeout=10)
+
+    def test_cancelled_wait_refunds_tokens(self, run_async):
+        import asyncio
+
+        from dragonfly2_tpu.pkg.ratelimit import Limiter
+
+        async def body():
+            lim = Limiter(limit=100, burst=10)
+            await lim.wait(10)  # drain the bucket
+            t = asyncio.ensure_future(lim.wait(10))
+            await asyncio.sleep(0.01)
+            t.cancel()
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+            # The cancelled reservation must be refunded: a fresh waiter
+            # should need ~0.1s (10 tokens @ 100/s), not ~0.2s.
+            import time
+
+            start = time.monotonic()
+            await lim.wait(10)
+            assert time.monotonic() - start < 0.15
+
+        run_async(body(), timeout=10)
+
+    def test_throughput_shaping(self, run_async):
+        import time
+
+        from dragonfly2_tpu.pkg.ratelimit import Limiter
+
+        async def body():
+            lim = Limiter(limit=1000, burst=100)
+            start = time.monotonic()
+            total = 0
+            while total < 300:
+                await lim.wait(100)
+                total += 100
+            # 300 tokens @ 1000/s with 100 burst → ≥ ~0.2s
+            assert time.monotonic() - start >= 0.15
+
+        run_async(body(), timeout=10)
+
+
+def test_range_inverted_rejected():
+    import pytest as _pytest
+
+    from dragonfly2_tpu.pkg.piece import Range
+
+    with _pytest.raises(ValueError):
+        Range.parse_http("bytes=9-0")
+
+
+def test_dflog_late_configure_adds_file_handler(tmp_path):
+    import logging
+
+    from dragonfly2_tpu.pkg import dflog
+
+    dflog.get("late-test").info("before configure")
+    dflog.configure(log_dir=str(tmp_path))
+    dflog.get("late-test").info("after configure")
+    root = logging.getLogger("df")
+    assert any(isinstance(h, logging.handlers.RotatingFileHandler) for h in root.handlers)

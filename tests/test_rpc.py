@@ -1,0 +1,191 @@
+"""drpc tests: unary calls, bidi streams, errors, reconnect."""
+
+import asyncio
+
+import pytest
+
+from dragonfly2_tpu.pkg.errors import Code, DfError
+from dragonfly2_tpu.pkg.types import NetAddr
+from dragonfly2_tpu.rpc import Client, RpcError, Server
+
+
+async def _make_server() -> tuple[Server, int]:
+    srv = Server("test")
+
+    async def echo(body, ctx):
+        return {"echo": body}
+
+    async def fail(body, ctx):
+        raise DfError(Code.SchedNeedBackSource, "go away")
+
+    async def crash(body, ctx):
+        raise RuntimeError("boom")
+
+    async def sum_stream(stream, ctx):
+        total = 0
+        while True:
+            msg = await stream.recv()
+            if msg is None:
+                break
+            total += msg["n"]
+            await stream.send({"running_total": total})
+
+    async def counter(stream, ctx):
+        n = stream.open_body["count"]
+        for i in range(n):
+            await stream.send({"i": i})
+
+    srv.register_unary("Test.Echo", echo)
+    srv.register_unary("Test.Fail", fail)
+    srv.register_unary("Test.Crash", crash)
+    srv.register_stream("Test.Sum", sum_stream)
+    srv.register_stream("Test.Counter", counter)
+    await srv.serve(NetAddr.tcp("127.0.0.1", 0))
+    return srv, srv.port()
+
+
+def test_unary_echo(run_async):
+    async def body():
+        srv, port = await _make_server()
+        cli = Client(NetAddr.tcp("127.0.0.1", port))
+        try:
+            res = await cli.call("Test.Echo", {"x": 1})
+            assert res == {"echo": {"x": 1}}
+        finally:
+            await cli.close()
+            await srv.close()
+
+    run_async(body())
+
+
+def test_unary_coded_error(run_async):
+    async def body():
+        srv, port = await _make_server()
+        cli = Client(NetAddr.tcp("127.0.0.1", port))
+        try:
+            with pytest.raises(DfError) as ei:
+                await cli.call("Test.Fail")
+            assert ei.value.code == Code.SchedNeedBackSource
+            with pytest.raises(DfError) as ei:
+                await cli.call("Test.Crash")
+            assert ei.value.code == Code.UnknownError
+            with pytest.raises(DfError) as ei:
+                await cli.call("No.Such")
+            assert ei.value.code == Code.BadRequest
+        finally:
+            await cli.close()
+            await srv.close()
+
+    run_async(body())
+
+
+def test_bidi_stream(run_async):
+    async def body():
+        srv, port = await _make_server()
+        cli = Client(NetAddr.tcp("127.0.0.1", port))
+        try:
+            stream = await cli.open_stream("Test.Sum")
+            for n in (1, 2, 3):
+                await stream.send({"n": n})
+                res = await stream.recv(timeout=5)
+                assert res["running_total"] == sum(range(1, n + 1))
+            await stream.close()
+            assert await stream.recv(timeout=5) is None  # clean server close
+        finally:
+            await cli.close()
+            await srv.close()
+
+    run_async(body())
+
+
+def test_server_stream(run_async):
+    async def body():
+        srv, port = await _make_server()
+        cli = Client(NetAddr.tcp("127.0.0.1", port))
+        try:
+            stream = await cli.open_stream("Test.Counter", {"count": 5})
+            got = []
+            while True:
+                msg = await stream.recv(timeout=5)
+                if msg is None:
+                    break
+                got.append(msg["i"])
+            assert got == list(range(5))
+        finally:
+            await cli.close()
+            await srv.close()
+
+    run_async(body())
+
+
+def test_connect_refused(run_async):
+    async def body():
+        cli = Client(NetAddr.tcp("127.0.0.1", 1))  # nothing listening
+        with pytest.raises(RpcError) as ei:
+            await cli.call("Test.Echo")
+        assert ei.value.code == Code.ClientConnectionError
+        await cli.close()
+
+    run_async(body())
+
+
+def test_reconnect_after_server_restart(run_async):
+    async def body():
+        srv, port = await _make_server()
+        cli = Client(NetAddr.tcp("127.0.0.1", port))
+        assert (await cli.call("Test.Echo", 1))["echo"] == 1
+        await srv.close()
+        await asyncio.sleep(0.05)
+        with pytest.raises(DfError):
+            await cli.call("Test.Echo", 2, timeout=2)
+        # New server on the same port; client reconnects lazily.
+        srv2 = Server("test2")
+
+        async def echo(body, ctx):
+            return {"echo": body}
+
+        srv2.register_unary("Test.Echo", echo)
+        await srv2.serve(NetAddr.tcp("127.0.0.1", port))
+        assert (await cli.call("Test.Echo", 3))["echo"] == 3
+        await cli.close()
+        await srv2.close()
+
+    run_async(body())
+
+
+def test_unix_socket(run_async, tmp_path):
+    async def body():
+        srv = Server("unix-test")
+
+        async def echo(body, ctx):
+            return body
+
+        srv.register_unary("E", echo)
+        sock = str(tmp_path / "s.sock")
+        await srv.serve(NetAddr.unix(sock))
+        cli = Client(NetAddr.unix(sock))
+        assert await cli.call("E", "hi") == "hi"
+        assert await cli.ping()
+        await cli.close()
+        await srv.close()
+
+    run_async(body())
+
+
+def test_concurrent_calls(run_async):
+    async def body():
+        srv = Server("conc")
+
+        async def slow_echo(body, ctx):
+            await asyncio.sleep(0.01 * (body % 5))
+            return body
+
+        srv.register_unary("E", slow_echo)
+        await srv.serve(NetAddr.tcp("127.0.0.1", 0))
+        cli = Client(NetAddr.tcp("127.0.0.1", srv.port()))
+        results = await asyncio.gather(*[cli.call("E", i) for i in range(20)])
+        assert results == list(range(20))
+        await cli.close()
+        await srv.close()
+
+    run_async(body())
