@@ -1,0 +1,1 @@
+"""CLI entry points (reference: cmd/{dfget,dfcache,dfstore,scheduler,manager})."""

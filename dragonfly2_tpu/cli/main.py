@@ -1,0 +1,184 @@
+"""``df`` multi-command CLI.
+
+Reference: cmd/ — one cobra binary per role; we expose one Python entry with
+subcommands: dfget, daemon, scheduler, manager, dfcache, dfstore.
+``python -m dragonfly2_tpu.cli.main <cmd> ...`` or the ``df`` console script.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import subprocess
+import sys
+import time
+
+from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg.dfpath import Dfpath
+from dragonfly2_tpu.pkg.types import format_size
+
+log = dflog.get("cli")
+
+
+def _add_dfget(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser("dfget", help="download a file through the P2P fabric")
+    p.add_argument("url", help="source URL (http/https/file/gs)")
+    p.add_argument("-O", "--output", required=True, help="output path")
+    p.add_argument("--tag", default="", help="task isolation tag")
+    p.add_argument("--application", default="")
+    p.add_argument("--digest", default="", help="expected digest algo:hex")
+    p.add_argument("--filter", default="", help="'&'-separated query params to ignore")
+    p.add_argument("--range", dest="range_", default="", help="byte range a-b")
+    p.add_argument("--header", action="append", default=[], help="k:v (repeatable)")
+    p.add_argument("--disable-back-source", action="store_true")
+    p.add_argument("--recursive", action="store_true")
+    p.add_argument("--level", type=int, default=5, help="recursion depth")
+    p.add_argument("--timeout", type=float, default=0.0)
+    p.add_argument("--work-home", default="")
+    p.add_argument("--no-daemon", action="store_true", help="never spawn a daemon")
+    p.set_defaults(func=_run_dfget)
+
+
+def _run_dfget(args: argparse.Namespace) -> int:
+    from dragonfly2_tpu.client import dfget as dfget_lib
+    from dragonfly2_tpu.proto.common import UrlMeta
+
+    path = Dfpath(args.work_home) if args.work_home else Dfpath()
+    header = {}
+    for h in args.header:
+        k, _, v = h.partition(":")
+        header[k.strip()] = v.strip()
+    meta = UrlMeta(digest=args.digest, tag=args.tag, filter=args.filter,
+                   application=args.application, header=header,
+                   range=args.range_)
+    cfg = dfget_lib.DfgetConfig(
+        url=args.url,
+        output=args.output,
+        daemon_sock=path.daemon_sock,
+        meta=meta,
+        disable_back_source=args.disable_back_source,
+        recursive=args.recursive,
+        level=args.level,
+        timeout=args.timeout,
+    )
+
+    async def run() -> int:
+        if not args.no_daemon and not await dfget_lib.is_daemon_alive(path.daemon_sock):
+            _spawn_daemon(path)
+            await _wait_daemon(path.daemon_sock)
+        start = time.monotonic()
+        state = {"last": 0}
+
+        def on_progress(msg: dict) -> None:
+            if msg.get("state") != "running":
+                return
+            done = msg.get("completed_length", 0)
+            total = msg.get("content_length", -1)
+            if done - state["last"] >= (8 << 20) or done == total:
+                state["last"] = done
+                pct = f"{100 * done / total:5.1f}%" if total > 0 else "  ?  "
+                sys.stderr.write(f"\r{pct} {format_size(done)}")
+                sys.stderr.flush()
+
+        result = await dfget_lib.download(cfg, on_progress)
+        elapsed = time.monotonic() - start
+        size = result.get("completed_length", 0)
+        rate = size / elapsed if elapsed > 0 else 0
+        sys.stderr.write(
+            f"\rdownloaded {format_size(size)} in {elapsed:.2f}s "
+            f"({format_size(int(rate))}/s) task={result.get('task_id', '')[:16]} "
+            f"reuse={result.get('from_reuse', False)} p2p={result.get('from_p2p', False)}\n"
+        )
+        return 0
+
+    try:
+        return asyncio.run(run())
+    except Exception as e:
+        sys.stderr.write(f"\ndfget: error: {e}\n")
+        return 1
+
+
+def _spawn_daemon(path: Dfpath) -> None:
+    """Fork a daemon like dfget does (reference cmd/dfget/cmd/root.go:313)."""
+    path.ensure()
+    cmd = [sys.executable, "-m", "dragonfly2_tpu.cli.main", "daemon",
+           "--work-home", path.root]
+    with open(os.path.join(path.log_dir, "daemon-spawn.log"), "ab") as logf:
+        subprocess.Popen(cmd, stdout=logf, stderr=logf,
+                         start_new_session=True, close_fds=True)
+    log.info("spawned daemon", work_home=path.root)
+
+
+async def _wait_daemon(sock: str, timeout: float = 15.0) -> None:
+    from dragonfly2_tpu.client.dfget import is_daemon_alive
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if await is_daemon_alive(sock):
+            return
+        await asyncio.sleep(0.1)
+    raise RuntimeError(f"daemon did not come up on {sock} within {timeout}s")
+
+
+def _add_daemon(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser("daemon", help="run the peer daemon (dfdaemon)")
+    p.add_argument("--config", default="", help="YAML config path")
+    p.add_argument("--work-home", default="")
+    p.add_argument("--seed-peer", action="store_true")
+    p.add_argument("--scheduler", action="append", default=[],
+                   help="scheduler host:port (repeatable)")
+    p.add_argument("--alive-time", type=float, default=0.0)
+    p.set_defaults(func=_run_daemon)
+
+
+def _run_daemon(args: argparse.Namespace) -> int:
+    from dragonfly2_tpu.daemon.config import DaemonConfig
+    from dragonfly2_tpu.daemon.daemon import Daemon
+
+    if args.config:
+        cfg = DaemonConfig.load(args.config)
+    else:
+        cfg = DaemonConfig()
+    if args.work_home:
+        cfg.work_home = args.work_home
+        cfg.__post_init__()
+    if args.seed_peer:
+        cfg.seed_peer = True
+    if args.scheduler:
+        cfg.scheduler.addrs = args.scheduler
+    if args.alive_time:
+        cfg.alive_time = args.alive_time
+
+    async def run() -> int:
+        daemon = Daemon(cfg)
+        import signal
+
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, lambda: asyncio.ensure_future(daemon.stop()))
+        await daemon.serve()
+        return 0
+
+    return asyncio.run(run())
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="df", description="TPU-native P2P content fabric")
+    sub = parser.add_subparsers(dest="command", required=True)
+    _add_dfget(sub)
+    _add_daemon(sub)
+    # scheduler/manager/dfcache/dfstore subcommands are registered as those
+    # stages land (SURVEY.md §7 build order).
+    try:
+        from dragonfly2_tpu.cli import extra
+
+        extra.register(sub)
+    except ImportError:
+        pass
+    args = parser.parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1 @@
+"""Client libraries: dfget, dfcache, dfstore (reference: client/{dfget,dfcache,dfstore})."""

@@ -1,0 +1,1 @@
+"""The data-plane peer daemon (reference: client/daemon)."""

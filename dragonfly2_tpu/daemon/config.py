@@ -1,0 +1,164 @@
+"""Daemon configuration tree.
+
+Reference: client/config/peerhost.go:46-85 (DaemonOption: scheduler, host,
+download, upload, proxy, objectStorage, storage, announcer...) with YAML
+loading (:91-110). Kept as nested dataclasses with a YAML/dict loader.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from dataclasses import dataclass, field
+
+import yaml
+
+from dragonfly2_tpu.pkg.dfpath import Dfpath
+from dragonfly2_tpu.pkg.types import HostType, parse_size
+
+
+def _local_ip() -> str:
+    # UDP connect trick: no traffic actually sent.
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("10.255.255.255", 1))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+@dataclass
+class HostOption:
+    hostname: str = field(default_factory=socket.gethostname)
+    ip: str = field(default_factory=_local_ip)
+    idc: str = ""               # for TPU: the pod/cluster identifier
+    location: str = ""          # "zone|pod|slice|host" affinity path
+    tpu_slice: str = ""         # slice name within the pod (ICI domain)
+    tpu_worker_index: int = -1  # worker index within the slice
+
+
+@dataclass
+class SchedulerOption:
+    addrs: list[str] = field(default_factory=list)  # "host:port" drpc
+    schedule_timeout: float = 30.0
+    disable_auto_back_source: bool = False
+    max_schedule_attempts: int = 5
+
+
+@dataclass
+class DownloadOption:
+    rate_limit: int = 0             # bytes/sec, 0 = unlimited
+    piece_concurrency: int = 4      # origin range-group concurrency
+    parent_concurrency: int = 4     # concurrent parent piece workers
+    unix_sock: str = ""             # download gRPC analog (dfget attach)
+    peer_port: int = 0              # TCP drpc for other peers (sync pieces)
+    calculate_digest: bool = True
+    prefetch: bool = False          # prefetch whole task on ranged requests
+    concurrent_min_length: int = 32 << 20
+
+
+@dataclass
+class UploadOption:
+    port: int = 0                   # HTTP piece upload server, 0 = ephemeral
+    rate_limit: int = 0
+
+
+@dataclass
+class StorageOpt:
+    task_ttl: float = 3 * 3600.0
+    disk_gc_threshold: int = 0
+    keep_storage: bool = True
+    write_buffer_size: int = 4 << 20
+
+
+@dataclass
+class ProxyOption:
+    enabled: bool = False
+    port: int = 0
+    registry_mirror: str = ""       # remote registry URL to mirror
+    rules: list[dict] = field(default_factory=list)  # {regex, use_dragonfly, direct}
+    white_list_ports: list[int] = field(default_factory=lambda: [443, 80])
+    max_concurrency: int = 0
+
+
+@dataclass
+class ObjectStorageOption:
+    enabled: bool = False
+    port: int = 0
+    max_replicas: int = 3
+
+
+@dataclass
+class TPUSinkOption:
+    """--device=tpu sink: land verified pieces into TPU HBM (no reference
+    analog; BASELINE.json north star)."""
+
+    enabled: bool = False
+    mesh_shape: list[int] = field(default_factory=list)
+    donate_staging: bool = True
+
+
+@dataclass
+class DaemonConfig:
+    host: HostOption = field(default_factory=HostOption)
+    scheduler: SchedulerOption = field(default_factory=SchedulerOption)
+    download: DownloadOption = field(default_factory=DownloadOption)
+    upload: UploadOption = field(default_factory=UploadOption)
+    storage: StorageOpt = field(default_factory=StorageOpt)
+    proxy: ProxyOption = field(default_factory=ProxyOption)
+    object_storage: ObjectStorageOption = field(default_factory=ObjectStorageOption)
+    tpu_sink: TPUSinkOption = field(default_factory=TPUSinkOption)
+    work_home: str = ""
+    host_type: str = "normal"       # normal|super|strong|weak (seed tiers)
+    alive_time: float = 0.0         # 0 = forever
+    gc_interval: float = 60.0
+    metrics_port: int = 0
+    manager_addr: str = ""          # manager drpc for dynconfig (stage 4)
+    seed_peer: bool = False
+
+    def __post_init__(self):
+        if not self.work_home:
+            self.work_home = Dfpath().root
+        path = Dfpath(self.work_home)
+        if not self.download.unix_sock:
+            self.download.unix_sock = path.daemon_sock
+
+    @property
+    def dfpath(self) -> Dfpath:
+        return Dfpath(self.work_home)
+
+    @property
+    def host_type_enum(self) -> HostType:
+        if self.seed_peer and self.host_type == "normal":
+            return HostType.SUPER_SEED
+        return HostType.parse(self.host_type)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DaemonConfig":
+        cfg = cls()
+        _merge_dataclass(cfg, d)
+        cfg.__post_init__()
+        return cfg
+
+    @classmethod
+    def load(cls, path: str) -> "DaemonConfig":
+        with open(path) as f:
+            data = yaml.safe_load(f) or {}
+        return cls.from_dict(data)
+
+
+def _merge_dataclass(obj, d: dict) -> None:
+    """Recursive dict→dataclass merge; size strings like '100MiB' accepted
+    for int fields ending in _limit/_size/_threshold."""
+    for key, value in d.items():
+        if not hasattr(obj, key):
+            continue
+        current = getattr(obj, key)
+        if hasattr(current, "__dataclass_fields__") and isinstance(value, dict):
+            _merge_dataclass(current, value)
+        elif isinstance(current, int) and not isinstance(current, bool) and isinstance(value, str):
+            setattr(obj, key, parse_size(value))
+        else:
+            setattr(obj, key, value)

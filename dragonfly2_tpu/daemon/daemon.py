@@ -1,0 +1,93 @@
+"""Daemon bootstrap: wire every sub-service and serve.
+
+Reference: client/daemon/daemon.go — New (:108) builds storage, peer task
+manager, rpc servers, upload server, proxy, object storage, gc, announcer;
+Serve (:400-710) starts them; Stop (:711) tears down. Stage 2 wires the
+download path; later stages attach upload/proxy/objectstorage/announcer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from dragonfly2_tpu.daemon.config import DaemonConfig
+from dragonfly2_tpu.daemon.peer.piece_manager import PieceManager, PieceManagerOption
+from dragonfly2_tpu.daemon.peer.task_manager import TaskManager
+from dragonfly2_tpu.daemon.rpcserver import DaemonRpcServer
+from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg.cache import GC, GCTask
+from dragonfly2_tpu.pkg.ratelimit import Limiter
+from dragonfly2_tpu.pkg.types import NetAddr
+from dragonfly2_tpu.storage import StorageManager, StorageOption
+
+log = dflog.get("daemon")
+
+
+class Daemon:
+    def __init__(self, config: DaemonConfig):
+        self.config = config
+        path = config.dfpath.ensure()
+        dflog.configure(log_dir=path.log_dir)
+
+        self.storage = StorageManager(
+            StorageOption(
+                data_dir=path.data_dir,
+                task_ttl=config.storage.task_ttl,
+                disk_gc_threshold=config.storage.disk_gc_threshold,
+                keep_storage=config.storage.keep_storage,
+                gc_interval=config.gc_interval,
+            )
+        )
+        self.storage.reload()
+
+        rate = config.download.rate_limit
+        self.piece_manager = PieceManager(
+            PieceManagerOption(
+                concurrency=config.download.piece_concurrency,
+                compute_digest=config.download.calculate_digest,
+                concurrent_min_length=config.download.concurrent_min_length,
+            ),
+            limiter=Limiter(rate if rate > 0 else float("inf")),
+        )
+        self.task_manager = TaskManager(
+            self.storage,
+            self.piece_manager,
+            host_ip=config.host.ip,
+            total_rate_limit=rate,
+        )
+        self.rpc = DaemonRpcServer(self.task_manager)
+        self.gc = GC(log)
+        self.gc.add(GCTask("storage", config.gc_interval, 30.0, self._gc_storage))
+        self._stopped = asyncio.Event()
+
+    async def _gc_storage(self) -> None:
+        self.storage.gc()
+
+    async def serve(self) -> None:
+        await self.rpc.serve_download(NetAddr.unix(self.config.download.unix_sock))
+        if self.config.download.peer_port >= 0:
+            await self.rpc.serve_peer(
+                NetAddr.tcp(self.config.host.ip, self.config.download.peer_port)
+            )
+        self.gc.serve()
+        log.info(
+            "daemon up",
+            sock=self.config.download.unix_sock,
+            data_dir=self.storage.opt.data_dir,
+        )
+        if self.config.alive_time > 0:
+            try:
+                await asyncio.wait_for(self._stopped.wait(), self.config.alive_time)
+            except asyncio.TimeoutError:
+                log.info("alive time reached, exiting")
+        else:
+            await self._stopped.wait()
+
+    async def stop(self) -> None:
+        self.gc.stop()
+        await self.rpc.close()
+        self.storage.close()
+        self._stopped.set()
+
+    def peer_port(self) -> int:
+        return self.rpc.peer_server.port()

@@ -1,0 +1,2 @@
+"""Peer-task machinery: conductors, piece pipeline, reuse
+(reference: client/daemon/peer)."""

@@ -1,0 +1,272 @@
+"""Piece manager: origin (back-to-source) piece pipeline.
+
+Reference: client/daemon/peer/piece_manager.go — DownloadSource (:304),
+known-length sequential (:481), unknown-length streaming (:539), concurrent
+back-to-source by piece group with byte ranges (:796-1000, pieceGroup
+:876-922), optional digest computation (WithCalculateDigest :91), file
+import for dfcache (ImportFile :662). Parent-peer piece downloads live in
+piece_downloader.py; this module owns origin fetches and storage writes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+from typing import Awaitable, Callable
+
+from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg import digest as pkgdigest
+from dragonfly2_tpu.pkg.errors import Code, SourceError
+from dragonfly2_tpu.pkg.piece import Range, compute_piece_count, compute_piece_size
+from dragonfly2_tpu.pkg.ratelimit import Limiter
+from dragonfly2_tpu.source import Request as SourceRequest
+from dragonfly2_tpu.source import get_client
+from dragonfly2_tpu.storage.local_store import LocalTaskStore, PieceRecord
+
+log = dflog.get("peer.piece_manager")
+
+# piece arrival callback: fired after each piece lands in storage, with the
+# record and the store (conductor reports to scheduler + notifies subscribers)
+PieceCallback = Callable[[LocalTaskStore, PieceRecord], Awaitable[None]]
+
+
+@dataclass
+class PieceManagerOption:
+    concurrency: int = 4                  # concurrent range streams to origin
+    compute_digest: bool = True           # per-piece md5 during write
+    concurrent_min_length: int = 32 << 20 # below this, a single stream wins
+    chunk_size: int = 1 << 20
+
+
+class PieceManager:
+    def __init__(self, opt: PieceManagerOption | None = None, limiter: Limiter | None = None):
+        self.opt = opt or PieceManagerOption()
+        self._limiter = limiter or Limiter()
+
+    # -- origin download entry (reference piece_manager.go:304) ------------
+
+    async def download_source(
+        self,
+        store: LocalTaskStore,
+        url: str,
+        header: dict[str, str] | None = None,
+        *,
+        content_range: Range | None = None,
+        on_piece: PieceCallback | None = None,
+        limiter: Limiter | None = None,
+    ) -> None:
+        """Fetch the full content from origin into ``store``. Decides between
+        sequential, concurrent-range-group and unknown-length paths."""
+        client = get_client(url)
+        header = dict(header or {})
+        header.pop("Range", None)
+        request = SourceRequest(url, header)
+        limiter = limiter or self._limiter
+
+        content_length = store.metadata.content_length
+        range_known: bool | None = None
+        if content_length < 0:
+            try:
+                content_length, range_known = await client.probe(request)
+            except SourceError:
+                content_length = -1
+        if content_range is not None:
+            # Ranged task: treat the range as the content.
+            total = content_length if content_length >= 0 else -1
+            if total >= 0:
+                if content_range.start >= total:
+                    raise SourceError(f"range start {content_range.start} beyond length {total}",
+                                      Code.BadRequest)
+                length = min(content_range.length, total - content_range.start) \
+                    if content_range.length >= 0 else total - content_range.start
+            else:
+                length = content_range.length
+            content_length = length
+
+        if content_length is not None and content_length >= 0:
+            piece_size = store.metadata.piece_size or compute_piece_size(content_length)
+            total_pieces = compute_piece_count(content_length, piece_size)
+            store.update_task(content_length=content_length, piece_size=piece_size,
+                              total_piece_count=total_pieces)
+            support_range = False
+            if content_length >= self.opt.concurrent_min_length and self.opt.concurrency > 1:
+                if range_known is not None:
+                    support_range = range_known  # answered by the same probe
+                else:
+                    try:
+                        support_range = await client.is_support_range(request)
+                    except SourceError:
+                        support_range = False
+            if support_range:
+                await self._download_known_length_concurrent(
+                    store, client, request, content_range, on_piece, limiter)
+            else:
+                await self._download_streaming(
+                    store, client, request, content_range, on_piece, limiter,
+                    known_length=content_length)
+        else:
+            if store.metadata.piece_size <= 0:
+                store.update_task(piece_size=compute_piece_size(-1))
+            await self._download_streaming(
+                store, client, request, content_range, on_piece, limiter, known_length=-1)
+
+        if not store.is_complete():
+            raise SourceError(
+                f"source download incomplete: {len(store.metadata.pieces)}/"
+                f"{store.metadata.total_piece_count} pieces", Code.BackToSourceAborted)
+
+    # -- sequential / unknown-length (reference :481,:539) -----------------
+
+    async def _download_streaming(
+        self,
+        store: LocalTaskStore,
+        client,
+        request: SourceRequest,
+        content_range: Range | None,
+        on_piece: PieceCallback | None,
+        limiter: Limiter,
+        known_length: int,
+    ) -> None:
+        req = request
+        if content_range is not None:
+            req = request.with_range(content_range.to_http())
+        resp = await client.download(req)
+        piece_size = store.metadata.piece_size
+        num = 0
+        buf = bytearray()
+        total = 0
+        start = time.monotonic()
+        try:
+            async for chunk in resp.body:
+                buf += chunk
+                total += len(chunk)
+                while len(buf) >= piece_size:
+                    data = bytes(buf[:piece_size])
+                    del buf[:piece_size]
+                    await self._write_piece(store, num, data, on_piece, limiter, start)
+                    num += 1
+                    start = time.monotonic()
+        finally:
+            await resp.close()
+        # Length check BEFORE the trailing partial piece lands: a dropped
+        # connection must never persist a truncated piece in metadata.
+        if known_length >= 0 and total != known_length:
+            raise SourceError(f"origin returned {total} bytes, expected {known_length}",
+                              Code.BackToSourceAborted, temporary=True)
+        if buf:
+            await self._write_piece(store, num, bytes(buf), on_piece, limiter, start)
+            num += 1
+        if known_length < 0:
+            # Learned the length at EOF (reference downloadUnknownLengthSource
+            # finishes by updating task metadata).
+            store.update_task(content_length=total, total_piece_count=num)
+
+    # -- concurrent piece groups (reference :796-1000) ---------------------
+
+    async def _download_known_length_concurrent(
+        self,
+        store: LocalTaskStore,
+        client,
+        request: SourceRequest,
+        content_range: Range | None,
+        on_piece: PieceCallback | None,
+        limiter: Limiter,
+    ) -> None:
+        m = store.metadata
+        total_pieces = m.total_piece_count
+        concurrency = min(self.opt.concurrency, total_pieces)
+        # Contiguous piece groups (reference pieceGroup :876-922): group g
+        # covers pieces [g*per + min(g, rem) ... ), sizes differ by ≤1.
+        per, rem = divmod(total_pieces, concurrency)
+        groups: list[tuple[int, int]] = []
+        start_piece = 0
+        for g in range(concurrency):
+            count = per + (1 if g < rem else 0)
+            groups.append((start_piece, start_piece + count))
+            start_piece += count
+
+        base_offset = content_range.start if content_range is not None else 0
+
+        async def fetch_group(first: int, last: int) -> None:
+            byte_start = base_offset + first * m.piece_size
+            byte_len = min(last * m.piece_size, m.content_length) - first * m.piece_size
+            req = request.with_range(Range(byte_start, byte_len).to_http())
+            resp = await client.download(req)
+            if resp.status != 206:
+                await resp.close()
+                raise SourceError("origin ignored range request",
+                                  Code.SourceRangeUnsupported, temporary=True)
+            num = first
+            buf = bytearray()
+            got = 0
+            t0 = time.monotonic()
+            try:
+                async for chunk in resp.body:
+                    buf += chunk
+                    got += len(chunk)
+                    while len(buf) >= m.piece_size and num < last - 1:
+                        data = bytes(buf[: m.piece_size])
+                        del buf[: m.piece_size]
+                        await self._write_piece(store, num, data, on_piece, limiter, t0)
+                        num += 1
+                        t0 = time.monotonic()
+            finally:
+                await resp.close()
+            # Length check first — a short stream must not persist its
+            # trailing buffer as a (truncated) piece.
+            if got != byte_len:
+                raise SourceError(f"group [{first},{last}) got {got} bytes, want {byte_len}",
+                                  Code.BackToSourceAborted, temporary=True)
+            if buf:
+                await self._write_piece(store, num, bytes(buf), on_piece, limiter, t0)
+                num += 1
+
+        results = await asyncio.gather(
+            *(fetch_group(f, l) for f, l in groups), return_exceptions=True
+        )
+        errors = [r for r in results if isinstance(r, BaseException)]
+        if errors:
+            raise errors[0]
+
+    # -- shared piece writer -----------------------------------------------
+
+    async def _write_piece(
+        self,
+        store: LocalTaskStore,
+        num: int,
+        data: bytes,
+        on_piece: PieceCallback | None,
+        limiter: Limiter,
+        started_at: float,
+    ) -> None:
+        await limiter.wait(len(data))
+        cost_ms = int((time.monotonic() - started_at) * 1000)
+        if store.has_piece(num):
+            return
+        rec = store.write_piece(num, data, cost_ms=cost_ms) if self.opt.compute_digest \
+            else store.write_piece(num, data, expected_digest="", cost_ms=cost_ms)
+        if on_piece is not None:
+            await on_piece(store, rec)
+
+    # -- file import for dfcache (reference :662 ImportFile) ---------------
+
+    async def import_file(self, store: LocalTaskStore, path: str,
+                          on_piece: PieceCallback | None = None) -> None:
+        import os
+
+        size = os.path.getsize(path)
+        piece_size = store.metadata.piece_size or compute_piece_size(size)
+        total = compute_piece_count(size, piece_size)
+        store.update_task(content_length=size, piece_size=piece_size, total_piece_count=total)
+        with open(path, "rb") as f:
+            for num in range(total):
+                data = f.read(piece_size)
+                t0 = time.monotonic()
+                await self._write_piece(store, num, data, on_piece, self._limiter, t0)
+
+    # -- whole-content digest ----------------------------------------------
+
+    @staticmethod
+    def validate_content(store: LocalTaskStore, expected_digest: str = "") -> str:
+        return store.validate_digest(expected_digest)

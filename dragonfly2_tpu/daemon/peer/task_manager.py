@@ -1,0 +1,253 @@
+"""Peer task manager: task front-end, dedup and reuse.
+
+Reference: client/daemon/peer/peertask_manager.go — StartFileTask (:328),
+StartStreamTask (:357), StartSeedTask (:401), conductor dedup
+(getOrCreatePeerTaskConductor :201) and peertask_reuse.go (local-completion
+reuse). Stage 2 wires reuse + back-to-source; the P2P conductor
+(conductor.py) plugs in via ``scheduler_client``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import AsyncIterator
+
+from dragonfly2_tpu.daemon.peer.piece_manager import PieceManager
+from dragonfly2_tpu.pkg import dflog, idgen
+from dragonfly2_tpu.pkg.errors import Code, DfError
+from dragonfly2_tpu.pkg.piece import Range
+from dragonfly2_tpu.pkg.ratelimit import Limiter
+from dragonfly2_tpu.proto.common import UrlMeta
+from dragonfly2_tpu.storage import StorageManager, TaskStoreMetadata
+
+log = dflog.get("peer.task_manager")
+
+
+@dataclass
+class FileTaskRequest:
+    url: str
+    output: str
+    meta: UrlMeta = field(default_factory=UrlMeta)
+    peer_id: str = ""
+    disable_back_source: bool = False
+    range: Range | None = None
+
+    def task_id(self) -> str:
+        return idgen.task_id_v1(
+            self.url,
+            digest=self.meta.digest,
+            tag=self.meta.tag,
+            application=self.meta.application,
+            filters=self.meta.filter,
+            range_header=self.meta.range,
+        )
+
+
+@dataclass
+class FileTaskProgress:
+    state: str                  # running | done | failed
+    task_id: str = ""
+    peer_id: str = ""
+    content_length: int = -1
+    completed_length: int = 0
+    piece_count: int = 0
+    total_piece_count: int = -1
+    digest: str = ""
+    error: dict | None = None
+    from_reuse: bool = False
+    from_p2p: bool = False
+
+    def to_wire(self) -> dict:
+        return {
+            "state": self.state,
+            "task_id": self.task_id,
+            "peer_id": self.peer_id,
+            "content_length": self.content_length,
+            "completed_length": self.completed_length,
+            "piece_count": self.piece_count,
+            "total_piece_count": self.total_piece_count,
+            "digest": self.digest,
+            "error": self.error,
+            "from_reuse": self.from_reuse,
+            "from_p2p": self.from_p2p,
+        }
+
+
+class TaskManager:
+    """Front-end for file/stream/seed tasks. Holds the storage manager, the
+    piece manager and (from stage 3) the conductor pool."""
+
+    def __init__(
+        self,
+        storage: StorageManager,
+        piece_manager: PieceManager,
+        *,
+        host_ip: str = "127.0.0.1",
+        scheduler_client=None,
+        conductor_factory=None,
+        total_rate_limit: int = 0,
+    ):
+        self.storage = storage
+        self.piece_manager = piece_manager
+        self.host_ip = host_ip
+        self.scheduler_client = scheduler_client
+        self.conductor_factory = conductor_factory
+        self.limiter = Limiter(total_rate_limit if total_rate_limit > 0 else float("inf"))
+
+    # -- file task (reference peertask_manager.go:328) ---------------------
+
+    async def start_file_task(self, req: FileTaskRequest) -> AsyncIterator[FileTaskProgress]:
+        task_id = req.task_id()
+        peer_id = req.peer_id or idgen.peer_id_v1(self.host_ip)
+
+        # 1. Reuse: completed local task (reference peertask_reuse.go:50).
+        reused = self.storage.find_completed_task(task_id)
+        if reused is not None:
+            log.info("reusing completed task", task_id=task_id[:16])
+            reused.store_to(req.output)
+            yield FileTaskProgress(
+                state="done",
+                task_id=task_id,
+                peer_id=peer_id,
+                content_length=reused.metadata.content_length,
+                completed_length=reused.metadata.content_length,
+                piece_count=len(reused.metadata.pieces),
+                total_piece_count=reused.metadata.total_piece_count,
+                digest=reused.metadata.digest,
+                from_reuse=True,
+            )
+            return
+
+        store = self.storage.register_task(
+            TaskStoreMetadata(
+                task_id=task_id,
+                peer_id=peer_id,
+                url=req.url,
+                tag=req.meta.tag,
+                application=req.meta.application,
+                header=dict(req.meta.header),
+            )
+        )
+
+        # 2. P2P via scheduler when wired (stage 3 conductor), else origin.
+        use_p2p = self.scheduler_client is not None and self.conductor_factory is not None
+        progress_q = _ProgressAggregator(task_id, peer_id, store)
+        store.pin()  # GC must not reclaim the store mid-download
+        try:
+            if use_p2p:
+                conductor = self.conductor_factory(
+                    task_id=task_id, peer_id=peer_id, request=req, store=store,
+                    on_piece=progress_q.on_piece,
+                )
+                async for p in self._run_with_progress(conductor.run(), progress_q):
+                    yield p
+            else:
+                if req.disable_back_source:
+                    raise DfError(Code.ClientBackSourceError,
+                                  "no scheduler and back-to-source disabled")
+                coro = self.piece_manager.download_source(
+                    store, req.url, req.meta.header,
+                    content_range=req.range,
+                    on_piece=progress_q.on_piece,
+                    limiter=self.limiter,
+                )
+                async for p in self._run_with_progress(coro, progress_q):
+                    yield p
+            # 3. Verify + land output (inside the same failure envelope: a
+            # digest mismatch must invalidate the store like any other error).
+            if req.meta.digest:
+                store.validate_digest(req.meta.digest)
+                store.metadata.digest = req.meta.digest
+            store.mark_done()
+            store.store_to(req.output)
+        except DfError as e:
+            store.mark_invalid()
+            yield FileTaskProgress(state="failed", task_id=task_id, peer_id=peer_id,
+                                   error=e.to_wire())
+            return
+        except Exception as e:  # pragma: no cover - defensive
+            log.error("file task crashed", exc_info=True)
+            store.mark_invalid()
+            yield FileTaskProgress(state="failed", task_id=task_id, peer_id=peer_id,
+                                   error=DfError(Code.UnknownError, str(e)).to_wire())
+            return
+        finally:
+            store.unpin()
+
+        yield FileTaskProgress(
+            state="done",
+            task_id=task_id,
+            peer_id=peer_id,
+            content_length=store.metadata.content_length,
+            completed_length=store.downloaded_bytes(),
+            piece_count=len(store.metadata.pieces),
+            total_piece_count=store.metadata.total_piece_count,
+            digest=store.metadata.digest,
+            from_p2p=use_p2p,
+        )
+
+    async def _run_with_progress(self, coro, progress_q: "_ProgressAggregator"):
+        """Run the download while yielding progress snapshots as pieces land."""
+        import asyncio
+
+        task = asyncio.ensure_future(coro)
+        try:
+            while True:
+                snap = await progress_q.next_or_done(task)
+                if snap is not None:
+                    yield snap
+                if task.done():
+                    task.result()  # re-raise
+                    # drain any trailing progress
+                    while (s := progress_q.try_next()) is not None:
+                        yield s
+                    return
+        finally:
+            if not task.done():
+                task.cancel()
+
+
+class _ProgressAggregator:
+    def __init__(self, task_id: str, peer_id: str, store):
+        import asyncio
+
+        self.task_id = task_id
+        self.peer_id = peer_id
+        self.store = store
+        self._event = asyncio.Event()
+        self._last_report = 0.0
+
+    async def on_piece(self, store, rec) -> None:
+        self._event.set()
+
+    def _snapshot(self) -> FileTaskProgress:
+        m = self.store.metadata
+        return FileTaskProgress(
+            state="running",
+            task_id=self.task_id,
+            peer_id=self.peer_id,
+            content_length=m.content_length,
+            completed_length=self.store.downloaded_bytes(),
+            piece_count=len(m.pieces),
+            total_piece_count=m.total_piece_count,
+        )
+
+    def try_next(self) -> FileTaskProgress | None:
+        if self._event.is_set():
+            self._event.clear()
+            now = time.monotonic()
+            if now - self._last_report >= 0.1:  # throttle progress frames
+                self._last_report = now
+                return self._snapshot()
+        return None
+
+    async def next_or_done(self, task) -> FileTaskProgress | None:
+        import asyncio
+
+        waiter = asyncio.ensure_future(self._event.wait())
+        try:
+            await asyncio.wait({waiter, task}, return_when=asyncio.FIRST_COMPLETED)
+        finally:
+            waiter.cancel()
+        return self.try_next()

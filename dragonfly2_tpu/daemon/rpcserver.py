@@ -1,0 +1,88 @@
+"""Daemon drpc server: download service (unix sock) + peer service (TCP).
+
+Reference: client/daemon/rpcserver/rpcserver.go — Download streaming file
+task (:388), SyncPieceTasks serving children (:277), GetPieceTasks (:160),
+StatTask/DeleteTask (:847+). The download service faces dfget on the local
+host; the peer service faces other daemons (stage 3).
+"""
+
+from __future__ import annotations
+
+from dragonfly2_tpu.daemon.peer.task_manager import FileTaskRequest, TaskManager
+from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg.errors import Code, DfError
+from dragonfly2_tpu.pkg.piece import Range
+from dragonfly2_tpu.pkg.types import NetAddr
+from dragonfly2_tpu.proto.common import UrlMeta
+from dragonfly2_tpu.rpc import RpcContext, Server, ServerStream
+
+log = dflog.get("daemon.rpcserver")
+
+
+class DaemonRpcServer:
+    def __init__(self, task_manager: TaskManager):
+        self.task_manager = task_manager
+        self.download_server = Server("daemon.download")
+        self.peer_server = Server("daemon.peer")
+        self._register()
+
+    def _register(self) -> None:
+        self.download_server.register_stream("Daemon.Download", self._download)
+        self.download_server.register_unary("Daemon.StatTask", self._stat_task)
+        self.download_server.register_unary("Daemon.DeleteTask", self._delete_task)
+        self.download_server.register_unary("Daemon.Health", self._health)
+
+    async def serve_download(self, addr: NetAddr) -> None:
+        await self.download_server.serve(addr)
+
+    async def serve_peer(self, addr: NetAddr) -> None:
+        await self.peer_server.serve(addr)
+
+    async def close(self) -> None:
+        await self.download_server.close()
+        await self.peer_server.close()
+
+    # -- handlers ----------------------------------------------------------
+
+    async def _download(self, stream: ServerStream, ctx: RpcContext) -> None:
+        """One file download; progress frames stream back to dfget
+        (reference rpcserver.go:388 Download → :740 download)."""
+        body = stream.open_body or {}
+        url = body.get("url", "")
+        output = body.get("output", "")
+        if not url or not output:
+            raise DfError(Code.BadRequest, "url and output are required")
+        req = FileTaskRequest(
+            url=url,
+            output=output,
+            meta=UrlMeta.from_wire(body.get("meta")),
+            disable_back_source=body.get("disable_back_source", False),
+        )
+        if req.meta.range:
+            req.range = Range.parse_http(req.meta.range)
+        async for progress in self.task_manager.start_file_task(req):
+            await stream.send(progress.to_wire())
+
+    async def _stat_task(self, body, ctx: RpcContext):
+        """Local task presence/completeness (reference rpcserver.go:847)."""
+        task_id = (body or {}).get("task_id", "")
+        store = self.task_manager.storage.try_get(task_id)
+        if store is None:
+            raise DfError(Code.PeerTaskNotFound, f"task {task_id} not found")
+        m = store.metadata
+        return {
+            "task_id": m.task_id,
+            "done": m.done,
+            "content_length": m.content_length,
+            "piece_count": len(m.pieces),
+            "total_piece_count": m.total_piece_count,
+            "digest": m.digest,
+        }
+
+    async def _delete_task(self, body, ctx: RpcContext):
+        task_id = (body or {}).get("task_id", "")
+        self.task_manager.storage.delete_task(task_id)
+        return {"ok": True}
+
+    async def _health(self, body, ctx: RpcContext):
+        return {"ok": True, "version": "0.1.0"}

@@ -29,6 +29,7 @@ class Limiter:
         self._tokens = float(self._burst)
         self._last = time.monotonic()
         self._lock = asyncio.Lock()
+        self._resume: asyncio.Event | None = None  # waiters parked on limit<=0
 
     @property
     def limit(self) -> float:
@@ -39,10 +40,13 @@ class Limiter:
         self._advance()
         self._limit = limit
         if burst is not None:
-            self._burst = burst
+            self._burst = max(1, burst)
         elif limit != INF:
             self._burst = max(int(limit), 1)
         self._tokens = min(self._tokens, float(self._burst))
+        if limit > 0 and self._resume is not None:
+            self._resume.set()  # wake waiters parked by a zero limit
+            self._resume = None
 
     def _advance(self) -> None:
         now = time.monotonic()
@@ -63,10 +67,12 @@ class Limiter:
         """Block until ``n`` tokens are available; returns seconds waited."""
         if self._limit == INF:
             return 0.0
-        if self._limit <= 0:
-            # x/time/rate semantics: limit 0 blocks until cancelled (the
-            # traffic shaper uses this to pause a task).
-            await asyncio.Event().wait()
+        while self._limit <= 0:
+            # Limit 0 pauses the transfer; a later set_limit(>0) resumes it
+            # (the traffic shaper uses this to pause/resume tasks).
+            if self._resume is None:
+                self._resume = asyncio.Event()
+            await self._resume.wait()
         if n > self._burst:
             # A single request larger than the bucket: pay for it across
             # multiple bucket fills rather than deadlocking.

@@ -1,0 +1,7 @@
+"""Message schemas for drpc surfaces.
+
+Modeled on the reference's v2 protobuf API (d7y.io/api/v2: commonv2,
+schedulerv2, dfdaemonv2) — typed request/response dataclasses with explicit
+wire dicts. The v2 shape (AnnouncePeer stream dispatching on typed requests)
+was chosen over v1's PeerPacket per SURVEY.md §7.1.
+"""

@@ -1,0 +1,82 @@
+"""Common wire types shared by all surfaces (reference: commonv1/commonv2)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from dragonfly2_tpu.pkg.types import Priority, TaskType
+
+
+@dataclass
+class UrlMeta:
+    """Metadata distinguishing task identity and fetch behavior
+    (reference commonv1.UrlMeta)."""
+
+    digest: str = ""                   # expected content digest "sha256:..."
+    tag: str = ""                      # task isolation tag
+    range: str = ""                    # HTTP range within the URL content
+    filter: str = ""                   # '&'-separated query params to ignore
+    header: dict[str, str] = field(default_factory=dict)
+    application: str = ""
+    priority: int = int(Priority.LEVEL3)
+
+    def to_wire(self) -> dict[str, Any]:
+        return {
+            "digest": self.digest,
+            "tag": self.tag,
+            "range": self.range,
+            "filter": self.filter,
+            "header": self.header,
+            "application": self.application,
+            "priority": self.priority,
+        }
+
+    @classmethod
+    def from_wire(cls, d: dict[str, Any] | None) -> "UrlMeta":
+        d = d or {}
+        return cls(
+            digest=d.get("digest", ""),
+            tag=d.get("tag", ""),
+            range=d.get("range", ""),
+            filter=d.get("filter", ""),
+            header=d.get("header", {}) or {},
+            application=d.get("application", ""),
+            priority=d.get("priority", int(Priority.LEVEL3)),
+        )
+
+
+@dataclass
+class TaskMetadata:
+    """Resolved task facts, set once the origin/first piece is known."""
+
+    task_id: str
+    url: str = ""
+    content_length: int = -1
+    piece_size: int = 0
+    total_piece_count: int = -1
+    digest: str = ""
+    task_type: int = int(TaskType.STANDARD)
+
+    def to_wire(self) -> dict[str, Any]:
+        return {
+            "task_id": self.task_id,
+            "url": self.url,
+            "content_length": self.content_length,
+            "piece_size": self.piece_size,
+            "total_piece_count": self.total_piece_count,
+            "digest": self.digest,
+            "task_type": self.task_type,
+        }
+
+    @classmethod
+    def from_wire(cls, d: dict[str, Any]) -> "TaskMetadata":
+        return cls(
+            task_id=d["task_id"],
+            url=d.get("url", ""),
+            content_length=d.get("content_length", -1),
+            piece_size=d.get("piece_size", 0),
+            total_piece_count=d.get("total_piece_count", -1),
+            digest=d.get("digest", ""),
+            task_type=d.get("task_type", int(TaskType.STANDARD)),
+        )

@@ -1,0 +1,30 @@
+"""Pluggable origin clients keyed by URL scheme.
+
+Reference: pkg/source/source_client.go:156-222 (ResourceClient interface +
+registry) and pkg/source/clients/ (http, hdfs, oss, s3, oras). Clients here:
+http(s) via aiohttp, file:// for hermetic tests and local imports, gcs://
+(gated on google-cloud-storage availability; the TPU target's primary
+origin), s3-compatible via a minimal signed client (gated).
+"""
+
+from dragonfly2_tpu.source.client import (
+    ListEntry,
+    Registry,
+    Request,
+    ResourceClient,
+    Response,
+    default_registry,
+    get_client,
+    register_client,
+)
+
+__all__ = [
+    "ListEntry",
+    "Registry",
+    "Request",
+    "ResourceClient",
+    "Response",
+    "default_registry",
+    "get_client",
+    "register_client",
+]

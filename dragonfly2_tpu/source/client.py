@@ -1,0 +1,180 @@
+"""ResourceClient interface + scheme registry.
+
+Reference: pkg/source/source_client.go — ResourceClient
+(Download/GetContentLength/IsSupportRange/GetLastModified), request/response
+envelopes with header plumbing, and the scheme-keyed registry; metadata
+listing for recursive downloads (pkg/source/list_metadata.go).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import AsyncIterator
+from urllib.parse import urlsplit
+
+from dragonfly2_tpu.pkg.errors import Code, SourceError
+
+UNKNOWN_SOURCE_FILE_LEN = -2
+
+
+@dataclass
+class Request:
+    """Origin request envelope (reference pkg/source/request.go)."""
+
+    url: str
+    header: dict[str, str] = field(default_factory=dict)
+    timeout: float = 300.0
+
+    @property
+    def scheme(self) -> str:
+        return urlsplit(self.url).scheme.lower()
+
+    def with_range(self, http_range: str) -> "Request":
+        h = dict(self.header)
+        h["Range"] = http_range
+        return Request(self.url, h, self.timeout)
+
+
+@dataclass
+class ListEntry:
+    """One entry from a recursive metadata listing
+    (reference pkg/source/list_metadata.go)."""
+
+    url: str
+    name: str
+    is_dir: bool
+    content_length: int = -1
+
+
+class Response:
+    """Origin response: async body stream + metadata
+    (reference pkg/source/response.go)."""
+
+    def __init__(
+        self,
+        body: AsyncIterator[bytes],
+        *,
+        status: int = 200,
+        content_length: int = -1,
+        headers: dict[str, str] | None = None,
+        support_range: bool = False,
+        last_modified: str = "",
+        close=None,
+    ):
+        self.body = body
+        self.status = status
+        self.content_length = content_length
+        self.headers = headers or {}
+        self.support_range = support_range
+        self.last_modified = last_modified
+        self._close = close
+
+    async def close(self) -> None:
+        if self._close is not None:
+            await self._close()
+
+    async def read_all(self) -> bytes:
+        chunks = []
+        async for c in self.body:
+            chunks.append(c)
+        await self.close()
+        return b"".join(chunks)
+
+
+class ResourceClient(abc.ABC):
+    """One origin protocol (reference source_client.go ResourceClient)."""
+
+    @abc.abstractmethod
+    async def download(self, request: Request) -> Response:
+        """Open the content stream. Honors request.header['Range']."""
+
+    @abc.abstractmethod
+    async def get_content_length(self, request: Request) -> int:
+        """Content length, or UNKNOWN_SOURCE_FILE_LEN when undeterminable."""
+
+    @abc.abstractmethod
+    async def is_support_range(self, request: Request) -> bool:
+        """Whether the origin honors byte ranges (enables concurrent
+        back-to-source piece groups)."""
+
+    async def get_last_modified(self, request: Request) -> str:
+        return ""
+
+    async def probe(self, request: Request) -> tuple[int, bool]:
+        """(content_length, support_range) in as few origin round-trips as
+        the protocol allows. Default: two calls; protocol clients override
+        with a single-request probe."""
+        length = await self.get_content_length(request)
+        support = await self.is_support_range(request)
+        return length, support
+
+    async def list_metadata(self, request: Request) -> list[ListEntry]:
+        """Directory listing for recursive downloads; optional."""
+        raise SourceError(f"{self.__class__.__name__} does not support listing",
+                          Code.UnsupportedProtocol)
+
+
+class Registry:
+    def __init__(self):
+        self._clients: dict[str, ResourceClient] = {}
+
+    def register(self, scheme: str, client: ResourceClient) -> None:
+        self._clients[scheme.lower()] = client
+
+    def unregister(self, scheme: str) -> None:
+        self._clients.pop(scheme.lower(), None)
+
+    def get(self, url_or_scheme: str) -> ResourceClient:
+        scheme = url_or_scheme
+        if "://" in url_or_scheme or ":" in url_or_scheme and "/" in url_or_scheme:
+            scheme = urlsplit(url_or_scheme).scheme
+        client = self._clients.get(scheme.lower())
+        if client is None:
+            raise SourceError(f"no source client for scheme {scheme!r}", Code.UnsupportedProtocol)
+        return client
+
+    def schemes(self) -> list[str]:
+        return sorted(self._clients)
+
+
+_default = Registry()
+
+
+def default_registry() -> Registry:
+    _ensure_builtin_clients()
+    return _default
+
+
+def register_client(scheme: str, client: ResourceClient) -> None:
+    _default.register(scheme, client)
+
+
+def get_client(url_or_scheme: str) -> ResourceClient:
+    return default_registry().get(url_or_scheme)
+
+
+_builtin_loaded = False
+
+
+def _ensure_builtin_clients() -> None:
+    global _builtin_loaded
+    if _builtin_loaded:
+        return
+    _builtin_loaded = True
+    from dragonfly2_tpu.source.clients.http import HTTPSourceClient
+    from dragonfly2_tpu.source.clients.file import FileSourceClient
+
+    if "http" not in _default._clients:
+        http = HTTPSourceClient()
+        _default.register("http", http)
+        _default.register("https", http)
+    if "file" not in _default._clients:
+        _default.register("file", FileSourceClient())
+    try:
+        from dragonfly2_tpu.source.clients.gcs import GCSSourceClient
+
+        if GCSSourceClient.available() and "gs" not in _default._clients:
+            _default.register("gs", GCSSourceClient())
+    except Exception:
+        pass

@@ -1,0 +1,1 @@
+"""Concrete origin clients (reference: pkg/source/clients/)."""

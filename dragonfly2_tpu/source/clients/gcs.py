@@ -1,0 +1,201 @@
+"""gs:// origin client — the TPU target's primary back-to-source origin.
+
+The reference has NO GCS client (pkg/objectstorage has only s3/oss/obs —
+SURVEY.md §2.4); this is the first TPU-specific addition. Implemented over
+the GCS JSON/XML API via aiohttp with metadata-server token auth, so it
+works on any GCP VM (incl. TPU VMs) without extra SDKs. Gated: if no
+credentials are reachable the client reports unavailable and the scheme is
+simply not registered.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import AsyncIterator
+from urllib.parse import quote, urlsplit
+
+import aiohttp
+
+from dragonfly2_tpu.pkg.errors import Code, SourceError
+from dragonfly2_tpu.source.client import ListEntry, Request, ResourceClient, Response
+
+METADATA_TOKEN_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/instance/service-accounts/default/token"
+)
+CHUNK = 1 << 20
+
+
+def _parse_gs_url(url: str) -> tuple[str, str]:
+    parts = urlsplit(url)
+    if parts.scheme != "gs":
+        raise SourceError(f"not a gs url: {url}", Code.UnsupportedProtocol)
+    return parts.netloc, parts.path.lstrip("/")
+
+
+class GCSSourceClient(ResourceClient):
+    """GCS over JSON API: objects.get with alt=media, Range passthrough."""
+
+    def __init__(self, endpoint: str = "https://storage.googleapis.com"):
+        self._endpoint = os.environ.get("DF_GCS_ENDPOINT", endpoint)
+        self._session: aiohttp.ClientSession | None = None
+        self._session_loop = None
+        self._token: str | None = None
+        self._token_expiry = 0.0
+
+    @staticmethod
+    def available() -> bool:
+        """Availability gate: explicit opt-in (fake endpoint / anonymous) or
+        a GCP metadata server within reach."""
+        if os.environ.get("DF_GCS_ENDPOINT") or os.environ.get("DF_GCS_ANONYMOUS"):
+            return True
+        return os.environ.get("DF_ON_GCP", "") == "1"
+
+    async def _sess(self) -> aiohttp.ClientSession:
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        if self._session is None or self._session.closed or self._session_loop is not loop:
+            self._session = aiohttp.ClientSession()
+            self._session_loop = loop
+        return self._session
+
+    async def _auth_header(self) -> dict[str, str]:
+        if os.environ.get("DF_GCS_ANONYMOUS"):
+            return {}
+        now = time.monotonic()
+        if self._token is None or now >= self._token_expiry:
+            sess = await self._sess()
+            try:
+                async with sess.get(
+                    METADATA_TOKEN_URL,
+                    headers={"Metadata-Flavor": "Google"},
+                    timeout=aiohttp.ClientTimeout(total=5),
+                ) as resp:
+                    if resp.status != 200:
+                        raise SourceError("gcs: metadata token fetch failed",
+                                          Code.SourceForbidden)
+                    tok = json.loads(await resp.text())
+                    self._token = tok["access_token"]
+                    self._token_expiry = now + max(60, tok.get("expires_in", 300) - 60)
+            except aiohttp.ClientError as e:
+                raise SourceError(f"gcs: no credentials: {e}", Code.SourceForbidden)
+        return {"Authorization": f"Bearer {self._token}"}
+
+    def _media_url(self, bucket: str, obj: str) -> str:
+        return f"{self._endpoint}/storage/v1/b/{quote(bucket, safe='')}/o/{quote(obj, safe='')}?alt=media"
+
+    async def download(self, request: Request) -> Response:
+        bucket, obj = _parse_gs_url(request.url)
+        sess = await self._sess()
+        headers = await self._auth_header()
+        if "Range" in request.header:
+            headers["Range"] = request.header["Range"]
+        try:
+            resp = await sess.get(self._media_url(bucket, obj), headers=headers,
+                                  timeout=aiohttp.ClientTimeout(total=request.timeout))
+        except aiohttp.ClientError as e:
+            raise SourceError(f"gcs connect {request.url}: {e}",
+                              Code.BackToSourceAborted, temporary=True)
+        if resp.status == 404:
+            resp.release()
+            raise SourceError(f"gcs object not found: {request.url}", Code.SourceNotFound)
+        if resp.status in (401, 403):
+            resp.release()
+            raise SourceError(f"gcs access denied: {request.url}", Code.SourceForbidden)
+        if resp.status >= 400:
+            status = resp.status
+            resp.release()
+            raise SourceError(f"gcs {status}: {request.url}", Code.BackToSourceAborted,
+                              temporary=status >= 500)
+
+        async def body() -> AsyncIterator[bytes]:
+            async for chunk in resp.content.iter_chunked(CHUNK):
+                yield chunk
+
+        async def close():
+            resp.release()
+
+        cl = resp.headers.get("Content-Length")
+        return Response(
+            body(),
+            status=resp.status,
+            content_length=int(cl) if cl is not None else -1,
+            headers=dict(resp.headers),
+            support_range=True,  # GCS always honors ranges on media downloads
+            last_modified=resp.headers.get("Last-Modified", ""),
+            close=close,
+        )
+
+    async def _stat(self, bucket: str, obj: str, timeout: float) -> dict:
+        sess = await self._sess()
+        headers = await self._auth_header()
+        url = f"{self._endpoint}/storage/v1/b/{quote(bucket, safe='')}/o/{quote(obj, safe='')}"
+        async with sess.get(url, headers=headers,
+                            timeout=aiohttp.ClientTimeout(total=timeout)) as resp:
+            if resp.status == 404:
+                raise SourceError(f"gcs object not found: gs://{bucket}/{obj}", Code.SourceNotFound)
+            if resp.status >= 400:
+                raise SourceError(f"gcs stat {resp.status}: gs://{bucket}/{obj}",
+                                  Code.BackToSourceAborted, temporary=resp.status >= 500)
+            return json.loads(await resp.text())
+
+    async def get_content_length(self, request: Request) -> int:
+        bucket, obj = _parse_gs_url(request.url)
+        meta = await self._stat(bucket, obj, min(request.timeout, 30))
+        return int(meta.get("size", -1))
+
+    async def is_support_range(self, request: Request) -> bool:
+        return True
+
+    async def get_last_modified(self, request: Request) -> str:
+        bucket, obj = _parse_gs_url(request.url)
+        try:
+            meta = await self._stat(bucket, obj, min(request.timeout, 30))
+            return meta.get("updated", "")
+        except SourceError:
+            return ""
+
+    async def list_metadata(self, request: Request) -> list[ListEntry]:
+        """List objects under a gs://bucket/prefix (sharded checkpoints:
+        one entry per shard file)."""
+        bucket, prefix = _parse_gs_url(request.url)
+        sess = await self._sess()
+        headers = await self._auth_header()
+        entries: list[ListEntry] = []
+        page_token = ""
+        while True:
+            url = (f"{self._endpoint}/storage/v1/b/{quote(bucket, safe='')}/o"
+                   f"?prefix={quote(prefix, safe='')}&maxResults=1000")
+            if page_token:
+                url += f"&pageToken={quote(page_token, safe='')}"
+            async with sess.get(url, headers=headers,
+                                timeout=aiohttp.ClientTimeout(total=60)) as resp:
+                if resp.status >= 400:
+                    raise SourceError(f"gcs list {resp.status}: {request.url}",
+                                      Code.BackToSourceAborted, temporary=resp.status >= 500)
+                data = json.loads(await resp.text())
+            for item in data.get("items", []):
+                # Name is the path RELATIVE to the prefix so nested shards
+                # (ckpt/layer0/w.bin vs ckpt/layer1/w.bin) keep their
+                # subpaths on recursive download instead of clobbering.
+                rel = item["name"]
+                if prefix and rel.startswith(prefix):
+                    rel = rel[len(prefix):].lstrip("/")
+                entries.append(
+                    ListEntry(
+                        url=f"gs://{bucket}/{item['name']}",
+                        name=rel or item["name"].rsplit("/", 1)[-1],
+                        is_dir=False,
+                        content_length=int(item.get("size", -1)),
+                    )
+                )
+            page_token = data.get("nextPageToken", "")
+            if not page_token:
+                break
+        return entries
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()

@@ -1,0 +1,177 @@
+"""HTTP(S) origin client.
+
+Reference: pkg/source/clients/httpprotocol/http_source_client.go (294 LoC):
+range probing via a 1-byte Range GET, content-length via HEAD-with-GET
+fallback, header passthrough, status mapping to coded errors.
+"""
+
+from __future__ import annotations
+
+import html.parser
+from typing import AsyncIterator
+from urllib.parse import urljoin, urlsplit
+
+import aiohttp
+
+from dragonfly2_tpu.pkg.errors import Code, SourceError
+from dragonfly2_tpu.source.client import (
+    UNKNOWN_SOURCE_FILE_LEN,
+    ListEntry,
+    Request,
+    ResourceClient,
+    Response,
+)
+
+CHUNK = 1 << 20
+
+
+def _status_error(status: int, url: str) -> SourceError:
+    if status == 404:
+        return SourceError(f"origin 404: {url}", Code.SourceNotFound)
+    if status in (401, 403):
+        return SourceError(f"origin {status}: {url}", Code.SourceForbidden)
+    temporary = status in (408, 429, 500, 502, 503, 504)
+    return SourceError(f"origin {status}: {url}", Code.BackToSourceAborted, temporary=temporary)
+
+
+class HTTPSourceClient(ResourceClient):
+    def __init__(self, session: aiohttp.ClientSession | None = None):
+        self._session = session
+        self._session_loop = None
+
+    async def _sess(self) -> aiohttp.ClientSession:
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        # Sessions are bound to an event loop; a registry-cached client must
+        # rebuild when called from a fresh loop (daemon restarts, tests).
+        if self._session is None or self._session.closed or self._session_loop is not loop:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=None, sock_connect=10, sock_read=60)
+            )
+            self._session_loop = loop
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def download(self, request: Request) -> Response:
+        sess = await self._sess()
+        try:
+            resp = await sess.get(request.url, headers=request.header,
+                                  timeout=aiohttp.ClientTimeout(total=request.timeout))
+        except aiohttp.ClientError as e:
+            raise SourceError(f"origin connect {request.url}: {e}",
+                              Code.BackToSourceAborted, temporary=True)
+        if resp.status >= 400:
+            status = resp.status
+            resp.release()
+            raise _status_error(status, request.url)
+
+        async def body() -> AsyncIterator[bytes]:
+            try:
+                async for chunk in resp.content.iter_chunked(CHUNK):
+                    yield chunk
+            except aiohttp.ClientError as e:
+                raise SourceError(f"origin read {request.url}: {e}",
+                                  Code.BackToSourceAborted, temporary=True)
+
+        # content_length is the stream length (for 206, the range size — the
+        # caller asked for exactly that many bytes).
+        content_length = -1
+        if resp.headers.get("Content-Length") is not None and "Content-Encoding" not in resp.headers:
+            content_length = int(resp.headers["Content-Length"])
+
+        async def close():
+            resp.release()
+
+        return Response(
+            body(),
+            status=resp.status,
+            content_length=content_length,
+            headers=dict(resp.headers),
+            support_range=resp.status == 206 or resp.headers.get("Accept-Ranges") == "bytes",
+            last_modified=resp.headers.get("Last-Modified", ""),
+            close=close,
+        )
+
+    async def probe(self, request: Request) -> tuple[int, bool]:
+        """Single 1-byte-range GET answering both content length and range
+        support — HEAD is frequently mis-served (reference
+        http_source_client.go probes with ranged GETs)."""
+        sess = await self._sess()
+        try:
+            async with sess.get(
+                request.url, headers={**request.header, "Range": "bytes=0-0"},
+                timeout=aiohttp.ClientTimeout(total=30),
+            ) as resp:
+                if resp.status == 206:
+                    cr = resp.headers.get("Content-Range", "")
+                    if "/" in cr:
+                        total = cr.rsplit("/", 1)[1]
+                        if total != "*":
+                            return int(total), True
+                    return UNKNOWN_SOURCE_FILE_LEN, True
+                if resp.status == 200:
+                    cl = resp.headers.get("Content-Length")
+                    if cl is not None and "Content-Encoding" not in resp.headers:
+                        return int(cl), False
+                    return UNKNOWN_SOURCE_FILE_LEN, False
+                if resp.status >= 400:
+                    raise _status_error(resp.status, request.url)
+        except aiohttp.ClientError as e:
+            raise SourceError(f"origin probe {request.url}: {e}",
+                              Code.BackToSourceAborted, temporary=True)
+        return UNKNOWN_SOURCE_FILE_LEN, False
+
+    async def get_content_length(self, request: Request) -> int:
+        length, _ = await self.probe(request)
+        return length
+
+    async def is_support_range(self, request: Request) -> bool:
+        _, support = await self.probe(request)
+        return support
+
+    async def get_last_modified(self, request: Request) -> str:
+        sess = await self._sess()
+        try:
+            async with sess.head(request.url, headers=request.header,
+                                 timeout=aiohttp.ClientTimeout(total=30)) as resp:
+                return resp.headers.get("Last-Modified", "")
+        except aiohttp.ClientError:
+            return ""
+
+    async def list_metadata(self, request: Request) -> list[ListEntry]:
+        """Parse hrefs from an HTML index page (recursive dfget downloads —
+        reference client/dfget recursive URL-listing path)."""
+        sess = await self._sess()
+        async with sess.get(request.url, headers=request.header,
+                            timeout=aiohttp.ClientTimeout(total=60)) as resp:
+            if resp.status >= 400:
+                raise _status_error(resp.status, request.url)
+            text = await resp.text()
+
+        class _HrefParser(html.parser.HTMLParser):
+            def __init__(self):
+                super().__init__()
+                self.hrefs: list[str] = []
+
+            def handle_starttag(self, tag, attrs):
+                if tag == "a":
+                    for k, v in attrs:
+                        if k == "href" and v and not v.startswith(("?", "#", "../")):
+                            self.hrefs.append(v)
+
+        p = _HrefParser()
+        p.feed(text)
+        base = request.url if request.url.endswith("/") else request.url + "/"
+        entries = []
+        for href in p.hrefs:
+            absolute = urljoin(base, href)
+            # Only descend, never escape the base path.
+            if not absolute.startswith(base):
+                continue
+            name = urlsplit(absolute).path.rstrip("/").rsplit("/", 1)[-1]
+            entries.append(ListEntry(url=absolute, name=name, is_dir=absolute.endswith("/")))
+        return entries

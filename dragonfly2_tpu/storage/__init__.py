@@ -1,0 +1,18 @@
+"""Local piece stores with persistent metadata.
+
+Reference: client/daemon/storage — localTaskStore dirs holding ``data`` +
+``metadata`` files, piece-level write/read with digest validation, hardlink
+/copy Store-to-output, disk-quota GC by TTL+LRU, persistence across daemon
+restarts (storage_manager.go:703 ReloadPersistentTask).
+"""
+
+from dragonfly2_tpu.storage.local_store import LocalTaskStore, PieceRecord, TaskStoreMetadata
+from dragonfly2_tpu.storage.manager import StorageManager, StorageOption
+
+__all__ = [
+    "LocalTaskStore",
+    "PieceRecord",
+    "TaskStoreMetadata",
+    "StorageManager",
+    "StorageOption",
+]

@@ -1,0 +1,310 @@
+"""One task's on-disk store: a ``data`` file plus ``metadata.json``.
+
+Reference: client/daemon/storage/local_storage.go — WritePiece with MD5
+(:102-196), ReadPiece (:283), digest validation (:247), hardlink/copy
+Store-to-output (:353), GetPieces listing for upload (:434), metadata
+persistence (:647 saveMetadata). Piece ``n`` lives at byte offset
+``n * piece_size`` in ``data``; unknown-length downloads extend the file as
+pieces arrive in order.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from dataclasses import asdict, dataclass, field
+
+from dragonfly2_tpu.pkg import digest as pkgdigest
+from dragonfly2_tpu.pkg.errors import Code, StorageError
+from dragonfly2_tpu.pkg.piece import compute_piece_count
+
+DATA_FILE = "data"
+METADATA_FILE = "metadata.json"
+
+
+@dataclass
+class PieceRecord:
+    num: int
+    offset: int
+    size: int
+    digest: str = ""      # "md5:..." per-piece digest
+    cost_ms: int = 0
+
+    def to_wire(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "PieceRecord":
+        return cls(num=d["num"], offset=d["offset"], size=d["size"],
+                   digest=d.get("digest", ""), cost_ms=d.get("cost_ms", 0))
+
+
+@dataclass
+class TaskStoreMetadata:
+    task_id: str
+    peer_id: str = ""
+    url: str = ""
+    tag: str = ""
+    application: str = ""
+    content_length: int = -1
+    piece_size: int = 0
+    total_piece_count: int = -1
+    digest: str = ""                  # whole-content digest once verified
+    header: dict = field(default_factory=dict)
+    done: bool = False
+    invalid: bool = False
+    pieces: dict[int, PieceRecord] = field(default_factory=dict)
+    created_at: float = field(default_factory=time.time)
+    last_access: float = field(default_factory=time.time)
+
+    def to_json(self) -> dict:
+        d = asdict(self)
+        d["pieces"] = {str(k): v.to_wire() for k, v in self.pieces.items()}
+        return d
+
+    @classmethod
+    def from_json(cls, d: dict) -> "TaskStoreMetadata":
+        pieces = {int(k): PieceRecord.from_wire(v) for k, v in d.get("pieces", {}).items()}
+        return cls(
+            task_id=d["task_id"],
+            peer_id=d.get("peer_id", ""),
+            url=d.get("url", ""),
+            tag=d.get("tag", ""),
+            application=d.get("application", ""),
+            content_length=d.get("content_length", -1),
+            piece_size=d.get("piece_size", 0),
+            total_piece_count=d.get("total_piece_count", -1),
+            digest=d.get("digest", ""),
+            header=d.get("header", {}) or {},
+            done=d.get("done", False),
+            invalid=d.get("invalid", False),
+            pieces=pieces,
+            created_at=d.get("created_at", time.time()),
+            last_access=d.get("last_access", time.time()),
+        )
+
+
+class LocalTaskStore:
+    """Synchronous piece IO over one data file. Writes go through the page
+    cache (pwrite); metadata saves are atomic (tmp+rename)."""
+
+    def __init__(self, base_dir: str, metadata: TaskStoreMetadata):
+        self.dir = base_dir
+        self.metadata = metadata
+        os.makedirs(self.dir, exist_ok=True)
+        self._data_path = os.path.join(self.dir, DATA_FILE)
+        self._fd: int | None = None
+        self._pins = 0
+
+    # -- pinning: GC must not reclaim a store mid-download/upload ----------
+
+    def pin(self) -> "LocalTaskStore":
+        self._pins += 1
+        return self
+
+    def unpin(self) -> None:
+        self._pins = max(0, self._pins - 1)
+
+    @property
+    def pinned(self) -> bool:
+        return self._pins > 0
+
+    def __enter__(self) -> "LocalTaskStore":
+        return self.pin()
+
+    def __exit__(self, *exc) -> None:
+        self.unpin()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @classmethod
+    def create(cls, base_dir: str, metadata: TaskStoreMetadata) -> "LocalTaskStore":
+        store = cls(base_dir, metadata)
+        store.save_metadata()
+        return store
+
+    @classmethod
+    def load(cls, base_dir: str) -> "LocalTaskStore":
+        meta_path = os.path.join(base_dir, METADATA_FILE)
+        with open(meta_path) as f:
+            metadata = TaskStoreMetadata.from_json(json.load(f))
+        return cls(base_dir, metadata)
+
+    def _ensure_fd(self) -> int:
+        if self._fd is None:
+            self._fd = os.open(self._data_path, os.O_RDWR | os.O_CREAT, 0o644)
+        return self._fd
+
+    def close(self) -> None:
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
+
+    def destroy(self) -> None:
+        self.close()
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+    # -- metadata ----------------------------------------------------------
+
+    def save_metadata(self) -> None:
+        tmp = os.path.join(self.dir, METADATA_FILE + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(self.metadata.to_json(), f)
+        os.replace(tmp, os.path.join(self.dir, METADATA_FILE))
+
+    def touch(self) -> None:
+        self.metadata.last_access = time.time()
+
+    def update_task(self, *, content_length: int | None = None,
+                    total_piece_count: int | None = None,
+                    piece_size: int | None = None,
+                    digest: str | None = None,
+                    header: dict | None = None) -> None:
+        m = self.metadata
+        if content_length is not None and content_length >= 0:
+            m.content_length = content_length
+            if m.piece_size and m.total_piece_count < 0:
+                m.total_piece_count = compute_piece_count(content_length, m.piece_size)
+        if total_piece_count is not None and total_piece_count >= 0:
+            m.total_piece_count = total_piece_count
+        if piece_size is not None and piece_size > 0:
+            m.piece_size = piece_size
+        if digest is not None:
+            m.digest = digest
+        if header is not None:
+            m.header = header
+        self.save_metadata()
+
+    # -- piece IO ----------------------------------------------------------
+
+    def write_piece(self, num: int, data: bytes, expected_digest: str = "",
+                    cost_ms: int = 0) -> PieceRecord:
+        """Write piece ``num``. Verifies the per-piece digest before the
+        write lands (reference local_storage.go:102-196 hashes in-flight)."""
+        m = self.metadata
+        if m.piece_size <= 0:
+            raise StorageError("piece size not set")
+        if expected_digest:
+            d = pkgdigest.parse(expected_digest)
+            actual = pkgdigest.hash_bytes(d.algorithm, data)
+            if actual.encoded != d.encoded:
+                raise StorageError(
+                    f"piece {num} digest mismatch: want {d.encoded}, got {actual.encoded}",
+                    Code.ClientPieceDownloadFail,
+                )
+            digest_str = expected_digest
+        else:
+            digest_str = str(pkgdigest.hash_bytes(pkgdigest.ALGORITHM_MD5, data))
+        offset = num * m.piece_size
+        fd = self._ensure_fd()
+        written = 0
+        while written < len(data):
+            written += os.pwrite(fd, data[written:], offset + written)
+        rec = PieceRecord(num=num, offset=offset, size=len(data), digest=digest_str, cost_ms=cost_ms)
+        existing = m.pieces.get(num)
+        m.pieces[num] = rec
+        self.touch()
+        if existing is None:
+            # Persist piece map incrementally so a daemon restart resumes
+            # from the bitmap (reference: checkpoint/resume of downloads).
+            self.save_metadata()
+        return rec
+
+    def read_piece(self, num: int) -> bytes:
+        rec = self.metadata.pieces.get(num)
+        if rec is None:
+            raise StorageError(f"piece {num} not found", Code.StoragePieceNotFound)
+        fd = self._ensure_fd()
+        out = os.pread(fd, rec.size, rec.offset)
+        if len(out) != rec.size:
+            raise StorageError(f"piece {num} short read {len(out)} != {rec.size}")
+        self.touch()
+        return out
+
+    def read_range(self, offset: int, size: int) -> bytes:
+        fd = self._ensure_fd()
+        return os.pread(fd, size, offset)
+
+    def get_pieces(self, start_num: int = 0, limit: int = 0) -> list[PieceRecord]:
+        """Contiguous-known pieces from start_num (upload-server listing —
+        reference local_storage.go:434 GetPieces)."""
+        out = []
+        nums = sorted(n for n in self.metadata.pieces if n >= start_num)
+        for n in nums:
+            out.append(self.metadata.pieces[n])
+            if limit and len(out) >= limit:
+                break
+        return out
+
+    def has_piece(self, num: int) -> bool:
+        return num in self.metadata.pieces
+
+    def downloaded_bytes(self) -> int:
+        return sum(p.size for p in self.metadata.pieces.values())
+
+    def disk_usage(self) -> int:
+        try:
+            return os.path.getsize(self._data_path)
+        except OSError:
+            return 0
+
+    # -- completion --------------------------------------------------------
+
+    def is_complete(self) -> bool:
+        m = self.metadata
+        return (
+            m.total_piece_count >= 0
+            and len(m.pieces) >= m.total_piece_count
+            and all(n in m.pieces for n in range(m.total_piece_count))
+        )
+
+    def mark_done(self) -> None:
+        self.metadata.done = True
+        self.touch()
+        self.save_metadata()
+
+    def mark_invalid(self) -> None:
+        self.metadata.invalid = True
+        self.save_metadata()
+
+    def validate_digest(self, expected: str = "") -> str:
+        """Whole-content digest over piece ranges in order; checks against
+        ``expected`` (or metadata digest) when present. Returns the actual
+        digest string (reference local_storage.go:247)."""
+        want = expected or self.metadata.digest
+        algorithm = pkgdigest.parse(want).algorithm if want else pkgdigest.ALGORITHM_SHA256
+        h = pkgdigest.new_hasher(algorithm)
+        fd = self._ensure_fd()
+        for n in sorted(self.metadata.pieces):
+            rec = self.metadata.pieces[n]
+            h.update(os.pread(fd, rec.size, rec.offset))
+        actual = f"{algorithm}:{h.hexdigest()}"
+        if want and actual != want:
+            raise StorageError(f"content digest mismatch: want {want}, got {actual}",
+                               Code.ClientPieceDownloadFail)
+        return actual
+
+    def store_to(self, dest: str, *, hardlink: bool = True) -> None:
+        """Land the completed content at ``dest``: hardlink when possible,
+        else copy (reference local_storage.go:353)."""
+        if not self.is_complete():
+            raise StorageError("task incomplete; refusing to store output")
+        dest_dir = os.path.dirname(os.path.abspath(dest))
+        os.makedirs(dest_dir, exist_ok=True)
+        if os.path.exists(dest):
+            os.unlink(dest)
+        # The data file is exactly the content when pieces are contiguous
+        # from offset 0; truncate to content length guards a sparse tail.
+        cl = self.metadata.content_length
+        if cl >= 0 and self.disk_usage() != cl:
+            with open(self._data_path, "r+b") as f:
+                f.truncate(cl)
+        if hardlink:
+            try:
+                os.link(self._data_path, dest)
+                return
+            except OSError:
+                pass
+        shutil.copyfile(self._data_path, dest)

@@ -1,0 +1,441 @@
+"""Source clients, piece-manager back-to-source paths, and the minimum
+end-to-end slice: dfget → daemon (unix drpc) → origin → store → output.
+
+The hermetic origin is an aiohttp server with range support plus a
+no-content-length endpoint (reference test fixtures: file server +
+no-content-length server, hack/install-e2e-test.sh:42-60).
+"""
+
+import asyncio
+import hashlib
+import os
+import random
+
+import pytest
+from aiohttp import web
+
+from dragonfly2_tpu.daemon.config import DaemonConfig
+from dragonfly2_tpu.daemon.daemon import Daemon
+from dragonfly2_tpu.daemon.peer.piece_manager import PieceManager, PieceManagerOption
+from dragonfly2_tpu.pkg import digest as pkgdigest
+from dragonfly2_tpu.pkg.errors import DfError, SourceError
+from dragonfly2_tpu.pkg.piece import Range
+from dragonfly2_tpu.proto.common import UrlMeta
+from dragonfly2_tpu.source import Request as SourceRequest
+from dragonfly2_tpu.source import get_client
+from dragonfly2_tpu.storage import StorageManager, StorageOption, TaskStoreMetadata
+
+CONTENT = bytes(random.Random(42).randbytes(10 * 1024 * 1024))  # 10 MiB deterministic
+SMALL = b"tiny payload"
+
+
+async def start_origin() -> tuple[web.AppRunner, int, dict]:
+    """Hermetic origin: /blob (ranged), /small, /chunked (no content length),
+    /flaky (fails first N requests), and request counting."""
+    stats = {"blob_gets": 0, "flaky_fails_left": 2}
+
+    async def blob(request: web.Request) -> web.StreamResponse:
+        stats["blob_gets"] += 1
+        rng = request.headers.get("Range")
+        if rng:
+            r = Range.parse_http(rng, len(CONTENT))
+            data = CONTENT[r.start : r.start + r.length]
+            resp = web.Response(
+                status=206,
+                body=data,
+                headers={
+                    "Content-Range": f"bytes {r.start}-{r.start + r.length - 1}/{len(CONTENT)}",
+                    "Accept-Ranges": "bytes",
+                },
+            )
+            return resp
+        return web.Response(body=CONTENT, headers={"Accept-Ranges": "bytes"})
+
+    async def small(request: web.Request) -> web.Response:
+        return web.Response(body=SMALL)
+
+    async def chunked(request: web.Request) -> web.StreamResponse:
+        resp = web.StreamResponse()
+        resp.enable_chunked_encoding()
+        await resp.prepare(request)
+        for i in range(0, len(CONTENT) // 2, 1 << 20):
+            await resp.write(CONTENT[i : i + (1 << 20)])
+        await resp.write_eof()
+        return resp
+
+    async def flaky(request: web.Request) -> web.Response:
+        if stats["flaky_fails_left"] > 0:
+            stats["flaky_fails_left"] -= 1
+            return web.Response(status=503)
+        return web.Response(body=SMALL)
+
+    app = web.Application()
+    app.router.add_get("/blob", blob)
+    app.router.add_get("/small", small)
+    app.router.add_get("/chunked", chunked)
+    app.router.add_get("/flaky", flaky)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, port, stats
+
+
+class TestFileSource:
+    def test_download_and_range(self, run_async, tmp_path):
+        p = tmp_path / "f.bin"
+        p.write_bytes(b"0123456789")
+
+        async def body():
+            client = get_client("file:///x")
+            url = f"file://{p}"
+            resp = await client.download(SourceRequest(url))
+            assert await resp.read_all() == b"0123456789"
+            resp = await client.download(SourceRequest(url, {"Range": "bytes=2-5"}))
+            assert await resp.read_all() == b"2345"
+            assert await client.get_content_length(SourceRequest(url)) == 10
+            assert await client.is_support_range(SourceRequest(url))
+
+        run_async(body())
+
+    def test_list_metadata(self, run_async, tmp_path):
+        (tmp_path / "a.txt").write_bytes(b"a")
+        (tmp_path / "sub").mkdir()
+        (tmp_path / "sub" / "b.txt").write_bytes(b"bb")
+
+        async def body():
+            client = get_client("file:///x")
+            entries = await client.list_metadata(SourceRequest(f"file://{tmp_path}"))
+            names = {e.name: e for e in entries}
+            assert names["a.txt"].content_length == 1
+            assert names["sub"].is_dir
+
+        run_async(body())
+
+    def test_missing_file(self, run_async, tmp_path):
+        async def body():
+            client = get_client("file:///x")
+            with pytest.raises(SourceError):
+                await client.download(SourceRequest(f"file://{tmp_path}/nope"))
+
+        run_async(body())
+
+
+class TestHTTPSource:
+    def test_content_length_and_range_probe(self, run_async):
+        async def body():
+            runner, port, _ = await start_origin()
+            try:
+                client = get_client("http://x")
+                url = f"http://127.0.0.1:{port}/blob"
+                assert await client.get_content_length(SourceRequest(url)) == len(CONTENT)
+                assert await client.is_support_range(SourceRequest(url))
+                resp = await client.download(SourceRequest(url, {"Range": "bytes=0-1023"}))
+                data = await resp.read_all()
+                assert data == CONTENT[:1024]
+            finally:
+                await runner.cleanup()
+
+        run_async(body())
+
+    def test_404_maps_to_not_found(self, run_async):
+        async def body():
+            runner, port, _ = await start_origin()
+            try:
+                client = get_client("http://x")
+                with pytest.raises(SourceError) as ei:
+                    await client.download(SourceRequest(f"http://127.0.0.1:{port}/nope"))
+                from dragonfly2_tpu.pkg.errors import Code
+
+                assert ei.value.code == Code.SourceNotFound
+            finally:
+                await runner.cleanup()
+
+        run_async(body())
+
+
+def _store_for(tmp_path, task_id="t1"):
+    sm = StorageManager(StorageOption(data_dir=str(tmp_path / "data")))
+    return sm, sm.register_task(TaskStoreMetadata(task_id=task_id, url="u"))
+
+
+class TestPieceManagerBackSource:
+    def test_known_length_sequential(self, run_async, tmp_path):
+        async def body():
+            runner, port, _ = await start_origin()
+            try:
+                sm, store = _store_for(tmp_path)
+                pm = PieceManager(PieceManagerOption(concurrency=1))
+                pieces_seen = []
+
+                async def on_piece(st, rec):
+                    pieces_seen.append(rec.num)
+
+                await pm.download_source(store, f"http://127.0.0.1:{port}/blob",
+                                         on_piece=on_piece)
+                assert store.is_complete()
+                assert pieces_seen == sorted(pieces_seen)
+                store.mark_done()
+                out = tmp_path / "o.bin"
+                store.store_to(str(out))
+                assert hashlib.sha256(out.read_bytes()).digest() == hashlib.sha256(CONTENT).digest()
+            finally:
+                await runner.cleanup()
+
+        run_async(body())
+
+    def test_concurrent_piece_groups(self, run_async, tmp_path):
+        async def body():
+            runner, port, stats = await start_origin()
+            try:
+                sm, store = _store_for(tmp_path)
+                pm = PieceManager(PieceManagerOption(concurrency=4, concurrent_min_length=1 << 20))
+                await pm.download_source(store, f"http://127.0.0.1:{port}/blob")
+                assert store.is_complete()
+                # exactly 1 combined probe + one stream per piece group
+                # (10 MiB / 4 MiB pieces = 3 groups)
+                assert stats["blob_gets"] == 4
+                store.mark_done()
+                out = tmp_path / "o.bin"
+                store.store_to(str(out))
+                assert out.read_bytes() == CONTENT
+            finally:
+                await runner.cleanup()
+
+        run_async(body())
+
+    def test_unknown_length_streaming(self, run_async, tmp_path):
+        async def body():
+            runner, port, _ = await start_origin()
+            try:
+                sm, store = _store_for(tmp_path)
+                pm = PieceManager()
+                await pm.download_source(store, f"http://127.0.0.1:{port}/chunked")
+                assert store.is_complete()
+                assert store.metadata.content_length == len(CONTENT) // 2
+                store.mark_done()
+                out = tmp_path / "o.bin"
+                store.store_to(str(out))
+                assert out.read_bytes() == CONTENT[: len(CONTENT) // 2]
+            finally:
+                await runner.cleanup()
+
+        run_async(body())
+
+    def test_ranged_task(self, run_async, tmp_path):
+        async def body():
+            runner, port, _ = await start_origin()
+            try:
+                sm, store = _store_for(tmp_path)
+                pm = PieceManager(PieceManagerOption(concurrency=1))
+                await pm.download_source(store, f"http://127.0.0.1:{port}/blob",
+                                         content_range=Range(1024, 4096))
+                assert store.is_complete()
+                store.mark_done()
+                out = tmp_path / "o.bin"
+                store.store_to(str(out))
+                assert out.read_bytes() == CONTENT[1024 : 1024 + 4096]
+            finally:
+                await runner.cleanup()
+
+        run_async(body())
+
+
+class TestE2ESlice:
+    """BASELINE config #1: dfget single-URL download, no P2P."""
+
+    def _daemon_config(self, tmp_path) -> DaemonConfig:
+        cfg = DaemonConfig()
+        cfg.work_home = str(tmp_path / "home")
+        cfg.__post_init__()
+        cfg.download.unix_sock = str(tmp_path / "d.sock")
+        return cfg
+
+    def test_dfget_through_daemon(self, run_async, tmp_path):
+        async def body():
+            runner, port, stats = await start_origin()
+            daemon = Daemon(self._daemon_config(tmp_path))
+            serve = asyncio.ensure_future(daemon.serve())
+            await asyncio.sleep(0.1)
+            try:
+                from dragonfly2_tpu.client import dfget as dfget_lib
+
+                url = f"http://127.0.0.1:{port}/blob"
+                digest = "sha256:" + hashlib.sha256(CONTENT).hexdigest()
+                out = tmp_path / "out.bin"
+                progress = []
+                result = await dfget_lib.download(
+                    dfget_lib.DfgetConfig(
+                        url=url, output=str(out),
+                        daemon_sock=daemon.config.download.unix_sock,
+                        meta=UrlMeta(digest=digest),
+                        allow_source_fallback=False,
+                    ),
+                    on_progress=progress.append,
+                )
+                assert result["state"] == "done"
+                assert out.read_bytes() == CONTENT
+                assert result["content_length"] == len(CONTENT)
+                first_gets = stats["blob_gets"]
+
+                # Second download: served from reuse, origin untouched.
+                out2 = tmp_path / "out2.bin"
+                result2 = await dfget_lib.download(
+                    dfget_lib.DfgetConfig(
+                        url=url, output=str(out2),
+                        daemon_sock=daemon.config.download.unix_sock,
+                        meta=UrlMeta(digest=digest),
+                        allow_source_fallback=False,
+                    ),
+                )
+                assert result2["from_reuse"]
+                assert out2.read_bytes() == CONTENT
+                assert stats["blob_gets"] == first_gets
+            finally:
+                await daemon.stop()
+                serve.cancel()
+                await runner.cleanup()
+
+        run_async(body())
+
+    def test_dfget_digest_mismatch_fails(self, run_async, tmp_path):
+        async def body():
+            runner, port, _ = await start_origin()
+            daemon = Daemon(self._daemon_config(tmp_path))
+            serve = asyncio.ensure_future(daemon.serve())
+            await asyncio.sleep(0.1)
+            try:
+                from dragonfly2_tpu.client import dfget as dfget_lib
+
+                out = tmp_path / "bad.bin"
+                with pytest.raises(DfError):
+                    await dfget_lib.download(
+                        dfget_lib.DfgetConfig(
+                            url=f"http://127.0.0.1:{port}/small", output=str(out),
+                            daemon_sock=daemon.config.download.unix_sock,
+                            meta=UrlMeta(digest="sha256:" + "0" * 64),
+                            allow_source_fallback=False,
+                        ),
+                    )
+                assert not out.exists()
+            finally:
+                await daemon.stop()
+                serve.cancel()
+                await runner.cleanup()
+
+        run_async(body())
+
+    def test_daemon_restart_resumes_storage(self, run_async, tmp_path):
+        async def body():
+            runner, port, stats = await start_origin()
+            cfg = self._daemon_config(tmp_path)
+            daemon = Daemon(cfg)
+            serve = asyncio.ensure_future(daemon.serve())
+            await asyncio.sleep(0.1)
+            from dragonfly2_tpu.client import dfget as dfget_lib
+
+            url = f"http://127.0.0.1:{port}/blob"
+            out = tmp_path / "o1.bin"
+            await dfget_lib.download(
+                dfget_lib.DfgetConfig(url=url, output=str(out),
+                                      daemon_sock=cfg.download.unix_sock,
+                                      allow_source_fallback=False))
+            gets = stats["blob_gets"]
+            await daemon.stop()
+            serve.cancel()
+
+            # Restart daemon over the same work home: task reloads, second
+            # download reuses without touching origin.
+            daemon2 = Daemon(cfg)
+            serve2 = asyncio.ensure_future(daemon2.serve())
+            await asyncio.sleep(0.1)
+            try:
+                out2 = tmp_path / "o2.bin"
+                result = await dfget_lib.download(
+                    dfget_lib.DfgetConfig(url=url, output=str(out2),
+                                          daemon_sock=cfg.download.unix_sock,
+                                          allow_source_fallback=False))
+                assert result["from_reuse"]
+                assert out2.read_bytes() == CONTENT
+                assert stats["blob_gets"] == gets
+            finally:
+                await daemon2.stop()
+                serve2.cancel()
+                await runner.cleanup()
+
+        run_async(body())
+
+    def test_source_fallback_when_daemon_dead(self, run_async, tmp_path):
+        async def body():
+            runner, port, _ = await start_origin()
+            try:
+                from dragonfly2_tpu.client import dfget as dfget_lib
+
+                out = tmp_path / "direct.bin"
+                result = await dfget_lib.download(
+                    dfget_lib.DfgetConfig(
+                        url=f"http://127.0.0.1:{port}/small", output=str(out),
+                        daemon_sock=str(tmp_path / "missing.sock"),
+                    ),
+                )
+                assert result.get("from_source")
+                assert out.read_bytes() == SMALL
+            finally:
+                await runner.cleanup()
+
+        run_async(body())
+
+
+class TestTruncationSafety:
+    def test_short_stream_does_not_persist_trailing_piece(self, run_async, tmp_path):
+        """A dropped origin connection must not record a truncated piece."""
+
+        async def body():
+            from aiohttp import web as _web
+
+            async def truncated(request: _web.Request) -> _web.StreamResponse:
+                # Claim the full length, stream 6 MiB, then kill the socket —
+                # a mid-transfer connection drop.
+                resp = _web.StreamResponse(
+                    headers={"Content-Length": str(len(CONTENT)), "Accept-Ranges": "bytes"}
+                )
+                await resp.prepare(request)
+                await resp.write(CONTENT[: 6 << 20])
+                request.transport.close()
+                return resp
+
+            app = _web.Application()
+            app.router.add_get("/trunc", truncated)
+            runner = _web.AppRunner(app)
+            await runner.setup()
+            site = _web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            try:
+                sm, store = _store_for(tmp_path)
+                pm = PieceManager(PieceManagerOption(concurrency=1))
+                with pytest.raises(Exception):
+                    await pm.download_source(store, f"http://127.0.0.1:{port}/trunc")
+                # Only full 4MiB pieces may be recorded; no truncated tail.
+                for rec in store.metadata.pieces.values():
+                    assert rec.size == store.metadata.piece_size
+            finally:
+                await runner.cleanup()
+
+        run_async(body())
+
+
+def test_limiter_pause_resume(run_async):
+    from dragonfly2_tpu.pkg.ratelimit import Limiter
+
+    async def body():
+        lim = Limiter(limit=1000)
+        lim.set_limit(0)  # pause
+        waiter = asyncio.ensure_future(lim.wait(10))
+        await asyncio.sleep(0.05)
+        assert not waiter.done()
+        lim.set_limit(10_000)  # resume
+        await asyncio.wait_for(waiter, 2)
+
+    run_async(body())

@@ -1,0 +1,176 @@
+"""Storage manager + local task store tests."""
+
+import os
+
+import pytest
+
+from dragonfly2_tpu.pkg import digest as pkgdigest
+from dragonfly2_tpu.pkg.errors import StorageError
+from dragonfly2_tpu.storage import StorageManager, StorageOption, TaskStoreMetadata
+
+
+def make_manager(tmp_path, **kw):
+    return StorageManager(StorageOption(data_dir=str(tmp_path / "data"), **kw))
+
+
+def meta(task_id="t1", piece_size=4, content_length=10):
+    import math
+
+    return TaskStoreMetadata(
+        task_id=task_id,
+        peer_id="p1",
+        url="http://x/f",
+        piece_size=piece_size,
+        content_length=content_length,
+        total_piece_count=math.ceil(content_length / piece_size) if content_length >= 0 else -1,
+    )
+
+
+class TestLocalStore:
+    def test_write_read_roundtrip(self, tmp_path):
+        sm = make_manager(tmp_path)
+        store = sm.register_task(meta())
+        store.write_piece(0, b"aaaa")
+        store.write_piece(1, b"bbbb")
+        store.write_piece(2, b"cc")
+        assert store.read_piece(0) == b"aaaa"
+        assert store.read_piece(2) == b"cc"
+        assert store.is_complete()
+        assert store.downloaded_bytes() == 10
+
+    def test_piece_digest_verified_on_write(self, tmp_path):
+        sm = make_manager(tmp_path)
+        store = sm.register_task(meta())
+        good = str(pkgdigest.hash_bytes("md5", b"aaaa"))
+        store.write_piece(0, b"aaaa", expected_digest=good)
+        with pytest.raises(StorageError):
+            store.write_piece(1, b"bbbb", expected_digest=good)
+
+    def test_out_of_order_writes(self, tmp_path):
+        sm = make_manager(tmp_path)
+        store = sm.register_task(meta())
+        store.write_piece(2, b"cc")
+        store.write_piece(0, b"aaaa")
+        store.write_piece(1, b"bbbb")
+        assert store.is_complete()
+        out = tmp_path / "out.bin"
+        store.mark_done()
+        store.store_to(str(out))
+        assert out.read_bytes() == b"aaaabbbbcc"
+
+    def test_store_to_hardlink(self, tmp_path):
+        sm = make_manager(tmp_path)
+        store = sm.register_task(meta())
+        for i, d in enumerate([b"aaaa", b"bbbb", b"cc"]):
+            store.write_piece(i, d)
+        store.mark_done()
+        dest = tmp_path / "out" / "f.bin"
+        store.store_to(str(dest))
+        assert dest.read_bytes() == b"aaaabbbbcc"
+        # hardlink: same inode as the data file
+        data_inode = os.stat(os.path.join(store.dir, "data")).st_ino
+        assert os.stat(dest).st_ino == data_inode
+
+    def test_store_incomplete_refused(self, tmp_path):
+        sm = make_manager(tmp_path)
+        store = sm.register_task(meta())
+        store.write_piece(0, b"aaaa")
+        with pytest.raises(StorageError):
+            store.store_to(str(tmp_path / "o"))
+
+    def test_validate_whole_digest(self, tmp_path):
+        sm = make_manager(tmp_path)
+        store = sm.register_task(meta())
+        for i, d in enumerate([b"aaaa", b"bbbb", b"cc"]):
+            store.write_piece(i, d)
+        want = "sha256:" + pkgdigest.hash_bytes("sha256", b"aaaabbbbcc").encoded
+        assert store.validate_digest(want) == want
+        with pytest.raises(StorageError):
+            store.validate_digest("sha256:" + "0" * 64)
+
+    def test_get_pieces_listing(self, tmp_path):
+        sm = make_manager(tmp_path)
+        store = sm.register_task(meta(content_length=-1))
+        store.update_task(piece_size=4)
+        store.write_piece(0, b"aaaa")
+        store.write_piece(1, b"bbbb")
+        recs = store.get_pieces(0)
+        assert [r.num for r in recs] == [0, 1]
+        recs = store.get_pieces(1, limit=1)
+        assert [r.num for r in recs] == [1]
+
+
+class TestManager:
+    def test_reload_restores_tasks(self, tmp_path):
+        sm = make_manager(tmp_path)
+        store = sm.register_task(meta())
+        for i, d in enumerate([b"aaaa", b"bbbb", b"cc"]):
+            store.write_piece(i, d)
+        store.mark_done()
+        sm.close()
+        # New manager over the same dir (daemon restart).
+        sm2 = make_manager(tmp_path)
+        assert sm2.reload() == 1
+        found = sm2.find_completed_task("t1")
+        assert found is not None
+        assert found.read_piece(1) == b"bbbb"
+
+    def test_reload_sweeps_invalid(self, tmp_path):
+        sm = make_manager(tmp_path)
+        store = sm.register_task(meta())
+        store.mark_invalid()
+        sm.close()
+        sm2 = make_manager(tmp_path)
+        assert sm2.reload() == 0
+        assert sm2.try_get("t1") is None
+
+    def test_ttl_gc(self, tmp_path):
+        sm = make_manager(tmp_path, task_ttl=0.0)
+        store = sm.register_task(meta())
+        store.write_piece(0, b"aaaa")
+        store.metadata.last_access -= 10
+        reclaimed = sm.gc()
+        assert reclaimed == ["t1"]
+        assert sm.try_get("t1") is None
+        assert not os.path.exists(store.dir)
+
+    def test_lru_quota_gc(self, tmp_path):
+        import time
+
+        sm = make_manager(tmp_path, disk_gc_threshold=25)
+        now = time.time()
+        for n in range(3):
+            st = sm.register_task(meta(task_id=f"t{n}"))
+            for i, d in enumerate([b"aaaa", b"bbbb", b"cc"]):
+                st.write_piece(i, d)
+            st.metadata.last_access = now - (3 - n)  # t0 oldest
+        reclaimed = sm.gc()
+        assert "t0" in reclaimed
+        assert sm.try_get("t2") is not None
+
+    def test_find_partial(self, tmp_path):
+        sm = make_manager(tmp_path)
+        store = sm.register_task(meta())
+        store.write_piece(0, b"aaaa")
+        assert sm.find_completed_task("t1") is None
+        assert sm.find_partial_completed_task("t1") is not None
+
+
+class TestGCPinning:
+    def test_pinned_store_survives_gc(self, tmp_path):
+        sm = make_manager(tmp_path, task_ttl=0.0)
+        store = sm.register_task(meta())
+        store.write_piece(0, b"aaaa")
+        store.metadata.last_access -= 10
+        with store:  # pinned
+            assert sm.gc() == []
+        assert sm.gc() == ["t1"]  # unpinned → reclaimed
+
+    def test_invalid_store_recreated_on_register(self, tmp_path):
+        sm = make_manager(tmp_path)
+        store = sm.register_task(meta())
+        store.write_piece(0, b"aaaa")
+        store.mark_invalid()
+        fresh = sm.register_task(meta())
+        assert not fresh.metadata.invalid
+        assert not fresh.metadata.pieces  # clean slate, no poisoned pieces
