@@ -1,0 +1,45 @@
+"""Additional CLI subcommands registered as stages land."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+
+def register(sub: argparse._SubParsersAction) -> None:
+    _add_scheduler(sub)
+
+
+def _add_scheduler(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser("scheduler", help="run the scheduler control plane")
+    p.add_argument("--config", default="", help="YAML config path")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8002)
+    p.add_argument("--manager", default="", help="manager drpc addr host:port")
+    p.set_defaults(func=_run_scheduler)
+
+
+def _run_scheduler(args: argparse.Namespace) -> int:
+    from dragonfly2_tpu.scheduler.config import SchedulerConfig
+    from dragonfly2_tpu.scheduler.server import SchedulerServer
+
+    if args.config:
+        cfg = SchedulerConfig.load(args.config)
+    else:
+        cfg = SchedulerConfig()
+    cfg.server.host = args.host
+    cfg.server.port = args.port
+    if args.manager:
+        cfg.manager_addr = args.manager
+
+    async def run() -> int:
+        server = SchedulerServer(cfg)
+        import signal
+
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, lambda: asyncio.ensure_future(server.stop()))
+        await server.serve()
+        return 0
+
+    return asyncio.run(run())

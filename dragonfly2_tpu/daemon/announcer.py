@@ -1,0 +1,88 @@
+"""Daemon announcer: registers this host with schedulers, keeps alive.
+
+Reference: client/daemon/announcer/announcer.go — builds AnnounceHostRequest
+with full host telemetry via gopsutil (:158-300, psutil here), periodic
+announce (:103-156), LeaveHost on stop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from dragonfly2_tpu.daemon.config import DaemonConfig
+from dragonfly2_tpu.pkg import dflog, idgen
+
+log = dflog.get("daemon.announcer")
+
+try:
+    import psutil
+
+    HAVE_PSUTIL = True
+except ImportError:  # pragma: no cover
+    HAVE_PSUTIL = False
+
+
+class Announcer:
+    def __init__(self, config: DaemonConfig, scheduler_client, *,
+                 peer_port: int, upload_port: int, interval: float = 30.0):
+        self.config = config
+        self.scheduler_client = scheduler_client
+        self.peer_port = peer_port
+        self.upload_port = upload_port
+        self.interval = interval
+        self.host_id = idgen.host_id(config.host.hostname, peer_port)
+        self._task: asyncio.Task | None = None
+
+    def host_wire(self) -> dict:
+        h = self.config.host
+        return {
+            "id": self.host_id,
+            "hostname": h.hostname,
+            "ip": h.ip,
+            "port": self.peer_port,
+            "upload_port": self.upload_port,
+            "type": int(self.config.host_type_enum),
+            "idc": h.idc,
+            "location": h.location,
+            "tpu_slice": h.tpu_slice,
+            "tpu_worker_index": h.tpu_worker_index,
+            "telemetry": self._telemetry(),
+        }
+
+    @staticmethod
+    def _telemetry() -> dict:
+        if not HAVE_PSUTIL:
+            return {}
+        try:
+            mem = psutil.virtual_memory()
+            disk = psutil.disk_usage("/")
+            return {
+                "cpu_percent": psutil.cpu_percent(interval=None),
+                "mem_percent": mem.percent,
+                "disk_free": disk.free,
+            }
+        except Exception:
+            return {}
+
+    async def start(self) -> None:
+        await self.announce_once()
+        self._task = asyncio.ensure_future(self._loop())
+
+    async def announce_once(self) -> None:
+        try:
+            await self.scheduler_client.announce_host(self.host_wire())
+        except Exception as e:
+            log.warning("host announce failed", error=str(e))
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval)
+            await self.announce_once()
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+        try:
+            await self.scheduler_client.leave_host(self.host_id)
+        except Exception:
+            pass

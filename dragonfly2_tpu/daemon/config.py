@@ -121,13 +121,16 @@ class DaemonConfig:
     def __post_init__(self):
         if not self.work_home:
             self.work_home = Dfpath().root
-        path = Dfpath(self.work_home)
-        if not self.download.unix_sock:
-            self.download.unix_sock = path.daemon_sock
 
     @property
     def dfpath(self) -> Dfpath:
         return Dfpath(self.work_home)
+
+    @property
+    def unix_sock(self) -> str:
+        """Resolved lazily so work_home changes after construction move the
+        socket with them."""
+        return self.download.unix_sock or self.dfpath.daemon_sock
 
     @property
     def host_type_enum(self) -> HostType:

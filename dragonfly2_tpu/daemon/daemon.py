@@ -2,18 +2,21 @@
 
 Reference: client/daemon/daemon.go — New (:108) builds storage, peer task
 manager, rpc servers, upload server, proxy, object storage, gc, announcer;
-Serve (:400-710) starts them; Stop (:711) tears down. Stage 2 wires the
-download path; later stages attach upload/proxy/objectstorage/announcer.
+Serve (:400-710) starts them; Stop (:711) tears down.
 """
 
 from __future__ import annotations
 
 import asyncio
 
+from dragonfly2_tpu.daemon.announcer import Announcer
 from dragonfly2_tpu.daemon.config import DaemonConfig
+from dragonfly2_tpu.daemon.peer.conductor import PeerTaskConductor
 from dragonfly2_tpu.daemon.peer.piece_manager import PieceManager, PieceManagerOption
 from dragonfly2_tpu.daemon.peer.task_manager import TaskManager
 from dragonfly2_tpu.daemon.rpcserver import DaemonRpcServer
+from dragonfly2_tpu.daemon.schedulerclient import SchedulerClient
+from dragonfly2_tpu.daemon.upload import UploadManager
 from dragonfly2_tpu.pkg import dflog
 from dragonfly2_tpu.pkg.cache import GC, GCTask
 from dragonfly2_tpu.pkg.ratelimit import Limiter
@@ -49,32 +52,97 @@ class Daemon:
             ),
             limiter=Limiter(rate if rate > 0 else float("inf")),
         )
+
+        self.scheduler_client: SchedulerClient | None = None
+        if config.scheduler.addrs:
+            self.scheduler_client = SchedulerClient(config.scheduler.addrs)
+
+        self.upload = UploadManager(self.storage, rate_limit=config.upload.rate_limit)
         self.task_manager = TaskManager(
             self.storage,
             self.piece_manager,
             host_ip=config.host.ip,
+            scheduler_client=self.scheduler_client,
+            conductor_factory=self._make_conductor if self.scheduler_client else None,
             total_rate_limit=rate,
         )
         self.rpc = DaemonRpcServer(self.task_manager)
+        self.announcer: Announcer | None = None
         self.gc = GC(log)
         self.gc.add(GCTask("storage", config.gc_interval, 30.0, self._gc_storage))
         self._stopped = asyncio.Event()
 
+    # -- conductor factory (P2P path) --------------------------------------
+
+    def _make_conductor(self, *, task_id: str, peer_id: str, request, store,
+                        on_piece, is_seed: bool = False) -> PeerTaskConductor:
+        host = self.config.host
+        host_info = {
+            "id": self.announcer.host_id if self.announcer else host.hostname,
+            "hostname": host.hostname,
+            "ip": host.ip,
+            "port": self.rpc.peer_server.port() if self.rpc.peer_server._servers else 0,
+            "upload_port": self.upload.port,
+            "type": int(self.config.host_type_enum),
+            "idc": host.idc,
+            "location": host.location,
+            "tpu_slice": host.tpu_slice,
+            "tpu_worker_index": host.tpu_worker_index,
+        }
+        meta = {
+            "tag": request.meta.tag,
+            "application": request.meta.application,
+            "digest": request.meta.digest,
+            "filters": request.meta.filter.split("&") if request.meta.filter else [],
+            "header": dict(request.meta.header),
+            "priority": request.meta.priority,
+        }
+        return PeerTaskConductor(
+            task_id=task_id,
+            peer_id=peer_id,
+            url=request.url,
+            store=store,
+            scheduler_client=self.scheduler_client,
+            piece_manager=self.piece_manager,
+            host_info=host_info,
+            meta=meta,
+            is_seed=is_seed or self.config.seed_peer,
+            piece_parallelism=self.config.download.parent_concurrency,
+            limiter=self.task_manager.limiter,
+            on_piece=on_piece,
+        )
+
     async def _gc_storage(self) -> None:
         self.storage.gc()
 
-    async def serve(self) -> None:
-        await self.rpc.serve_download(NetAddr.unix(self.config.download.unix_sock))
-        if self.config.download.peer_port >= 0:
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        """Bring every service up (non-blocking)."""
+        await self.rpc.serve_download(NetAddr.unix(self.config.unix_sock))
+        if self.config.download.peer_port >= 0:  # -1 disables the peer service
             await self.rpc.serve_peer(
-                NetAddr.tcp(self.config.host.ip, self.config.download.peer_port)
+                NetAddr.tcp(self.config.host.ip, self.config.download.peer_port))
+        await self.upload.serve(self.config.host.ip, self.config.upload.port)
+        peer_port = self.rpc.peer_server.port() if self.rpc.peer_server._servers else 0
+        if self.scheduler_client is not None:
+            self.announcer = Announcer(
+                self.config, self.scheduler_client,
+                peer_port=peer_port,
+                upload_port=self.upload.port,
             )
+            await self.announcer.start()
         self.gc.serve()
         log.info(
             "daemon up",
-            sock=self.config.download.unix_sock,
-            data_dir=self.storage.opt.data_dir,
+            sock=self.config.unix_sock,
+            peer_port=peer_port,
+            upload_port=self.upload.port,
+            seed=self.config.seed_peer,
         )
+
+    async def serve(self) -> None:
+        await self.start()
         if self.config.alive_time > 0:
             try:
                 await asyncio.wait_for(self._stopped.wait(), self.config.alive_time)
@@ -85,6 +153,11 @@ class Daemon:
 
     async def stop(self) -> None:
         self.gc.stop()
+        if self.announcer is not None:
+            await self.announcer.stop()
+        if self.scheduler_client is not None:
+            await self.scheduler_client.close()
+        await self.upload.close()
         await self.rpc.close()
         self.storage.close()
         self._stopped.set()

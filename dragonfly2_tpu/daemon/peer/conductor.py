@@ -1,0 +1,388 @@
+"""Peer task conductor: orchestrates one P2P download.
+
+Reference: client/daemon/peer/peertask_conductor.go (1636 LoC) — the
+concurrency web tying together: the scheduler AnnouncePeer stream
+(register :255, receive loop :673), the P2P piece pull (pullPieces :533)
+with N download workers (:1009-1077 init, :1043 downloadPieceWorker hot
+loop), per-parent synchronizer streams, back-to-source fallback
+(backSource :503), piece result reporting (:1252-1314) and completion
+(done/fail :1378+).
+
+Flow:
+  run() → announce register → dispatch on scheduler response:
+    empty_task        → create empty content, finish
+    need_back_source  → piece_manager.download_source, announcing pieces
+    normal_task       → sync parents, spawn piece workers, fetch pieces
+                        over HTTP, report results, reschedule on starvation
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from dragonfly2_tpu.daemon.peer.piece_dispatcher import PieceAssignment, PieceDispatcher
+from dragonfly2_tpu.daemon.peer.piece_downloader import PieceDownloader
+from dragonfly2_tpu.daemon.peer.piece_manager import PieceManager
+from dragonfly2_tpu.daemon.peer.synchronizer import PieceTaskSynchronizer
+from dragonfly2_tpu.pkg import dflog, metrics
+from dragonfly2_tpu.pkg.errors import Code, DfError
+from dragonfly2_tpu.pkg.piece import compute_piece_count
+from dragonfly2_tpu.pkg.ratelimit import Limiter
+from dragonfly2_tpu.storage.local_store import LocalTaskStore
+
+log = dflog.get("peer.conductor")
+
+PIECE_DOWNLOAD_COUNT = metrics.counter(
+    "peer_piece_download_total", "P2P piece downloads", ("result",))
+BACK_SOURCE_COUNT = metrics.counter(
+    "peer_back_source_total", "Tasks that fell back to origin")
+
+MAX_RESCHEDULES = 8
+
+
+class PeerTaskConductor:
+    def __init__(
+        self,
+        *,
+        task_id: str,
+        peer_id: str,
+        url: str,
+        store: LocalTaskStore,
+        scheduler_client,
+        piece_manager: PieceManager,
+        host_info: dict,
+        meta: dict | None = None,
+        is_seed: bool = False,
+        piece_parallelism: int = 4,
+        limiter: Limiter | None = None,
+        on_piece=None,
+    ):
+        self.task_id = task_id
+        self.peer_id = peer_id
+        self.url = url
+        self.store = store
+        self.scheduler_client = scheduler_client
+        self.piece_manager = piece_manager
+        self.host_info = host_info
+        self.meta = meta or {}
+        self.is_seed = is_seed
+        self.piece_parallelism = piece_parallelism
+        self.limiter = limiter or Limiter()
+        self.on_piece = on_piece
+
+        self.dispatcher = PieceDispatcher()
+        self.downloader = PieceDownloader()
+        self.synchronizer: PieceTaskSynchronizer | None = None
+        self._stream = None
+        self._reschedules = 0
+        self._from_p2p = False
+        self._report_lock = asyncio.Lock()
+        self._resched_lock = asyncio.Lock()
+        self._sched_update = asyncio.Event()   # receiver loop applied a push
+        self._need_back_source = False
+
+    # ------------------------------------------------------------------ #
+
+    async def run(self) -> None:
+        """Complete the task into self.store, or raise DfError."""
+        open_body = {
+            "host": self.host_info,
+            "peer_id": self.peer_id,
+            "task_id": self.task_id,
+            "url": self.url,
+            "tag": self.meta.get("tag", ""),
+            "application": self.meta.get("application", ""),
+            "digest": self.meta.get("digest", ""),
+            "filters": self.meta.get("filters") or [],
+            "header": self.meta.get("header") or {},
+            "priority": self.meta.get("priority", 3),
+            "is_seed": self.is_seed,
+        }
+        self._stream = await self.scheduler_client.open_announce_stream(open_body)
+        try:
+            await self._stream.send({"type": "register"})
+            msg = await self._stream.recv(timeout=60.0)
+            if msg is None:
+                raise DfError(Code.SchedError, "scheduler closed stream at register")
+            kind = msg.get("type")
+            if kind == "empty_task":
+                await self._finish_empty()
+            elif kind == "need_back_source":
+                await self._back_source()
+            elif kind == "normal_task":
+                await self._pull_pieces_p2p(msg)
+            elif kind == "schedule_failed":
+                raise DfError(Code.SchedError, msg.get("reason", "schedule failed"))
+            else:
+                raise DfError(Code.SchedError, f"unexpected scheduler response {kind}")
+        except BaseException:
+            await self._safe_send({"type": "download_failed"})
+            raise
+        finally:
+            await self._teardown()
+
+    @property
+    def from_p2p(self) -> bool:
+        return self._from_p2p
+
+    # -- empty (reference storeEmptyPeerTask :595) -------------------------
+
+    async def _finish_empty(self) -> None:
+        self.store.update_task(content_length=0, total_piece_count=0, piece_size=1)
+        await self._safe_send({"type": "download_finished", "content_length": 0})
+
+    # -- back-to-source (reference backSource :503) ------------------------
+
+    async def _back_source(self) -> None:
+        # Announce-only fast path: content already complete locally (seed
+        # re-announce after a scheduler restart) — report pieces, no origin.
+        if self.store.metadata.done and self.store.is_complete():
+            m = self.store.metadata
+            await self._safe_send({
+                "type": "download_started",
+                "content_length": m.content_length,
+                "piece_size": m.piece_size,
+                "total_piece_count": m.total_piece_count,
+            })
+            for rec in self.store.get_pieces():
+                await self._report_piece(rec, parent_id="")
+            await self._safe_send({
+                "type": "download_finished",
+                "content_length": m.content_length,
+                "piece_size": m.piece_size,
+                "total_piece_count": m.total_piece_count,
+            })
+            return
+
+        BACK_SOURCE_COUNT.inc()
+        log.info("back-to-source", task=self.task_id[:16], seed=self.is_seed)
+        started_sent = False
+
+        async def on_piece(store: LocalTaskStore, rec) -> None:
+            nonlocal started_sent
+            if not started_sent and store.metadata.piece_size > 0:
+                started_sent = True
+                await self._safe_send({
+                    "type": "download_started",
+                    "content_length": store.metadata.content_length,
+                    "piece_size": store.metadata.piece_size,
+                    "total_piece_count": store.metadata.total_piece_count,
+                })
+            await self._report_piece(rec, parent_id="")
+            if self.on_piece is not None:
+                await self.on_piece(store, rec)
+
+        await self.piece_manager.download_source(
+            self.store, self.url, self.meta.get("header") or {},
+            on_piece=on_piece, limiter=self.limiter,
+        )
+        await self._safe_send({
+            "type": "download_finished",
+            "content_length": self.store.metadata.content_length,
+            "piece_size": self.store.metadata.piece_size,
+            "total_piece_count": self.store.metadata.total_piece_count,
+        })
+
+    # -- P2P pull (reference pullPiecesWithP2P :552) -----------------------
+
+    async def _pull_pieces_p2p(self, schedule_msg: dict) -> None:
+        self._from_p2p = True
+        self._apply_task_meta(schedule_msg.get("task") or {})
+        self.synchronizer = PieceTaskSynchronizer(
+            self.task_id, self.peer_id, self.dispatcher,
+            on_parent_dead=self._on_parent_dead)
+        self.synchronizer.sync_parents(schedule_msg.get("parents") or [])
+        # Resume support: pieces already on disk need no re-download.
+        self.dispatcher.mark_known_downloaded(self.store.metadata.pieces.keys())
+
+        receiver = asyncio.ensure_future(self._receive_scheduler_loop())
+        workers = [asyncio.ensure_future(self._piece_worker(i))
+                   for i in range(self.piece_parallelism)]
+        try:
+            try:
+                await asyncio.gather(*workers)
+            except BaseException:
+                # First failure cancels siblings so they can't race teardown.
+                for w in workers:
+                    w.cancel()
+                await asyncio.gather(*workers, return_exceptions=True)
+                raise
+            if self._need_back_source and not self._complete():
+                # Scheduler demoted us mid-flight: finish the remainder from
+                # origin (pieces already on disk are skipped).
+                await self._back_source()
+                return
+            if not self._complete():
+                raise DfError(Code.ClientPieceDownloadFail,
+                              f"p2p download stalled at "
+                              f"{self.dispatcher.downloaded_count()} pieces")
+            await self._safe_send({
+                "type": "download_finished",
+                "content_length": self.store.metadata.content_length,
+                "piece_size": self.store.metadata.piece_size,
+                "total_piece_count": self.store.metadata.total_piece_count,
+            })
+        finally:
+            receiver.cancel()
+
+    def _apply_task_meta(self, task_wire: dict) -> None:
+        cl = task_wire.get("content_length", -1)
+        ps = task_wire.get("piece_size", 0)
+        tp = task_wire.get("total_piece_count", -1)
+        if cl >= 0 and ps > 0 and tp < 0:
+            tp = compute_piece_count(cl, ps)
+        self.store.update_task(content_length=cl if cl >= 0 else None,
+                               piece_size=ps if ps > 0 else None,
+                               total_piece_count=tp if tp >= 0 else None)
+        self.dispatcher.content_length = self.store.metadata.content_length
+        self.dispatcher.piece_size = self.store.metadata.piece_size
+        if self.store.metadata.total_piece_count >= 0:
+            self.dispatcher.total_piece_count = self.store.metadata.total_piece_count
+
+    def _complete(self) -> bool:
+        m = self.store.metadata
+        if m.total_piece_count < 0 and self.dispatcher.total_piece_count >= 0:
+            self.store.update_task(
+                total_piece_count=self.dispatcher.total_piece_count,
+                content_length=self.dispatcher.content_length
+                if self.dispatcher.content_length >= 0 else None,
+                piece_size=self.dispatcher.piece_size
+                if self.dispatcher.piece_size > 0 else None,
+            )
+        return m.total_piece_count >= 0 and self.store.is_complete()
+
+    def _on_parent_dead(self, parent_peer_id: str) -> None:
+        # Next dispatcher starvation triggers a reschedule with this parent
+        # in the blocklist (reference reportInvalidPeer).
+        pass
+
+    async def _receive_scheduler_loop(self) -> None:
+        """The ONLY reader of the scheduler stream after registration:
+        applies pushed parent sets / back-source demotions and signals
+        waiters (reference receivePeerPacket :673)."""
+        try:
+            while True:
+                msg = await self._stream.recv()
+                if msg is None:
+                    return
+                kind = msg.get("type")
+                if kind == "normal_task":
+                    self._apply_task_meta(msg.get("task") or {})
+                    if self.synchronizer is not None:
+                        self.synchronizer.sync_parents(msg.get("parents") or [])
+                    self._sched_update.set()
+                elif kind in ("need_back_source", "schedule_failed"):
+                    if kind == "need_back_source":
+                        self._need_back_source = True
+                    for p in self.dispatcher.parents.values():
+                        p.blocked = True
+                    self._sched_update.set()
+        except (asyncio.CancelledError, DfError):
+            pass
+
+    async def _piece_worker(self, index: int) -> None:
+        """Hot loop (reference downloadPieceWorker :1043)."""
+        while True:
+            if self._complete() or self._need_back_source:
+                return
+            assignment = await self.dispatcher.get(timeout=10.0)
+            if assignment is None:
+                if self._complete() or self._need_back_source:
+                    return
+                if not await self._handle_starvation():
+                    return
+                continue
+            await self._download_one(assignment)
+
+    async def _download_one(self, assignment: PieceAssignment) -> None:
+        p = assignment.parent
+        # Task geometry can arrive from parents (sync streams) before the
+        # scheduler's task record knows it; the store needs piece_size
+        # before the first write.
+        if self.store.metadata.piece_size <= 0 and self.dispatcher.piece_size > 0:
+            self.store.update_task(
+                piece_size=self.dispatcher.piece_size,
+                content_length=self.dispatcher.content_length
+                if self.dispatcher.content_length >= 0 else None,
+                total_piece_count=self.dispatcher.total_piece_count
+                if self.dispatcher.total_piece_count >= 0 else None,
+            )
+        try:
+            await self.limiter.wait(max(assignment.expected_size, 1)
+                                    if assignment.expected_size > 0 else 1)
+            data, cost_ms = await self.downloader.download_piece(
+                p.ip, p.upload_port, self.task_id, assignment.piece_num,
+                src_peer_id=self.peer_id, expected_size=assignment.expected_size)
+            rec = self.store.write_piece(assignment.piece_num, data, cost_ms=cost_ms)
+            self.dispatcher.report_success(assignment, cost_ms)
+            PIECE_DOWNLOAD_COUNT.labels("ok").inc()
+            await self._report_piece(rec, parent_id=p.peer_id)
+            if self.on_piece is not None:
+                await self.on_piece(self.store, rec)
+        except DfError as e:
+            PIECE_DOWNLOAD_COUNT.labels("fail").inc()
+            gone = e.code in (Code.ClientConnectionError, Code.ClientPieceRequestFail)
+            self.dispatcher.report_failure(assignment, parent_gone=gone)
+            await self._safe_send({
+                "type": "piece_failed",
+                "piece_num": assignment.piece_num,
+                "parent_id": p.peer_id,
+                "temporary": not gone,
+            })
+
+    async def _handle_starvation(self) -> bool:
+        """No assignable pieces: ask the scheduler for new parents. Only one
+        worker at a time runs the reschedule dance; the scheduler's answer
+        arrives through the receiver loop. Returns False when the worker
+        should exit (back-source takeover or terminal starvation)."""
+        async with self._resched_lock:
+            if self._complete() or self._need_back_source:
+                return False
+            # Another worker may have already refreshed the parent set
+            # (peek only — try_get would leak an in-flight reservation).
+            if self.dispatcher.has_assignable() or self.dispatcher.active_parents():
+                return True
+            self._reschedules += 1
+            if self._reschedules > MAX_RESCHEDULES:
+                raise DfError(Code.ClientScheduleTimeout,
+                              f"starved after {MAX_RESCHEDULES} reschedules")
+            blocklist = [pid for pid, p in self.dispatcher.parents.items() if p.blocked]
+            self._sched_update.clear()
+            await self._safe_send({"type": "reschedule", "blocklist": blocklist,
+                                   "description": "piece starvation"})
+            try:
+                await asyncio.wait_for(self._sched_update.wait(), timeout=30.0)
+            except asyncio.TimeoutError:
+                raise DfError(Code.SchedError, "scheduler silent during reschedule")
+            return not self._need_back_source
+
+    # -- reporting ---------------------------------------------------------
+
+    async def _report_piece(self, rec, parent_id: str) -> None:
+        async with self._report_lock:
+            await self._safe_send({
+                "type": "piece_finished",
+                "piece": {
+                    "piece_num": rec.num,
+                    "range_start": rec.offset,
+                    "range_size": rec.size,
+                    "digest": rec.digest,
+                    "download_cost_ms": rec.cost_ms,
+                    "dst_peer_id": parent_id,
+                },
+            })
+
+    async def _safe_send(self, msg: dict) -> None:
+        if self._stream is None or self._stream.closed:
+            return
+        try:
+            await self._stream.send(msg)
+        except DfError:
+            pass
+
+    async def _teardown(self) -> None:
+        if self.synchronizer is not None:
+            await self.synchronizer.close()
+        await self.downloader.close()
+        if self._stream is not None:
+            await self._stream.close()

@@ -1,0 +1,184 @@
+"""Piece dispatcher: decides which (piece, parent) to fetch next.
+
+Reference: client/daemon/peer/piece_dispatcher.go — per-parent smoothed
+score, sorted with probability (1 - randomRatio) else shuffled (:89-168);
+skips pieces already downloaded. Availability arrives from the per-parent
+synchronizers; workers pull assignments here.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass, field
+
+from dragonfly2_tpu.pkg import dflog
+
+log = dflog.get("peer.piece_dispatcher")
+
+EWMA_ALPHA = 0.3
+RANDOM_RATIO = 0.1  # reference defaultRandomRatio: explore parents
+
+
+@dataclass
+class ParentInfo:
+    peer_id: str
+    ip: str
+    upload_port: int
+    pieces: set[int] = field(default_factory=set)
+    cost_ewma_ms: float = 100.0    # optimistic start
+    failures: int = 0
+    blocked: bool = False
+
+
+@dataclass
+class PieceAssignment:
+    piece_num: int
+    parent: ParentInfo
+    expected_size: int = -1
+
+
+class PieceDispatcher:
+    def __init__(self, *, max_parent_failures: int = 3):
+        self.parents: dict[str, ParentInfo] = {}
+        self.total_piece_count = -1
+        self.piece_size = 0
+        self.content_length = -1
+        self._done: set[int] = set()
+        self._inflight: set[int] = set()
+        self._max_parent_failures = max_parent_failures
+        self._wakeup = asyncio.Event()
+
+    # -- topology updates --------------------------------------------------
+
+    def upsert_parent(self, peer_id: str, ip: str, upload_port: int) -> ParentInfo:
+        p = self.parents.get(peer_id)
+        if p is None:
+            p = ParentInfo(peer_id, ip, upload_port)
+            self.parents[peer_id] = p
+            self._wakeup.set()
+        else:
+            p.ip, p.upload_port = ip, upload_port
+            p.blocked = False
+        return p
+
+    def drop_parent(self, peer_id: str) -> None:
+        p = self.parents.get(peer_id)
+        if p is not None:
+            p.blocked = True
+        self._wakeup.set()
+
+    def active_parents(self) -> list[ParentInfo]:
+        return [p for p in self.parents.values() if not p.blocked]
+
+    def on_parent_pieces(self, peer_id: str, piece_nums: list[int],
+                         total_piece_count: int = -1, content_length: int = -1,
+                         piece_size: int = 0) -> None:
+        p = self.parents.get(peer_id)
+        if p is None:
+            return
+        p.pieces.update(piece_nums)
+        if total_piece_count >= 0:
+            self.total_piece_count = total_piece_count
+        if content_length >= 0:
+            self.content_length = content_length
+        if piece_size > 0:
+            self.piece_size = piece_size
+        self._wakeup.set()
+
+    # -- results -----------------------------------------------------------
+
+    def mark_downloaded(self, piece_num: int) -> None:
+        self._done.add(piece_num)
+        self._inflight.discard(piece_num)
+        self._wakeup.set()
+
+    def mark_known_downloaded(self, piece_nums) -> None:
+        self._done.update(piece_nums)
+
+    def report_success(self, assignment: PieceAssignment, cost_ms: int) -> None:
+        p = assignment.parent
+        p.cost_ewma_ms = (1 - EWMA_ALPHA) * p.cost_ewma_ms + EWMA_ALPHA * cost_ms
+        p.failures = 0
+        self.mark_downloaded(assignment.piece_num)
+
+    def report_failure(self, assignment: PieceAssignment, *, parent_gone: bool = False) -> None:
+        p = assignment.parent
+        p.failures += 1
+        p.cost_ewma_ms *= 2  # punish
+        if parent_gone or p.failures >= self._max_parent_failures:
+            p.blocked = True
+        self._inflight.discard(assignment.piece_num)
+        self._wakeup.set()
+
+    # -- completion --------------------------------------------------------
+
+    def is_complete(self) -> bool:
+        return self.total_piece_count >= 0 and len(self._done) >= self.total_piece_count
+
+    def no_usable_parents(self) -> bool:
+        return not self.active_parents()
+
+    def downloaded_count(self) -> int:
+        return len(self._done)
+
+    # -- assignment (reference getDesiredReq :104-168) ---------------------
+
+    def _candidate_pieces(self) -> list[int]:
+        if self.total_piece_count >= 0:
+            universe = range(self.total_piece_count)
+            missing = [n for n in universe if n not in self._done and n not in self._inflight]
+        else:
+            advertised: set[int] = set()
+            for p in self.active_parents():
+                advertised |= p.pieces
+            missing = sorted(advertised - self._done - self._inflight)
+        return missing
+
+    def _pick_parent(self, piece_num: int) -> ParentInfo | None:
+        holders = [p for p in self.active_parents() if piece_num in p.pieces]
+        if not holders:
+            return None
+        if random.random() < RANDOM_RATIO:
+            return random.choice(holders)
+        return min(holders, key=lambda p: p.cost_ewma_ms)
+
+    def has_assignable(self) -> bool:
+        """Non-mutating peek: could try_get() return an assignment now?"""
+        for piece_num in self._candidate_pieces():
+            if any(piece_num in p.pieces for p in self.active_parents()):
+                return True
+        return False
+
+    def try_get(self) -> PieceAssignment | None:
+        for piece_num in self._candidate_pieces():
+            parent = self._pick_parent(piece_num)
+            if parent is None:
+                continue
+            self._inflight.add(piece_num)
+            expected = -1
+            if self.piece_size > 0 and self.content_length >= 0:
+                from dragonfly2_tpu.pkg.piece import piece_length
+
+                expected = piece_length(piece_num, self.piece_size, self.content_length)
+            return PieceAssignment(piece_num, parent, expected)
+        return None
+
+    async def get(self, timeout: float = 30.0) -> PieceAssignment | None:
+        """Next assignment; None when the task is complete or no parents can
+        serve anything new within ``timeout`` (caller decides to reschedule)."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            if self.is_complete():
+                return None
+            assignment = self.try_get()
+            if assignment is not None:
+                return assignment
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0 or self.no_usable_parents():
+                return None
+            self._wakeup.clear()
+            try:
+                await asyncio.wait_for(self._wakeup.wait(), min(remaining, 1.0))
+            except asyncio.TimeoutError:
+                pass

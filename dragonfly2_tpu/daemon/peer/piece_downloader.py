@@ -1,0 +1,69 @@
+"""Piece downloader: HTTP GETs against a parent's upload server.
+
+Reference: client/daemon/peer/piece_downloader.go — DownloadPiece (:165),
+buildDownloadPieceHTTPRequest (:204): GET
+http://{parent}/download/{taskPrefix}/{taskID}?peerId=...&pieceNum=N.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import aiohttp
+
+from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg.errors import Code, DfError
+
+log = dflog.get("peer.piece_downloader")
+
+
+class PieceDownloader:
+    def __init__(self, timeout: float = 30.0):
+        self._timeout = timeout
+        self._session: aiohttp.ClientSession | None = None
+        self._session_loop = None
+
+    async def _sess(self) -> aiohttp.ClientSession:
+        loop = asyncio.get_running_loop()
+        if self._session is None or self._session.closed or self._session_loop is not loop:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self._timeout),
+                connector=aiohttp.TCPConnector(limit_per_host=16),
+            )
+            self._session_loop = loop
+        return self._session
+
+    async def download_piece(self, parent_ip: str, parent_upload_port: int,
+                             task_id: str, piece_num: int, *, src_peer_id: str = "",
+                             expected_size: int = -1) -> tuple[bytes, int]:
+        """Fetch one piece; returns (data, cost_ms)."""
+        url = (f"http://{parent_ip}:{parent_upload_port}"
+               f"/download/{task_id[:3]}/{task_id}")
+        start = time.monotonic()
+        sess = await self._sess()
+        try:
+            async with sess.get(url, params={"peerId": src_peer_id,
+                                             "pieceNum": str(piece_num)}) as resp:
+                if resp.status == 404:
+                    raise DfError(Code.ClientPieceNotFound,
+                                  f"parent {parent_ip}:{parent_upload_port} lacks piece {piece_num}")
+                if resp.status == 429:
+                    raise DfError(Code.ClientRequestLimitFail,
+                                  f"parent {parent_ip}:{parent_upload_port} throttled")
+                if resp.status != 200:
+                    raise DfError(Code.ClientPieceRequestFail,
+                                  f"parent returned {resp.status} for piece {piece_num}")
+                data = await resp.read()
+        except aiohttp.ClientError as e:
+            raise DfError(Code.ClientPieceRequestFail,
+                          f"piece {piece_num} from {parent_ip}:{parent_upload_port}: {e}")
+        if expected_size >= 0 and len(data) != expected_size:
+            raise DfError(Code.ClientPieceDownloadFail,
+                          f"piece {piece_num} size {len(data)} != expected {expected_size}")
+        cost_ms = int((time.monotonic() - start) * 1000)
+        return data, cost_ms
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()

@@ -1,0 +1,102 @@
+"""Piece-task synchronizer: per-parent drpc streams announcing pieces.
+
+Reference: client/daemon/peer/peertask_piecetask_synchronizer.go — one
+``SyncPieceTasks`` stream per parent (:81-143 syncPeers), received piece
+infos dispatched into the dispatcher (:341-386), invalid peers reported so
+the scheduler can blocklist them.
+
+Wire (drpc "Peer.SyncPieceTasks"):
+  open_body: {task_id, src_peer_id (requester), dst_peer_id (parent)}
+  parent → child: {pieces: [nums], total_piece_count, content_length,
+                   piece_size, done}
+  child → parent: {interested: true}   (keep-alive / request-more)
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from dragonfly2_tpu.daemon.peer.piece_dispatcher import PieceDispatcher
+from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg.types import NetAddr
+from dragonfly2_tpu.rpc import Client
+
+log = dflog.get("peer.synchronizer")
+
+
+class PieceTaskSynchronizer:
+    """Manages one sync stream per parent for a single conductor."""
+
+    def __init__(self, task_id: str, peer_id: str, dispatcher: PieceDispatcher,
+                 on_parent_dead=None):
+        self.task_id = task_id
+        self.peer_id = peer_id
+        self.dispatcher = dispatcher
+        self.on_parent_dead = on_parent_dead
+        self._tasks: dict[str, asyncio.Task] = {}
+        self._clients: dict[str, Client] = {}
+
+    def sync_parents(self, parents: list[dict]) -> None:
+        """Start/refresh sync streams for the scheduled parent set
+        (reference syncPeers :81)."""
+        for parent in parents:
+            peer_id = parent["id"]
+            host = parent.get("host") or {}
+            ip, port = host.get("ip", ""), host.get("port", 0)
+            upload_port = host.get("upload_port", 0)
+            if not ip or not port or not upload_port:
+                log.warning("parent missing address", parent=peer_id[:24])
+                continue
+            self.dispatcher.upsert_parent(peer_id, ip, upload_port)
+            # Seed known pieces from the schedule response if present.
+            finished = parent.get("finished_pieces") or []
+            if finished:
+                self.dispatcher.on_parent_pieces(peer_id, finished)
+            if peer_id not in self._tasks or self._tasks[peer_id].done():
+                self._tasks[peer_id] = asyncio.ensure_future(
+                    self._sync_one(peer_id, ip, port))
+
+    async def _sync_one(self, parent_peer_id: str, ip: str, port: int) -> None:
+        cli = self._clients.get(parent_peer_id)
+        if cli is None:
+            cli = Client(NetAddr.tcp(ip, port))
+            self._clients[parent_peer_id] = cli
+        try:
+            stream = await cli.open_stream(
+                "Peer.SyncPieceTasks",
+                {"task_id": self.task_id, "src_peer_id": self.peer_id,
+                 "dst_peer_id": parent_peer_id},
+            )
+            while True:
+                msg = await stream.recv(timeout=60.0)
+                if msg is None:
+                    break
+                self.dispatcher.on_parent_pieces(
+                    parent_peer_id,
+                    msg.get("pieces") or [],
+                    msg.get("total_piece_count", -1),
+                    msg.get("content_length", -1),
+                    msg.get("piece_size", 0),
+                )
+                if msg.get("done"):
+                    break
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.warning("sync stream lost", parent=parent_peer_id[:24], error=str(e))
+            self.dispatcher.drop_parent(parent_peer_id)
+            if self.on_parent_dead is not None:
+                self.on_parent_dead(parent_peer_id)
+
+    async def close(self) -> None:
+        for t in self._tasks.values():
+            t.cancel()
+        for t in self._tasks.values():
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        for cli in self._clients.values():
+            await cli.close()
+        self._tasks.clear()
+        self._clients.clear()

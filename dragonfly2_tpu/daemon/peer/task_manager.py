@@ -1,18 +1,18 @@
-"""Peer task manager: task front-end, dedup and reuse.
+"""Peer task manager: task front-end, dedup, reuse, conductors.
 
 Reference: client/daemon/peer/peertask_manager.go — StartFileTask (:328),
-StartStreamTask (:357), StartSeedTask (:401), conductor dedup
-(getOrCreatePeerTaskConductor :201) and peertask_reuse.go (local-completion
-reuse). Stage 2 wires reuse + back-to-source; the P2P conductor
-(conductor.py) plugs in via ``scheduler_client``.
+StartSeedTask (:401), conductor dedup (getOrCreatePeerTaskConductor :201),
+Subscribe (:439) via the piece broker; peertask_reuse.go for local reuse.
 """
 
 from __future__ import annotations
 
+import asyncio
 import time
 from dataclasses import dataclass, field
 from typing import AsyncIterator
 
+from dragonfly2_tpu.daemon.peer.broker import PieceBroker, PieceEvent
 from dragonfly2_tpu.daemon.peer.piece_manager import PieceManager
 from dragonfly2_tpu.pkg import dflog, idgen
 from dragonfly2_tpu.pkg.errors import Code, DfError
@@ -74,9 +74,16 @@ class FileTaskProgress:
         }
 
 
+class _RunningTask:
+    def __init__(self, store):
+        self.store = store
+        self.done = asyncio.Event()
+        self.error: DfError | None = None
+
+
 class TaskManager:
-    """Front-end for file/stream/seed tasks. Holds the storage manager, the
-    piece manager and (from stage 3) the conductor pool."""
+    """Front-end for file/stream/seed tasks; owns conductor dedup and the
+    piece broker."""
 
     def __init__(
         self,
@@ -94,6 +101,42 @@ class TaskManager:
         self.scheduler_client = scheduler_client
         self.conductor_factory = conductor_factory
         self.limiter = Limiter(total_rate_limit if total_rate_limit > 0 else float("inf"))
+        self.broker = PieceBroker()
+        self._running: dict[str, _RunningTask] = {}
+
+    # -- shared download core ---------------------------------------------
+
+    async def _run_download(self, task_id: str, peer_id: str, req: FileTaskRequest,
+                            store, progress_q: "_ProgressAggregator | None",
+                            *, is_seed: bool = False) -> bool:
+        """Run the download into ``store``; returns from_p2p. Publishes piece
+        events to the broker so SyncPieceTasks children see pieces live."""
+
+        async def on_piece(st, rec) -> None:
+            m = st.metadata
+            self.broker.publish(task_id, PieceEvent(
+                [rec.num], m.total_piece_count, m.content_length, m.piece_size))
+            if progress_q is not None:
+                await progress_q.on_piece(st, rec)
+
+        use_p2p = self.scheduler_client is not None and self.conductor_factory is not None
+        if use_p2p:
+            conductor = self.conductor_factory(
+                task_id=task_id, peer_id=peer_id, request=req, store=store,
+                on_piece=on_piece, is_seed=is_seed,
+            )
+            await conductor.run()
+            return conductor.from_p2p
+        if req.disable_back_source:
+            raise DfError(Code.ClientBackSourceError,
+                          "no scheduler and back-to-source disabled")
+        await self.piece_manager.download_source(
+            store, req.url, req.meta.header,
+            content_range=req.range,
+            on_piece=on_piece,
+            limiter=self.limiter,
+        )
+        return False
 
     # -- file task (reference peertask_manager.go:328) ---------------------
 
@@ -106,17 +149,27 @@ class TaskManager:
         if reused is not None:
             log.info("reusing completed task", task_id=task_id[:16])
             reused.store_to(req.output)
-            yield FileTaskProgress(
-                state="done",
-                task_id=task_id,
-                peer_id=peer_id,
-                content_length=reused.metadata.content_length,
-                completed_length=reused.metadata.content_length,
-                piece_count=len(reused.metadata.pieces),
-                total_piece_count=reused.metadata.total_piece_count,
-                digest=reused.metadata.digest,
-                from_reuse=True,
-            )
+            yield self._final_progress(reused, task_id, peer_id, from_reuse=True)
+            return
+
+        # 2. Dedup: piggyback on a running conductor for the same task
+        # (reference getOrCreatePeerTaskConductor :201).
+        running = self._running.get(task_id)
+        if running is not None:
+            log.info("waiting on running task", task_id=task_id[:16])
+            await running.done.wait()
+            if running.error is not None:
+                yield FileTaskProgress(state="failed", task_id=task_id, peer_id=peer_id,
+                                       error=running.error.to_wire())
+                return
+            store = self.storage.find_completed_task(task_id)
+            if store is None:
+                yield FileTaskProgress(
+                    state="failed", task_id=task_id, peer_id=peer_id,
+                    error=DfError(Code.UnknownError, "dedup race: no store").to_wire())
+                return
+            store.store_to(req.output)
+            yield self._final_progress(store, task_id, peer_id, from_reuse=True)
             return
 
         store = self.storage.register_task(
@@ -129,33 +182,18 @@ class TaskManager:
                 header=dict(req.meta.header),
             )
         )
-
-        # 2. P2P via scheduler when wired (stage 3 conductor), else origin.
-        use_p2p = self.scheduler_client is not None and self.conductor_factory is not None
+        run = _RunningTask(store)
+        self._running[task_id] = run
         progress_q = _ProgressAggregator(task_id, peer_id, store)
-        store.pin()  # GC must not reclaim the store mid-download
+        store.pin()
+        from_p2p = False
+        download = asyncio.ensure_future(
+            self._run_download(task_id, peer_id, req, store, progress_q))
         try:
-            if use_p2p:
-                conductor = self.conductor_factory(
-                    task_id=task_id, peer_id=peer_id, request=req, store=store,
-                    on_piece=progress_q.on_piece,
-                )
-                async for p in self._run_with_progress(conductor.run(), progress_q):
-                    yield p
-            else:
-                if req.disable_back_source:
-                    raise DfError(Code.ClientBackSourceError,
-                                  "no scheduler and back-to-source disabled")
-                coro = self.piece_manager.download_source(
-                    store, req.url, req.meta.header,
-                    content_range=req.range,
-                    on_piece=progress_q.on_piece,
-                    limiter=self.limiter,
-                )
-                async for p in self._run_with_progress(coro, progress_q):
-                    yield p
-            # 3. Verify + land output (inside the same failure envelope: a
-            # digest mismatch must invalidate the store like any other error).
+            async for p in self._stream_progress(download, progress_q):
+                yield p
+            from_p2p = download.result()
+            # Verify + land output inside the same failure envelope.
             if req.meta.digest:
                 store.validate_digest(req.meta.digest)
                 store.metadata.digest = req.meta.digest
@@ -163,55 +201,120 @@ class TaskManager:
             store.store_to(req.output)
         except DfError as e:
             store.mark_invalid()
+            run.error = e
+            self.broker.publish(task_id, PieceEvent([], failed=True))
             yield FileTaskProgress(state="failed", task_id=task_id, peer_id=peer_id,
                                    error=e.to_wire())
             return
         except Exception as e:  # pragma: no cover - defensive
             log.error("file task crashed", exc_info=True)
             store.mark_invalid()
+            run.error = DfError(Code.UnknownError, str(e))
+            self.broker.publish(task_id, PieceEvent([], failed=True))
             yield FileTaskProgress(state="failed", task_id=task_id, peer_id=peer_id,
-                                   error=DfError(Code.UnknownError, str(e)).to_wire())
+                                   error=run.error.to_wire())
             return
         finally:
+            # Early generator close (client disconnect) must not leave the
+            # download running against an unpinned, deregistered store.
+            if not download.done():
+                download.cancel()
+                try:
+                    await download
+                except BaseException:
+                    pass
+                if run.error is None:
+                    run.error = DfError(Code.ClientContextCanceled,
+                                        "download aborted by client")
+                store.mark_invalid()
+                self.broker.publish(task_id, PieceEvent([], failed=True))
             store.unpin()
+            run.done.set()
+            self._running.pop(task_id, None)
 
-        yield FileTaskProgress(
+        self.broker.publish(task_id, PieceEvent(
+            [], store.metadata.total_piece_count, store.metadata.content_length,
+            store.metadata.piece_size, done=True))
+        yield self._final_progress(store, task_id, peer_id, from_p2p=from_p2p)
+
+    # -- seed task (reference StartSeedTask :401 + seeder ObtainSeeds) -----
+
+    async def start_seed_task(self, spec: dict) -> None:
+        """Seed this daemon with a task (scheduler trigger). Runs inline;
+        callers fire it as a background task."""
+        meta = UrlMeta(
+            digest=spec.get("digest", ""),
+            tag=spec.get("tag", ""),
+            application=spec.get("application", ""),
+            header=spec.get("header") or {},
+            filter="&".join(spec.get("filters") or []),
+        )
+        req = FileTaskRequest(url=spec.get("url", ""), output="", meta=meta)
+        task_id = spec.get("task_id") or req.task_id()
+        if task_id in self._running:
+            return  # already seeding
+        peer_id = idgen.seed_peer_id_v1(self.host_ip)
+
+        store = self.storage.register_task(
+            TaskStoreMetadata(task_id=task_id, peer_id=peer_id, url=req.url,
+                              tag=meta.tag, application=meta.application,
+                              header=dict(meta.header)))
+        run = _RunningTask(store)
+        self._running[task_id] = run
+        store.pin()
+        try:
+            await self._run_download(task_id, peer_id, req, store, None, is_seed=True)
+            store.mark_done()
+            self.broker.publish(task_id, PieceEvent(
+                [], store.metadata.total_piece_count, store.metadata.content_length,
+                store.metadata.piece_size, done=True))
+            log.info("seed task complete", task_id=task_id[:16],
+                     pieces=len(store.metadata.pieces))
+        except Exception as e:
+            log.error("seed task failed", error=str(e))
+            store.mark_invalid()
+            run.error = e if isinstance(e, DfError) else DfError(Code.UnknownError, str(e))
+            self.broker.publish(task_id, PieceEvent([], failed=True))
+        finally:
+            store.unpin()
+            run.done.set()
+            self._running.pop(task_id, None)
+
+    def is_task_running(self, task_id: str) -> bool:
+        return task_id in self._running
+
+    # -- helpers -----------------------------------------------------------
+
+    def _final_progress(self, store, task_id: str, peer_id: str, *,
+                        from_reuse: bool = False, from_p2p: bool = False) -> FileTaskProgress:
+        m = store.metadata
+        return FileTaskProgress(
             state="done",
             task_id=task_id,
             peer_id=peer_id,
-            content_length=store.metadata.content_length,
+            content_length=m.content_length,
             completed_length=store.downloaded_bytes(),
-            piece_count=len(store.metadata.pieces),
-            total_piece_count=store.metadata.total_piece_count,
-            digest=store.metadata.digest,
-            from_p2p=use_p2p,
+            piece_count=len(m.pieces),
+            total_piece_count=m.total_piece_count,
+            digest=m.digest,
+            from_reuse=from_reuse,
+            from_p2p=from_p2p,
         )
 
-    async def _run_with_progress(self, coro, progress_q: "_ProgressAggregator"):
-        """Run the download while yielding progress snapshots as pieces land."""
-        import asyncio
-
-        task = asyncio.ensure_future(coro)
-        try:
-            while True:
-                snap = await progress_q.next_or_done(task)
-                if snap is not None:
-                    yield snap
-                if task.done():
-                    task.result()  # re-raise
-                    # drain any trailing progress
-                    while (s := progress_q.try_next()) is not None:
-                        yield s
-                    return
-        finally:
-            if not task.done():
-                task.cancel()
+    async def _stream_progress(self, task: asyncio.Task, progress_q: "_ProgressAggregator"):
+        while True:
+            snap = await progress_q.next_or_done(task)
+            if snap is not None:
+                yield snap
+            if task.done():
+                task.result()  # re-raise
+                while (s := progress_q.try_next()) is not None:
+                    yield s
+                return
 
 
 class _ProgressAggregator:
     def __init__(self, task_id: str, peer_id: str, store):
-        import asyncio
-
         self.task_id = task_id
         self.peer_id = peer_id
         self.store = store
@@ -243,8 +346,6 @@ class _ProgressAggregator:
         return None
 
     async def next_or_done(self, task) -> FileTaskProgress | None:
-        import asyncio
-
         waiter = asyncio.ensure_future(self._event.wait())
         try:
             await asyncio.wait({waiter, task}, return_when=asyncio.FIRST_COMPLETED)

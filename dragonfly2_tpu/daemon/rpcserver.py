@@ -8,6 +8,8 @@ host; the peer service faces other daemons (stage 3).
 
 from __future__ import annotations
 
+import asyncio
+
 from dragonfly2_tpu.daemon.peer.task_manager import FileTaskRequest, TaskManager
 from dragonfly2_tpu.pkg import dflog
 from dragonfly2_tpu.pkg.errors import Code, DfError
@@ -31,6 +33,12 @@ class DaemonRpcServer:
         self.download_server.register_unary("Daemon.StatTask", self._stat_task)
         self.download_server.register_unary("Daemon.DeleteTask", self._delete_task)
         self.download_server.register_unary("Daemon.Health", self._health)
+        # Peer-facing service (reference rpcserver.go peer server): piece
+        # availability sync for children + seed triggering by the scheduler.
+        self.peer_server.register_stream("Peer.SyncPieceTasks", self._sync_piece_tasks)
+        self.peer_server.register_unary("Peer.GetPieceTasks", self._get_piece_tasks)
+        self.peer_server.register_unary("Peer.TriggerDownloadTask", self._trigger_download)
+        self.peer_server.register_unary("Daemon.Health", self._health)
 
     async def serve_download(self, addr: NetAddr) -> None:
         await self.download_server.serve(addr)
@@ -86,3 +94,75 @@ class DaemonRpcServer:
 
     async def _health(self, body, ctx: RpcContext):
         return {"ok": True, "version": "0.1.0"}
+
+    # -- peer service ------------------------------------------------------
+
+    def _piece_snapshot(self, task_id: str) -> dict | None:
+        store = self.task_manager.storage.try_get(task_id)
+        if store is None:
+            return None
+        m = store.metadata
+        return {
+            "pieces": sorted(m.pieces.keys()),
+            "total_piece_count": m.total_piece_count,
+            "content_length": m.content_length,
+            "piece_size": m.piece_size,
+            "done": m.done,
+        }
+
+    async def _sync_piece_tasks(self, stream: ServerStream, ctx: RpcContext) -> None:
+        """Serve piece availability to a child peer, pushing updates as
+        pieces land (reference rpcserver.go:277 SyncPieceTasks +
+        subscriber.go push)."""
+        body = stream.open_body or {}
+        task_id = body.get("task_id", "")
+        snapshot = self._piece_snapshot(task_id)
+        running = self.task_manager.is_task_running(task_id)
+        if snapshot is None and not running:
+            raise DfError(Code.StorageTaskNotFound, f"task {task_id} not on this peer")
+        broker = self.task_manager.broker
+        q = broker.subscribe(task_id)
+        try:
+            if snapshot is not None:
+                await stream.send(snapshot)
+                if snapshot["done"]:
+                    return
+            while True:
+                event = await q.get()
+                if event.failed:
+                    raise DfError(Code.ClientPieceDownloadFail,
+                                  "parent download failed")
+                await stream.send({
+                    "pieces": event.piece_nums,
+                    "total_piece_count": event.total_piece_count,
+                    "content_length": event.content_length,
+                    "piece_size": event.piece_size,
+                    "done": event.done,
+                })
+                if event.done:
+                    return
+        finally:
+            broker.unsubscribe(task_id, q)
+
+    async def _get_piece_tasks(self, body, ctx: RpcContext):
+        """One-shot piece listing (reference rpcserver.go:160 GetPieceTasks)."""
+        task_id = (body or {}).get("task_id", "")
+        snapshot = self._piece_snapshot(task_id)
+        if snapshot is None:
+            raise DfError(Code.StorageTaskNotFound, f"task {task_id} not on this peer")
+        return snapshot
+
+    async def _trigger_download(self, body, ctx: RpcContext):
+        """Scheduler asks this (seed) daemon to fetch a task from origin
+        (reference seeder.go:56 ObtainSeeds / v2 DownloadTask)."""
+        spec = body or {}
+        if not spec.get("url"):
+            raise DfError(Code.BadRequest, "url required")
+        task_id = spec.get("task_id", "")
+        already = bool(task_id and
+                       self.task_manager.storage.find_completed_task(task_id) is not None)
+        if not (task_id and self.task_manager.is_task_running(task_id)):
+            # Runs even when complete: the announce-only fast path re-reports
+            # local pieces so the scheduler can hand this seed out as parent.
+            asyncio.ensure_future(self.task_manager.start_seed_task(spec))
+        return {"ok": True, "already_complete": already}

@@ -1,0 +1,61 @@
+"""Daemon-side scheduler client.
+
+Reference: pkg/rpc/scheduler/client — consistent-hash pick of a scheduler
+per task (pkg/balancer/consistent_hashing.go) + the AnnouncePeer stream
+wrapper the conductor drives.
+"""
+
+from __future__ import annotations
+
+from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg.errors import Code, DfError
+from dragonfly2_tpu.pkg.types import NetAddr
+from dragonfly2_tpu.rpc import Client, ClientStream
+from dragonfly2_tpu.rpc.balancer import HashRing
+
+log = dflog.get("daemon.schedulerclient")
+
+
+class SchedulerClient:
+    def __init__(self, addrs: list[str]):
+        if not addrs:
+            raise DfError(Code.BadRequest, "no scheduler addresses")
+        self._ring = HashRing(addrs)
+        self._clients: dict[str, Client] = {}
+
+    def _client_for(self, task_id: str) -> Client:
+        return self._client_for_addr(self._ring.pick(task_id))
+
+    async def open_announce_stream(self, open_body: dict) -> ClientStream:
+        cli = self._client_for(open_body["task_id"])
+        return await cli.open_stream("Scheduler.AnnouncePeer", open_body)
+
+    async def announce_host(self, host_wire: dict) -> None:
+        # Host announcements go to every scheduler (each keeps its own view).
+        for addr in self._ring.members():
+            try:
+                await self._client_for_addr(addr).call("Scheduler.AnnounceHost", host_wire,
+                                                       timeout=10.0)
+            except DfError as e:
+                log.warning("announce host failed", addr=addr, error=e.message)
+
+    async def leave_host(self, host_id: str) -> None:
+        for addr in self._ring.members():
+            try:
+                await self._client_for_addr(addr).call("Scheduler.LeaveHost", {"id": host_id},
+                                                       timeout=5.0)
+            except DfError:
+                pass
+
+    def _client_for_addr(self, addr: str) -> Client:
+        cli = self._clients.get(addr)
+        if cli is None:
+            host, _, port = addr.rpartition(":")
+            cli = Client(NetAddr.tcp(host, int(port)))
+            self._clients[addr] = cli
+        return cli
+
+    async def close(self) -> None:
+        for cli in self._clients.values():
+            await cli.close()
+        self._clients.clear()

@@ -1,0 +1,115 @@
+"""Upload server: HTTP endpoint other peers hit for piece payloads.
+
+Reference: client/daemon/upload/upload_manager.go — gin server with
+``GET /download/:task_prefix/:task_id`` + Range header (:181-188), rate
+limiting (WithLimiter :79). Piece payloads ride HTTP (not drpc) exactly like
+the reference, so transfers stream zero-copy from the page cache via
+sendfile-ish paths and any HTTP client can fetch.
+
+Routes:
+  GET /download/{task_prefix}/{task_id}?peerId=...          Range: bytes=a-b
+  GET /download/{task_prefix}/{task_id}?peerId=...&pieceNum=N   (whole piece)
+  GET /metrics, GET /healthy
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from aiohttp import web
+
+from dragonfly2_tpu.pkg import dflog, metrics
+from dragonfly2_tpu.pkg.piece import Range
+from dragonfly2_tpu.pkg.ratelimit import Limiter
+from dragonfly2_tpu.storage import StorageManager
+
+log = dflog.get("daemon.upload")
+
+UPLOAD_BYTES = metrics.counter("upload_bytes_total", "Piece bytes served to other peers")
+UPLOAD_REQUESTS = metrics.counter("upload_requests_total", "Piece upload requests", ("result",))
+CONCURRENT_UPLOADS = metrics.gauge("upload_concurrency", "In-flight piece uploads")
+
+
+class UploadManager:
+    def __init__(self, storage: StorageManager, *, rate_limit: int = 0,
+                 concurrent_limit: int = 0):
+        self.storage = storage
+        self.limiter = Limiter(rate_limit if rate_limit > 0 else float("inf"))
+        self.concurrent_limit = concurrent_limit
+        self.concurrent = 0
+        self._runner: web.AppRunner | None = None
+        self._port = 0
+
+    async def serve(self, host: str, port: int = 0) -> int:
+        app = web.Application()
+        app.router.add_get("/download/{task_prefix}/{task_id}", self._download)
+        app.router.add_get("/healthy", self._healthy)
+        app.router.add_get("/metrics", self._metrics)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self._port = site._server.sockets[0].getsockname()[1]
+        log.info("upload server up", port=self._port)
+        return self._port
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # -- handlers ----------------------------------------------------------
+
+    async def _download(self, request: web.Request) -> web.StreamResponse:
+        task_id = request.match_info["task_id"]
+        store = self.storage.try_get(task_id)
+        if store is None:
+            UPLOAD_REQUESTS.labels("not_found").inc()
+            raise web.HTTPNotFound(text=f"task {task_id} not found")
+        if self.concurrent_limit and self.concurrent >= self.concurrent_limit:
+            UPLOAD_REQUESTS.labels("throttled").inc()
+            raise web.HTTPTooManyRequests()
+
+        self.concurrent += 1
+        CONCURRENT_UPLOADS.inc()
+        store.pin()
+        try:
+            piece_num = request.query.get("pieceNum")
+            if piece_num is not None:
+                try:
+                    data = store.read_piece(int(piece_num))
+                except Exception:
+                    UPLOAD_REQUESTS.labels("piece_missing").inc()
+                    raise web.HTTPNotFound(text=f"piece {piece_num} not found")
+            else:
+                rng_header = request.headers.get("Range")
+                if not rng_header:
+                    UPLOAD_REQUESTS.labels("bad_request").inc()
+                    raise web.HTTPBadRequest(text="Range or pieceNum required")
+                try:
+                    rng = Range.parse_http(rng_header, store.metadata.content_length)
+                except ValueError as e:
+                    UPLOAD_REQUESTS.labels("bad_request").inc()
+                    raise web.HTTPBadRequest(text=str(e))
+                data = store.read_range(rng.start, rng.length)
+                if len(data) != rng.length:
+                    UPLOAD_REQUESTS.labels("piece_missing").inc()
+                    raise web.HTTPRequestRangeNotSatisfiable()
+            await self.limiter.wait(len(data))
+            UPLOAD_BYTES.inc(len(data))
+            UPLOAD_REQUESTS.labels("ok").inc()
+            return web.Response(body=data, status=200)
+        finally:
+            store.unpin()
+            self.concurrent -= 1
+            CONCURRENT_UPLOADS.dec()
+
+    async def _healthy(self, request: web.Request) -> web.Response:
+        return web.Response(text="ok")
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        body, ctype = metrics.render()
+        return web.Response(body=body, content_type=ctype.split(";")[0])

@@ -1,0 +1,1 @@
+"""Scheduler: per-cluster control plane (reference: scheduler/)."""

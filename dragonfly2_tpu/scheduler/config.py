@@ -1,0 +1,80 @@
+"""Scheduler configuration and tuning constants.
+
+Reference: scheduler/config/config.go + constants.go:26-107 (the numbers
+that shape scheduling behavior). TPU addition: topology affinity weights for
+ICI/DCN-aware parent selection (BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import yaml
+
+# Reference scheduler/config/constants.go values.
+SEED_PEER_CONCURRENT_UPLOAD_LIMIT = 2000   # :26-28
+PEER_CONCURRENT_UPLOAD_LIMIT = 200         # :29-31
+CANDIDATE_PARENT_LIMIT = 4                 # :32-34
+FILTER_PARENT_LIMIT = 15                   # :35-37
+TASK_BACK_TO_SOURCE_PEER_COUNT = 200       # :59-61
+RETRY_LIMIT = 5                            # :64-65
+RETRY_BACK_TO_SOURCE_LIMIT = 4             # :66-67
+RETRY_INTERVAL = 0.5                       # :68-70 (500ms)
+PIECE_DOWNLOAD_TIMEOUT = 30 * 60.0         # :71-73
+PEER_TTL = 24 * 3600.0                     # :77-79
+HOST_TTL = 3600.0                          # :86-88 (reference 1h)
+TASK_TTL = 24 * 3600.0
+
+
+@dataclass
+class SchedulerServerConfig:
+    host: str = "127.0.0.1"
+    port: int = 8002                       # reference DefaultPort (constants.go:42)
+    advertise_ip: str = ""
+
+
+@dataclass
+class SchedulingConfig:
+    candidate_parent_limit: int = CANDIDATE_PARENT_LIMIT
+    filter_parent_limit: int = FILTER_PARENT_LIMIT
+    retry_limit: int = RETRY_LIMIT
+    retry_back_to_source_limit: int = RETRY_BACK_TO_SOURCE_LIMIT
+    retry_interval: float = RETRY_INTERVAL
+    back_to_source_count: int = TASK_BACK_TO_SOURCE_PEER_COUNT
+    # Evaluator weights (reference evaluator_base.go:28-46); topology terms
+    # replace IDC/location weighting when TPU topology metadata is present.
+    weight_finished_pieces: float = 0.2
+    weight_upload_success: float = 0.2
+    weight_free_upload: float = 0.15
+    weight_host_type: float = 0.15
+    weight_idc_affinity: float = 0.15
+    weight_location_affinity: float = 0.15
+
+
+@dataclass
+class GCConfig:
+    peer_ttl: float = PEER_TTL
+    host_ttl: float = HOST_TTL
+    task_ttl: float = TASK_TTL
+    interval: float = 60.0
+
+
+@dataclass
+class SchedulerConfig:
+    server: SchedulerServerConfig = field(default_factory=SchedulerServerConfig)
+    scheduling: SchedulingConfig = field(default_factory=SchedulingConfig)
+    gc: GCConfig = field(default_factory=GCConfig)
+    manager_addr: str = ""                 # manager drpc for registration
+    cluster_id: int = 1
+    metrics_port: int = 0
+    seed_peer_enabled: bool = True
+
+    @classmethod
+    def load(cls, path: str) -> "SchedulerConfig":
+        with open(path) as f:
+            data = yaml.safe_load(f) or {}
+        cfg = cls()
+        from dragonfly2_tpu.daemon.config import _merge_dataclass
+
+        _merge_dataclass(cfg, data)
+        return cfg

@@ -1,0 +1,16 @@
+"""Scheduler resource model (reference: scheduler/resource/standard)."""
+
+from dragonfly2_tpu.scheduler.resource.host import Host, HostManager
+from dragonfly2_tpu.scheduler.resource.task import Task, TaskManager, TaskState
+from dragonfly2_tpu.scheduler.resource.peer import Peer, PeerManager, PeerState
+
+__all__ = [
+    "Host",
+    "HostManager",
+    "Task",
+    "TaskManager",
+    "TaskState",
+    "Peer",
+    "PeerManager",
+    "PeerState",
+]

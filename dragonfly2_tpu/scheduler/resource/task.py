@@ -1,0 +1,186 @@
+"""Task: one piece of content being distributed; holds the peer DAG.
+
+Reference: scheduler/resource/standard/task.go — FSM Pending/Running/
+Succeeded/Failed/Leave (:58-84, transitions :197-219), the peer DAG
+(:154-155, edge maintenance :312-353), SizeScope (:468-490), back-to-source
+peer accounting.
+"""
+
+from __future__ import annotations
+
+import time
+
+from dragonfly2_tpu.pkg.dag import DAG
+from dragonfly2_tpu.pkg.fsm import FSM, EventDesc
+from dragonfly2_tpu.pkg.piece import PieceInfo, SizeScope
+
+
+class TaskState:
+    PENDING = "pending"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    LEAVE = "leave"
+
+
+_TASK_EVENTS = [
+    EventDesc("download", (TaskState.PENDING, TaskState.FAILED, TaskState.SUCCEEDED), TaskState.RUNNING),
+    EventDesc("download_succeeded", (TaskState.RUNNING, TaskState.FAILED), TaskState.SUCCEEDED),
+    EventDesc("download_failed", (TaskState.RUNNING,), TaskState.FAILED),
+    EventDesc("leave", (TaskState.PENDING, TaskState.RUNNING, TaskState.SUCCEEDED, TaskState.FAILED),
+              TaskState.LEAVE),
+]
+
+
+class Task:
+    def __init__(self, task_id: str, url: str = "", *, tag: str = "", application: str = "",
+                 digest: str = "", filtered_query_params: list[str] | None = None,
+                 header: dict | None = None, back_to_source_limit: int = 200):
+        self.id = task_id
+        self.url = url
+        self.tag = tag
+        self.application = application
+        self.digest = digest
+        self.filtered_query_params = filtered_query_params or []
+        self.header = header or {}
+        self.content_length = -1
+        self.piece_size = 0
+        self.total_piece_count = -1
+        self.pieces: dict[int, PieceInfo] = {}   # known piece metadata
+        self.fsm = FSM(TaskState.PENDING, _TASK_EVENTS)
+        self.dag: DAG = DAG()                    # peer tree: parent → child
+        self.back_to_source_limit = back_to_source_limit
+        self.back_to_source_peers: set[str] = set()
+        self.created_at = time.time()
+        self.updated_at = time.time()
+
+    # -- state -------------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        return self.fsm.current
+
+    def touch(self) -> None:
+        self.updated_at = time.time()
+
+    def size_scope(self) -> str:
+        return SizeScope.of(self.content_length, self.piece_size, self.total_piece_count)
+
+    def has_available_peer(self, blocklist: set[str] | None = None) -> bool:
+        """Any finished/running peer that could serve pieces
+        (reference task.go HasAvailablePeer)."""
+        blocklist = blocklist or set()
+        from dragonfly2_tpu.scheduler.resource.peer import PeerState
+
+        for peer in self.dag.values():
+            if peer.id in blocklist:
+                continue
+            if peer.fsm.current in (PeerState.RUNNING, PeerState.BACK_TO_SOURCE,
+                                    PeerState.SUCCEEDED) and peer.finished_pieces:
+                return True
+            if peer.fsm.current == PeerState.SUCCEEDED:
+                return True
+        return False
+
+    def can_back_to_source(self) -> bool:
+        """Bounded number of peers may hit origin
+        (reference task.go CanBackToSource)."""
+        return len(self.back_to_source_peers) < self.back_to_source_limit
+
+    # -- peer DAG (reference task.go:154,312-353) --------------------------
+
+    def add_peer(self, peer) -> None:
+        if not self.dag.has_vertex(peer.id):
+            self.dag.add_vertex(peer.id, peer)
+
+    def delete_peer(self, peer_id: str) -> None:
+        self.dag.delete_vertex(peer_id)
+
+    def load_peer(self, peer_id: str):
+        if not self.dag.has_vertex(peer_id):
+            return None
+        return self.dag.get_vertex(peer_id).value
+
+    def peers(self) -> list:
+        return list(self.dag.values())
+
+    def peer_count(self) -> int:
+        return self.dag.vertex_count()
+
+    def add_peer_edge(self, parent_id: str, child_id: str) -> None:
+        self.dag.add_edge(parent_id, child_id)
+
+    def delete_peer_in_edges(self, peer_id: str) -> None:
+        """Detach a peer from its parents before rescheduling
+        (reference task.go DeletePeerInEdges)."""
+        self.dag.delete_vertex_in_edges(peer_id)
+
+    def delete_peer_out_edges(self, peer_id: str) -> None:
+        self.dag.delete_vertex_out_edges(peer_id)
+
+    def can_add_peer_edge(self, parent_id: str, child_id: str) -> bool:
+        return self.dag.can_add_edge(parent_id, child_id)
+
+    def peer_out_degree(self, peer_id: str) -> int:
+        return self.dag.get_vertex(peer_id).out_degree()
+
+    # -- piece metadata ----------------------------------------------------
+
+    def store_piece(self, piece: PieceInfo) -> None:
+        self.pieces.setdefault(piece.piece_num, piece)
+
+    def update_lengths(self, content_length: int, piece_size: int, total_piece_count: int) -> None:
+        if content_length >= 0:
+            self.content_length = content_length
+        if piece_size > 0:
+            self.piece_size = piece_size
+        if total_piece_count >= 0:
+            self.total_piece_count = total_piece_count
+        self.touch()
+
+    def to_wire(self) -> dict:
+        return {
+            "id": self.id,
+            "url": self.url,
+            "tag": self.tag,
+            "application": self.application,
+            "state": self.state,
+            "content_length": self.content_length,
+            "piece_size": self.piece_size,
+            "total_piece_count": self.total_piece_count,
+            "peer_count": self.peer_count(),
+            "size_scope": self.size_scope(),
+        }
+
+
+class TaskManager:
+    """In-memory task registry with TTL GC (reference task_manager.go:134)."""
+
+    def __init__(self, ttl: float = 24 * 3600.0):
+        self._tasks: dict[str, Task] = {}
+        self._ttl = ttl
+
+    def load(self, task_id: str) -> Task | None:
+        return self._tasks.get(task_id)
+
+    def load_or_store(self, task: Task) -> Task:
+        existing = self._tasks.get(task.id)
+        if existing is not None:
+            existing.touch()
+            return existing
+        self._tasks[task.id] = task
+        return task
+
+    def delete(self, task_id: str) -> None:
+        self._tasks.pop(task_id, None)
+
+    def all(self) -> list[Task]:
+        return list(self._tasks.values())
+
+    def gc(self) -> list[str]:
+        now = time.time()
+        dead = [t.id for t in self._tasks.values()
+                if t.peer_count() == 0 and (now - t.updated_at) > self._ttl]
+        for tid in dead:
+            del self._tasks[tid]
+        return dead

@@ -1,0 +1,7 @@
+"""Scheduling core: candidate filtering, scoring, retry loop
+(reference: scheduler/scheduling)."""
+
+from dragonfly2_tpu.scheduler.scheduling.evaluator import Evaluator
+from dragonfly2_tpu.scheduler.scheduling.scheduling import Scheduling
+
+__all__ = ["Evaluator", "Scheduling"]

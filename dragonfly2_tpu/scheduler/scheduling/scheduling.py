@@ -1,0 +1,128 @@
+"""Scheduling core: the retry loop that assigns candidate parents.
+
+Reference: scheduler/scheduling/scheduling.go — ScheduleCandidateParents
+(v2, :85-213): loop up to RetryLimit { if task can back-to-source and peer
+needs it → NeedBackToSourceResponse; filter candidates (:500-577) → score →
+push CandidateParents; sleep RetryInterval }, with the back-to-source
+fallback after RetryBackToSourceLimit tries; FindCandidateParents (:384-423)
+samples the task DAG up to FilterParentLimit and filters unusable parents.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.scheduler.config import SchedulingConfig
+from dragonfly2_tpu.scheduler.resource.peer import Peer, PeerState
+from dragonfly2_tpu.scheduler.scheduling.evaluator import Evaluator
+
+log = dflog.get("scheduler.scheduling")
+
+
+class ScheduleResult:
+    """What the service layer should tell the peer."""
+
+    CANDIDATES = "candidates"
+    NEED_BACK_SOURCE = "need_back_source"
+    FAILED = "failed"
+
+    def __init__(self, kind: str, parents: list[Peer] | None = None, reason: str = ""):
+        self.kind = kind
+        self.parents = parents or []
+        self.reason = reason
+
+
+class Scheduling:
+    def __init__(self, config: SchedulingConfig | None = None, evaluator: Evaluator | None = None):
+        self.config = config or SchedulingConfig()
+        self.evaluator = evaluator or Evaluator(self.config)
+
+    # -- v2-style scheduling (reference :85-213) ---------------------------
+
+    async def schedule_candidate_parents(self, peer: Peer,
+                                         blocklist: set[str] | None = None,
+                                         allow_back_source: bool = True) -> ScheduleResult:
+        """Retry loop: find parents for ``peer`` or fall back to source.
+
+        Parents are checked FIRST each attempt — the back-to-source demotion
+        only fires when an attempt at/after RetryBackToSourceLimit found
+        nothing (a fresh seed's pieces must win over a redundant origin
+        fetch). ``allow_back_source=False`` lets the service hold a peer in
+        the retry loop while a seed is known to be actively seeding.
+        """
+        blocklist = set(blocklist or ())
+        blocklist |= peer.block_parents
+        cfg = self.config
+        task = peer.task
+
+        for attempt in range(cfg.retry_limit):
+            parents = self.find_candidate_parents(peer, blocklist)
+            if parents:
+                return ScheduleResult(ScheduleResult.CANDIDATES, parents)
+            if (allow_back_source
+                    and attempt + 1 >= cfg.retry_back_to_source_limit
+                    and task.can_back_to_source()
+                    and peer.fsm.can("download_back_to_source")):
+                return ScheduleResult(ScheduleResult.NEED_BACK_SOURCE,
+                                      reason=f"no parents after {attempt + 1} tries")
+            await asyncio.sleep(cfg.retry_interval)
+
+        if allow_back_source and task.can_back_to_source() \
+                and peer.fsm.can("download_back_to_source"):
+            return ScheduleResult(ScheduleResult.NEED_BACK_SOURCE,
+                                  reason="retry limit reached")
+        return ScheduleResult(ScheduleResult.FAILED,
+                              reason=f"no candidate parents after {cfg.retry_limit} tries")
+
+    # -- candidate selection (reference :384-423 + :500-577) ---------------
+
+    def find_candidate_parents(self, peer: Peer, blocklist: set[str] | None = None) -> list[Peer]:
+        task = peer.task
+        blocklist = blocklist or set()
+        sample = task.dag.random_vertices(self.config.filter_parent_limit)
+        candidates = [
+            v.value for v in sample
+            if self._is_candidate(v.value, peer, blocklist)
+        ]
+        if not candidates:
+            return []
+        ranked = self.evaluator.evaluate_parents(candidates, peer, task.total_piece_count)
+        return ranked[: self.config.candidate_parent_limit]
+
+    def _is_candidate(self, parent: Peer, child: Peer, blocklist: set[str]) -> bool:
+        """Filter rules (reference filterCandidateParents :500-577)."""
+        if parent.id == child.id or parent.id in blocklist:
+            return False
+        if parent.host.id == child.host.id:
+            return False  # same host serves via local reuse, not P2P
+        if parent.fsm.current not in (PeerState.RUNNING, PeerState.BACK_TO_SOURCE,
+                                      PeerState.SUCCEEDED):
+            return False
+        if parent.fsm.current != PeerState.SUCCEEDED and parent.finished_piece_count() == 0:
+            return False  # nothing to serve yet
+        if parent.host.free_upload_count() <= 0:
+            return False
+        if self.evaluator.is_bad_node(parent):
+            return False
+        # DAG sanity: adding child under parent must not create a cycle or a
+        # duplicate edge (edge add happens on piece download start).
+        if not child.task.can_add_peer_edge(parent.id, child.id):
+            # Allow re-offering an existing parent (edge already present).
+            vertex_ok = (
+                child.task.dag.has_vertex(parent.id)
+                and child.id in child.task.dag.get_vertex(parent.id).children
+            )
+            if not vertex_ok:
+                return False
+        return True
+
+    # -- edge bookkeeping on reschedule (reference :164-208) ---------------
+
+    def reattach_peer(self, peer: Peer, new_parents: list[Peer]) -> None:
+        """Replace the peer's parent edges with the newly scheduled set."""
+        task = peer.task
+        task.delete_peer_in_edges(peer.id)
+        for parent in new_parents:
+            if task.can_add_peer_edge(parent.id, peer.id):
+                task.add_peer_edge(parent.id, peer.id)

@@ -1,0 +1,43 @@
+"""Seed-peer client pool: scheduler → seed daemon trigger calls.
+
+Reference: scheduler/resource/standard/seed_peer.go + seed_peer_client.go —
+TriggerDownloadTask asks a seed daemon to fetch a task from origin on behalf
+of the cluster (the ObtainSeeds/v2 DownloadTask path).
+"""
+
+from __future__ import annotations
+
+from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg.types import NetAddr
+from dragonfly2_tpu.rpc import Client
+
+log = dflog.get("scheduler.seed_client")
+
+
+class SeedPeerClientPool:
+    def __init__(self):
+        self._clients: dict[str, Client] = {}
+
+    def _client(self, ip: str, port: int) -> Client:
+        key = f"{ip}:{port}"
+        cli = self._clients.get(key)
+        if cli is None:
+            cli = Client(NetAddr.tcp(ip, port))
+            self._clients[key] = cli
+        return cli
+
+    async def trigger_download_task(self, host, task_spec: dict) -> bool:
+        """Fire-and-forget trigger; the seed reports progress through its own
+        AnnouncePeer stream. Returns False if the seed is unreachable."""
+        cli = self._client(host.ip, host.port)
+        try:
+            resp = await cli.call("Peer.TriggerDownloadTask", task_spec, timeout=10.0)
+            return bool(resp and resp.get("ok"))
+        except Exception as e:
+            log.warning("seed trigger failed", seed=host.id, error=str(e))
+            return False
+
+    async def close(self) -> None:
+        for cli in self._clients.values():
+            await cli.close()
+        self._clients.clear()

@@ -1,0 +1,65 @@
+"""Scheduler server bootstrap.
+
+Reference: scheduler/scheduler.go:58-346 (wires dynconfig, resource, jobs,
+scheduling, gRPC + metrics servers; graceful stop) and
+scheduler/rpcserver/rpcserver.go:30-41 (servicer registration).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg.cache import GC, GCTask
+from dragonfly2_tpu.pkg.types import NetAddr
+from dragonfly2_tpu.rpc import Server
+from dragonfly2_tpu.scheduler.config import SchedulerConfig
+from dragonfly2_tpu.scheduler.service import SchedulerService
+
+log = dflog.get("scheduler.server")
+
+
+class SchedulerServer:
+    def __init__(self, config: SchedulerConfig | None = None):
+        self.config = config or SchedulerConfig()
+        self.service = SchedulerService(self.config)
+        self.rpc = Server("scheduler")
+        self._register()
+        self.gc = GC(log)
+        self.gc.add(GCTask("resource", self.config.gc.interval, 30.0, self._gc))
+        self._stopped = asyncio.Event()
+
+    def _register(self) -> None:
+        s = self.service
+        self.rpc.register_stream("Scheduler.AnnouncePeer", s.announce_peer)
+        self.rpc.register_unary("Scheduler.AnnounceHost", s.announce_host)
+        self.rpc.register_unary("Scheduler.LeaveHost", s.leave_host)
+        self.rpc.register_unary("Scheduler.LeavePeer", s.leave_peer)
+        self.rpc.register_unary("Scheduler.StatTask", s.stat_task)
+        self.rpc.register_unary("Scheduler.StatPeer", s.stat_peer)
+        self.rpc.register_unary("Scheduler.ListHosts", s.list_hosts)
+
+    async def _gc(self) -> None:
+        counts = self.service.gc()
+        if any(counts.values()):
+            log.info("resource gc", **counts)
+
+    async def serve(self) -> None:
+        await self.rpc.serve(NetAddr.tcp(self.config.server.host, self.config.server.port))
+        self.gc.serve()
+        log.info("scheduler up", port=self.port())
+        await self._stopped.wait()
+
+    async def start(self) -> None:
+        """Non-blocking variant for embedding in tests."""
+        await self.rpc.serve(NetAddr.tcp(self.config.server.host, self.config.server.port))
+        self.gc.serve()
+
+    def port(self) -> int:
+        return self.rpc.port()
+
+    async def stop(self) -> None:
+        self.gc.stop()
+        await self.service.seed_clients.close()
+        await self.rpc.close()
+        self._stopped.set()

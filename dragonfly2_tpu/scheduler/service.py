@@ -1,0 +1,438 @@
+"""Scheduler service: the AnnouncePeer stream and resource RPCs.
+
+Reference: scheduler/service/service_v2.go — AnnouncePeer bidi stream
+dispatching on typed requests (:84), handleRegisterPeerRequest (:991),
+handleDownloadPiece{Finished,Failed} (:1291-1455), handleResource (:1457,
+get/create host+task+peer), downloadTaskBySeedPeer (:1504, back-to-source
+dedup via seed triggering), plus StatPeer/StatTask/AnnounceHost/LeaveHost.
+
+Stream protocol (drpc "Scheduler.AnnouncePeer"):
+  open_body: {host:{...}, peer_id, task_id, url, tag, application, digest,
+              filters, header, priority, range, is_seed}
+  client → server: register | download_started | piece_finished |
+                   piece_failed | reschedule | download_finished |
+                   download_failed
+  server → client: empty_task | normal_task{task, parents} |
+                   need_back_source{reason} | schedule_failed{reason}
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg.errors import Code, DfError
+from dragonfly2_tpu.pkg.fsm import TransitionError
+from dragonfly2_tpu.pkg.piece import PieceInfo
+from dragonfly2_tpu.pkg.types import HostType
+from dragonfly2_tpu.rpc import RpcContext, ServerStream
+from dragonfly2_tpu.scheduler.config import SchedulerConfig
+from dragonfly2_tpu.scheduler.resource import (
+    Host,
+    HostManager,
+    Peer,
+    PeerManager,
+    PeerState,
+    Task,
+    TaskManager,
+    TaskState,
+)
+from dragonfly2_tpu.scheduler.scheduling import Scheduling
+from dragonfly2_tpu.scheduler.scheduling.scheduling import ScheduleResult
+from dragonfly2_tpu.scheduler.seed_client import SeedPeerClientPool
+
+log = dflog.get("scheduler.service")
+
+
+class SchedulerService:
+    def __init__(self, config: SchedulerConfig | None = None):
+        self.config = config or SchedulerConfig()
+        gc = self.config.gc
+        self.hosts = HostManager(ttl=gc.host_ttl)
+        self.tasks = TaskManager(ttl=gc.task_ttl)
+        self.peers = PeerManager(ttl=gc.peer_ttl)
+        self.scheduling = Scheduling(self.config.scheduling)
+        self.seed_clients = SeedPeerClientPool()
+
+    # ------------------------------------------------------------------ #
+    # resource resolution (reference handleResource :1457)
+    # ------------------------------------------------------------------ #
+
+    def _resolve(self, open_body: dict) -> tuple[Host, Task, Peer]:
+        h = open_body.get("host") or {}
+        host = self.hosts.load_or_store(
+            Host(
+                h.get("id") or h.get("hostname", "unknown"),
+                hostname=h.get("hostname", ""),
+                ip=h.get("ip", ""),
+                port=h.get("port", 0),
+                upload_port=h.get("upload_port", 0),
+                host_type=HostType(h.get("type", 0)),
+                idc=h.get("idc", ""),
+                location=h.get("location", ""),
+                tpu_slice=h.get("tpu_slice", ""),
+                tpu_worker_index=h.get("tpu_worker_index", -1),
+            )
+        )
+        # Keep ports fresh: a daemon restart re-announces with new ports.
+        host.port = h.get("port", host.port)
+        host.upload_port = h.get("upload_port", host.upload_port)
+
+        task = self.tasks.load_or_store(
+            Task(
+                open_body["task_id"],
+                url=open_body.get("url", ""),
+                tag=open_body.get("tag", ""),
+                application=open_body.get("application", ""),
+                digest=open_body.get("digest", ""),
+                filtered_query_params=open_body.get("filters") or [],
+                header=open_body.get("header") or {},
+                back_to_source_limit=self.config.scheduling.back_to_source_count,
+            )
+        )
+        peer = self.peers.load_or_store(
+            Peer(
+                open_body["peer_id"],
+                task,
+                host,
+                is_seed=bool(open_body.get("is_seed")),
+                priority=open_body.get("priority", 3),
+                range_header=open_body.get("range", ""),
+            )
+        )
+        return host, task, peer
+
+    # ------------------------------------------------------------------ #
+    # AnnouncePeer stream (reference service_v2.go:84)
+    # ------------------------------------------------------------------ #
+
+    async def announce_peer(self, stream: ServerStream, ctx: RpcContext) -> None:
+        open_body = stream.open_body or {}
+        if not open_body.get("task_id") or not open_body.get("peer_id"):
+            raise DfError(Code.BadRequest, "task_id and peer_id required")
+        host, task, peer = self._resolve(open_body)
+        peer.announce_stream = stream
+        log.info("announce peer", peer=peer.id[:24], task=task.id[:16],
+                 host=host.id, seed=peer.is_seed)
+        try:
+            while True:
+                msg = await stream.recv()
+                if msg is None:
+                    break
+                await self._dispatch(msg, task, peer)
+                if peer.is_done():
+                    break
+        finally:
+            peer.announce_stream = None
+            self._on_stream_gone(task, peer)
+
+    async def _dispatch(self, msg: dict, task: Task, peer: Peer) -> None:
+        kind = msg.get("type", "")
+        if kind == "register":
+            await self._handle_register(task, peer)
+        elif kind == "download_started":
+            self._handle_download_started(msg, task, peer)
+        elif kind == "piece_finished":
+            self._handle_piece_finished(msg, task, peer)
+        elif kind == "piece_failed":
+            self._handle_piece_failed(msg, task, peer)
+        elif kind == "reschedule":
+            await self._handle_reschedule(msg, task, peer)
+        elif kind == "download_finished":
+            self._handle_download_finished(msg, task, peer)
+        elif kind == "download_failed":
+            self._handle_download_failed(msg, task, peer)
+        else:
+            log.warning("unknown announce message", kind=kind, peer=peer.id[:24])
+
+    # -- register (reference handleRegisterPeerRequest :991) --------------
+
+    async def _handle_register(self, task: Task, peer: Peer) -> None:
+        # Empty-content shortcut (reference registerEmptyTask).
+        if task.content_length == 0:
+            peer.fsm.event("register_empty")
+            peer.fsm.event("download_succeeded")
+            await peer.announce_stream.send({"type": "empty_task"})
+            return
+
+        peer.fsm.event("register_normal")
+
+        # Seed peers and solo first-comers go straight to origin; everyone
+        # else gets parents (back-to-source dedup: ~1 origin fetch per task).
+        if peer.is_seed:
+            self._mark_task_running(task)
+            self._to_back_source(task, peer, "seed peer registration")
+            await peer.announce_stream.send(
+                {"type": "need_back_source", "reason": "seed peer", "task": task.to_wire()})
+            return
+
+        seeding = False
+        if task.fsm.current == TaskState.PENDING or not task.has_available_peer():
+            seeding = await self._maybe_trigger_seed(task, peer)
+            if not seeding:
+                if task.can_back_to_source():
+                    self._mark_task_running(task)
+                    self._to_back_source(task, peer, "first peer, no seed")
+                    await peer.announce_stream.send(
+                        {"type": "need_back_source", "reason": "first peer",
+                         "task": task.to_wire()})
+                    return
+                # Out of back-source budget and nothing running: fail fast.
+                self._fail_peer(peer)
+                await peer.announce_stream.send(
+                    {"type": "schedule_failed", "reason": "no sources available"})
+                return
+
+        # While a seed is actively fetching, hold the peer in the schedule
+        # loop instead of demoting it to a redundant origin fetch.
+        patience = 30.0 if seeding else 0.0
+        await self._schedule_and_send(task, peer, patience=patience)
+
+    def _seed_active(self, task: Task) -> bool:
+        return any(p.is_seed and not p.is_done() for p in task.peers())
+
+    async def _schedule_and_send(self, task: Task, peer: Peer, patience: float = 0.0) -> None:
+        deadline = asyncio.get_running_loop().time() + patience
+        seed_seen = False
+        while True:
+            active = self._seed_active(task)
+            seed_seen = seed_seen or active
+            # Hold while the (possibly still-registering) seed works; stop
+            # holding once a seen seed is done/failed or patience runs out.
+            hold = (asyncio.get_running_loop().time() < deadline
+                    and (active or not seed_seen))
+            result = await self.scheduling.schedule_candidate_parents(
+                peer, allow_back_source=not hold)
+            if result.kind != ScheduleResult.FAILED or not hold:
+                break
+        stream = peer.announce_stream
+        if stream is None:
+            return
+        if result.kind == ScheduleResult.CANDIDATES:
+            self.scheduling.reattach_peer(peer, result.parents)
+            if peer.fsm.can("download"):
+                peer.fsm.event("download")
+            self._mark_task_running(task)
+            await stream.send({
+                "type": "normal_task",
+                "task": task.to_wire(),
+                "parents": [p.to_wire() for p in result.parents],
+            })
+        elif result.kind == ScheduleResult.NEED_BACK_SOURCE:
+            self._mark_task_running(task)
+            self._to_back_source(task, peer, result.reason)
+            await stream.send({"type": "need_back_source", "reason": result.reason,
+                               "task": task.to_wire()})
+        else:
+            self._fail_peer(peer)
+            await stream.send({"type": "schedule_failed", "reason": result.reason})
+
+    def _mark_task_running(self, task: Task) -> None:
+        if task.fsm.can("download"):
+            task.fsm.event("download")
+
+    def _to_back_source(self, task: Task, peer: Peer, reason: str) -> None:
+        if peer.fsm.can("download_back_to_source"):
+            peer.fsm.event("download_back_to_source")
+            task.back_to_source_peers.add(peer.id)
+            log.info("peer going back-to-source", peer=peer.id[:24], reason=reason)
+
+    def _fail_peer(self, peer: Peer) -> None:
+        if peer.fsm.can("download_failed"):
+            peer.fsm.event("download_failed")
+
+    # -- seed triggering (reference downloadTaskBySeedPeer :1504) ----------
+
+    async def _maybe_trigger_seed(self, task: Task, requesting_peer: Peer) -> bool:
+        """Pick the least-loaded live seed host and trigger a seed download.
+        Returns True if a seed is (already) seeding this task."""
+        if not self.config.seed_peer_enabled:
+            return False
+        # Already seeding?
+        for p in task.peers():
+            if p.is_seed and not p.is_done():
+                return True
+        seeds = [h for h in self.hosts.all() if h.is_seed() and h.port > 0]
+        if not seeds:
+            return False
+        seeds.sort(key=lambda h: len(h.peer_ids))
+        seed_host = seeds[0]
+        ok = await self.seed_clients.trigger_download_task(
+            seed_host,
+            {
+                "task_id": task.id,
+                "url": task.url,
+                "tag": task.tag,
+                "application": task.application,
+                "digest": task.digest,
+                "filters": task.filtered_query_params,
+                "header": task.header,
+            },
+        )
+        if ok:
+            self._mark_task_running(task)
+            log.info("triggered seed download", task=task.id[:16], seed=seed_host.id)
+        return ok
+
+    # -- piece reports (reference :1291-1455) ------------------------------
+
+    def _handle_download_started(self, msg: dict, task: Task, peer: Peer) -> None:
+        task.update_lengths(
+            msg.get("content_length", -1),
+            msg.get("piece_size", 0),
+            msg.get("total_piece_count", -1),
+        )
+
+    def _handle_piece_finished(self, msg: dict, task: Task, peer: Peer) -> None:
+        p = msg.get("piece") or {}
+        info = PieceInfo.from_wire(p)
+        peer.add_finished_piece(info.piece_num, info.download_cost_ms)
+        task.store_piece(info)
+        task.touch()
+        parent_id = p.get("dst_peer_id", "")
+        if parent_id:
+            parent = self.peers.load(parent_id)
+            if parent is not None:
+                parent.host.upload_count += 1
+                parent.touch()
+
+    def _handle_piece_failed(self, msg: dict, task: Task, peer: Peer) -> None:
+        parent_id = msg.get("parent_id", "")
+        if parent_id:
+            # Transient failures (429 throttle, size mismatch) only dent the
+            # upload stats; permanent ones blocklist the parent for this peer.
+            if not msg.get("temporary"):
+                peer.block_parents.add(parent_id)
+            parent = self.peers.load(parent_id)
+            if parent is not None:
+                parent.host.upload_count += 1
+                parent.host.upload_failed_count += 1
+
+    # -- reschedule (reference :1157 handleRescheduleRequest) --------------
+
+    async def _handle_reschedule(self, msg: dict, task: Task, peer: Peer) -> None:
+        peer.reschedule_count += 1
+        for pid in msg.get("blocklist") or []:
+            peer.block_parents.add(pid)
+        task.delete_peer_in_edges(peer.id)
+        patience = 30.0 if self._seed_active(task) else 0.0
+        await self._schedule_and_send(task, peer, patience=patience)
+
+    # -- completion (reference :1180/:1236) --------------------------------
+
+    def _handle_download_finished(self, msg: dict, task: Task, peer: Peer) -> None:
+        try:
+            peer.fsm.event("download_succeeded")
+        except TransitionError:
+            log.warning("download_finished in bad state", state=peer.state)
+            return
+        task.update_lengths(
+            msg.get("content_length", task.content_length),
+            msg.get("piece_size", task.piece_size),
+            msg.get("total_piece_count", task.total_piece_count),
+        )
+        if task.fsm.can("download_succeeded"):
+            task.fsm.event("download_succeeded")
+        log.info("peer finished", peer=peer.id[:24], task=task.id[:16])
+
+    def _handle_download_failed(self, msg: dict, task: Task, peer: Peer) -> None:
+        self._fail_peer(peer)
+        # Task fails only when nothing is still making progress.
+        still_running = any(
+            not p.is_done() and p.id != peer.id for p in task.peers()
+        )
+        if not still_running and task.fsm.can("download_failed"):
+            task.fsm.event("download_failed")
+
+    def _on_stream_gone(self, task: Task, peer: Peer) -> None:
+        """Stream dropped: a running peer that vanished must not stay a
+        parent candidate (reference: peer leave → DAG edge deletion)."""
+        if not peer.is_done():
+            self._fail_peer(peer)
+        if peer.fsm.current in (PeerState.FAILED, PeerState.LEAVE):
+            try:
+                task.delete_peer_out_edges(peer.id)
+                task.delete_peer_in_edges(peer.id)
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------ #
+    # unary RPCs
+    # ------------------------------------------------------------------ #
+
+    async def announce_host(self, body: dict, ctx: RpcContext) -> dict:
+        """Periodic host announcement (reference AnnounceHost :460)."""
+        h = body or {}
+        host = self.hosts.load_or_store(
+            Host(
+                h.get("id", "unknown"),
+                hostname=h.get("hostname", ""),
+                ip=h.get("ip", ""),
+                port=h.get("port", 0),
+                upload_port=h.get("upload_port", 0),
+                host_type=HostType(h.get("type", 0)),
+                idc=h.get("idc", ""),
+                location=h.get("location", ""),
+                tpu_slice=h.get("tpu_slice", ""),
+                tpu_worker_index=h.get("tpu_worker_index", -1),
+            )
+        )
+        host.port = h.get("port", host.port)
+        host.upload_port = h.get("upload_port", host.upload_port)
+        tel = h.get("telemetry") or {}
+        for k, v in tel.items():
+            if hasattr(host.telemetry, k):
+                setattr(host.telemetry, k, v)
+        host.touch()
+        return {"ok": True}
+
+    async def leave_host(self, body: dict, ctx: RpcContext) -> dict:
+        """Host shutdown (reference LeaveHost :641): fail its peers, drop it."""
+        host_id = (body or {}).get("id", "")
+        host = self.hosts.load(host_id)
+        if host is None:
+            return {"ok": False}
+        for pid in list(host.peer_ids):
+            peer = self.peers.load(pid)
+            if peer is not None:
+                if peer.fsm.can("leave"):
+                    peer.fsm.event("leave")
+                self.peers.delete(pid)
+        self.hosts.delete(host_id)
+        return {"ok": True}
+
+    async def leave_peer(self, body: dict, ctx: RpcContext) -> dict:
+        peer_id = (body or {}).get("id", "")
+        peer = self.peers.load(peer_id)
+        if peer is None:
+            return {"ok": False}
+        if peer.fsm.can("leave"):
+            peer.fsm.event("leave")
+        self.peers.delete(peer_id)
+        return {"ok": True}
+
+    async def stat_task(self, body: dict, ctx: RpcContext) -> dict:
+        task = self.tasks.load((body or {}).get("task_id", ""))
+        if task is None:
+            raise DfError(Code.PeerTaskNotFound, "task not found")
+        return task.to_wire()
+
+    async def stat_peer(self, body: dict, ctx: RpcContext) -> dict:
+        peer = self.peers.load((body or {}).get("peer_id", ""))
+        if peer is None:
+            raise DfError(Code.SchedPeerNotFound, "peer not found")
+        return peer.to_wire()
+
+    async def list_hosts(self, body: dict, ctx: RpcContext) -> dict:
+        return {"hosts": [h.to_wire() for h in self.hosts.all()]}
+
+    # ------------------------------------------------------------------ #
+    # GC
+    # ------------------------------------------------------------------ #
+
+    def gc(self) -> dict:
+        return {
+            "peers": len(self.peers.gc()),
+            "tasks": len(self.tasks.gc()),
+            "hosts": len(self.hosts.gc()),
+        }

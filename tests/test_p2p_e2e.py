@@ -1,0 +1,247 @@
+"""P2P integration: scheduler + seed + N peer daemons on localhost.
+
+BASELINE config #2 analog (8-peer fan-out, origin fetched ~once) — the
+hermetic multi-process harness from SURVEY.md §4 realized in-process: one
+origin, one scheduler, one seed daemon, N peer daemons, all on one loop.
+"""
+
+import asyncio
+import hashlib
+import random
+
+import pytest
+from aiohttp import web
+
+from dragonfly2_tpu.client import dfget as dfget_lib
+from dragonfly2_tpu.daemon.config import DaemonConfig
+from dragonfly2_tpu.daemon.daemon import Daemon
+from dragonfly2_tpu.pkg.piece import Range
+from dragonfly2_tpu.scheduler.config import SchedulerConfig
+from dragonfly2_tpu.scheduler.server import SchedulerServer
+
+CONTENT = bytes(random.Random(99).randbytes(10 * 1024 * 1024))
+SHA = "sha256:" + hashlib.sha256(CONTENT).hexdigest()
+
+
+async def start_origin():
+    stats = {"blob_streams": 0, "blob_bytes": 0}
+
+    async def blob(request: web.Request) -> web.StreamResponse:
+        stats["blob_streams"] += 1
+        rng = request.headers.get("Range")
+        if rng:
+            r = Range.parse_http(rng, len(CONTENT))
+            data = CONTENT[r.start : r.start + r.length]
+            stats["blob_bytes"] += len(data)
+            return web.Response(
+                status=206, body=data,
+                headers={
+                    "Content-Range": f"bytes {r.start}-{r.start + r.length - 1}/{len(CONTENT)}",
+                    "Accept-Ranges": "bytes",
+                })
+        stats["blob_bytes"] += len(CONTENT)
+        return web.Response(body=CONTENT, headers={"Accept-Ranges": "bytes"})
+
+    app = web.Application()
+    app.router.add_get("/blob", blob)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    return runner, site._server.sockets[0].getsockname()[1], stats
+
+
+async def start_scheduler() -> SchedulerServer:
+    cfg = SchedulerConfig()
+    cfg.server.port = 0
+    cfg.scheduling.retry_interval = 0.05   # fast tests
+    cfg.gc.interval = 3600
+    server = SchedulerServer(cfg)
+    await server.start()
+    return server
+
+
+def daemon_config(tmp_path, name: str, scheduler_port: int, *, seed=False) -> DaemonConfig:
+    cfg = DaemonConfig()
+    cfg.work_home = str(tmp_path / name)
+    cfg.__post_init__()
+    cfg.host.hostname = name
+    cfg.host.ip = "127.0.0.1"
+    cfg.scheduler.addrs = [f"127.0.0.1:{scheduler_port}"]
+    cfg.seed_peer = seed
+    cfg.gc_interval = 3600
+    cfg.download.piece_concurrency = 1          # deterministic origin counting
+    cfg.download.concurrent_min_length = 1 << 40
+    return cfg
+
+
+async def start_daemon(tmp_path, name, scheduler_port, *, seed=False) -> Daemon:
+    d = Daemon(daemon_config(tmp_path, name, scheduler_port, seed=seed))
+    await d.start()
+    return d
+
+
+async def dfget_via(daemon: Daemon, url: str, out: str, digest: str = SHA) -> dict:
+    from dragonfly2_tpu.proto.common import UrlMeta
+
+    return await dfget_lib.download(
+        dfget_lib.DfgetConfig(
+            url=url, output=out,
+            daemon_sock=daemon.config.unix_sock,
+            meta=UrlMeta(digest=digest),
+            allow_source_fallback=False,
+            timeout=60.0,
+        ))
+
+
+class TestP2PFanout:
+    def test_seed_plus_peers_single_origin_fetch(self, run_async, tmp_path):
+        """8 peers + 1 seed: origin serves ~one content copy; every peer's
+        output sha-verifies; peers report from_p2p."""
+
+        async def body():
+            origin, oport, stats = await start_origin()
+            sched = await start_scheduler()
+            url = f"http://127.0.0.1:{oport}/blob"
+            daemons = []
+            try:
+                seed = await start_daemon(tmp_path, "seed", sched.port(), seed=True)
+                daemons.append(seed)
+                peers = []
+                for i in range(8):
+                    d = await start_daemon(tmp_path, f"peer{i}", sched.port())
+                    daemons.append(d)
+                    peers.append(d)
+
+                results = await asyncio.gather(*[
+                    dfget_via(d, url, str(tmp_path / f"out{i}.bin"))
+                    for i, d in enumerate(peers)
+                ])
+                for i, r in enumerate(results):
+                    assert r["state"] == "done"
+                    data = (tmp_path / f"out{i}.bin").read_bytes()
+                    assert hashlib.sha256(data).hexdigest() == SHA.split(":")[1]
+                # Origin economy: one probe + one content stream (seed only).
+                assert stats["blob_streams"] <= 3, stats
+                assert stats["blob_bytes"] <= len(CONTENT) + (1 << 20), stats
+                # At least some peers rode P2P (the rest may have deduped
+                # onto a running conductor of the same daemon — not here,
+                # every daemon is distinct, so all should be P2P).
+                assert all(r["from_p2p"] for r in results), results
+            finally:
+                for d in daemons:
+                    await d.stop()
+                await sched.stop()
+                await origin.cleanup()
+
+        run_async(body(), timeout=120)
+
+    def test_first_peer_back_source_without_seed(self, run_async, tmp_path):
+        """No seed daemon: first peer falls back to origin, second peer
+        pulls pieces from the first over P2P."""
+
+        async def body():
+            origin, oport, stats = await start_origin()
+            sched = await start_scheduler()
+            sched.config.seed_peer_enabled = False
+            url = f"http://127.0.0.1:{oport}/blob"
+            daemons = []
+            try:
+                d1 = await start_daemon(tmp_path, "p1", sched.port())
+                d2 = await start_daemon(tmp_path, "p2", sched.port())
+                daemons += [d1, d2]
+                r1 = await dfget_via(d1, url, str(tmp_path / "o1.bin"))
+                assert r1["state"] == "done"
+                streams_after_first = stats["blob_streams"]
+
+                r2 = await dfget_via(d2, url, str(tmp_path / "o2.bin"))
+                assert r2["state"] == "done"
+                assert r2["from_p2p"]
+                assert (tmp_path / "o2.bin").read_bytes() == CONTENT
+                # Second download never touched origin.
+                assert stats["blob_streams"] == streams_after_first
+            finally:
+                for d in daemons:
+                    await d.stop()
+                await sched.stop()
+                await origin.cleanup()
+
+        run_async(body(), timeout=60)
+
+    def test_seed_reannounce_serves_after_scheduler_restart(self, run_async, tmp_path):
+        """Scheduler restarts (loses all state); seed re-announce path lets a
+        new peer still fetch via P2P without a fresh origin fetch."""
+
+        async def body():
+            origin, oport, stats = await start_origin()
+            sched = await start_scheduler()
+            url = f"http://127.0.0.1:{oport}/blob"
+            daemons = []
+            try:
+                seed = await start_daemon(tmp_path, "seed", sched.port(), seed=True)
+                daemons.append(seed)
+                d1 = await start_daemon(tmp_path, "p1", sched.port())
+                daemons.append(d1)
+                await dfget_via(d1, url, str(tmp_path / "o1.bin"))
+                bytes_after = stats["blob_bytes"]
+
+                # Scheduler dies and comes back empty on the same port.
+                port = sched.port()
+                await sched.stop()
+                cfg = SchedulerConfig()
+                cfg.server.port = port
+                cfg.scheduling.retry_interval = 0.05
+                cfg.gc.interval = 3600
+                sched2 = SchedulerServer(cfg)
+                await sched2.start()
+                # Daemons re-announce their host records.
+                for d in daemons:
+                    await d.announcer.announce_once()
+
+                d2 = await start_daemon(tmp_path, "p2", sched2.port())
+                daemons.append(d2)
+                r = await dfget_via(d2, url, str(tmp_path / "o2.bin"))
+                assert r["state"] == "done"
+                assert (tmp_path / "o2.bin").read_bytes() == CONTENT
+                # Origin payload untouched: seed re-announced local pieces.
+                assert stats["blob_bytes"] == bytes_after, stats
+                await sched2.stop()
+            finally:
+                for d in daemons:
+                    await d.stop()
+                await origin.cleanup()
+
+        run_async(body(), timeout=60)
+
+
+def test_broker_no_channel_leak(run_async, tmp_path):
+    from dragonfly2_tpu.daemon.peer.broker import PieceBroker, PieceEvent
+
+    async def body():
+        b = PieceBroker()
+        for i in range(100):
+            b.publish(f"task{i}", PieceEvent([1]))
+        assert len(b._tasks) == 0  # no subscribers → no channels
+        q = b.subscribe("t")
+        b.publish("t", PieceEvent([1]))
+        assert (await q.get()).piece_nums == [1]
+        b.unsubscribe("t", q)
+        assert len(b._tasks) == 0
+
+    run_async(body())
+
+
+def test_dispatcher_peek_does_not_reserve():
+    from dragonfly2_tpu.daemon.peer.piece_dispatcher import PieceDispatcher
+
+    d = PieceDispatcher()
+    d.total_piece_count = 2
+    d.piece_size = 4
+    d.content_length = 8
+    d.upsert_parent("p1", "127.0.0.1", 9000)
+    d.on_parent_pieces("p1", [0, 1])
+    assert d.has_assignable()
+    assert d.has_assignable()  # peek twice, nothing reserved
+    a1 = d.try_get()
+    a2 = d.try_get()
+    assert {a1.piece_num, a2.piece_num} == {0, 1}  # both still assignable

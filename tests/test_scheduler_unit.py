@@ -1,0 +1,201 @@
+"""Scheduler unit tests: resource FSMs, evaluator scoring, scheduling filters.
+
+Modeled on reference scheduler/scheduling/scheduling_test.go and
+evaluator_base_test.go (build fake hosts/peers, assert filter + sort
+behavior).
+"""
+
+import pytest
+
+from dragonfly2_tpu.pkg.types import HostType
+from dragonfly2_tpu.scheduler.config import SchedulingConfig
+from dragonfly2_tpu.scheduler.resource import (
+    Host,
+    Peer,
+    PeerState,
+    Task,
+    TaskState,
+)
+from dragonfly2_tpu.scheduler.scheduling import Evaluator, Scheduling
+
+
+def make_host(hid, *, host_type=HostType.NORMAL, idc="", location="", tpu_slice="",
+              upload_port=9000):
+    return Host(hid, ip="10.0.0.1", port=8000, upload_port=upload_port,
+                host_type=host_type, idc=idc, location=location, tpu_slice=tpu_slice)
+
+
+def make_peer(pid, task, host, *, state=None, pieces=0):
+    p = Peer(pid, task, host)
+    task.add_peer(p)
+    host.peer_ids.add(pid)
+    if state == PeerState.RUNNING:
+        p.fsm.event("register_normal")
+        p.fsm.event("download")
+    elif state == PeerState.SUCCEEDED:
+        p.fsm.event("register_normal")
+        p.fsm.event("download")
+        p.fsm.event("download_succeeded")
+    elif state == PeerState.BACK_TO_SOURCE:
+        p.fsm.event("register_normal")
+        p.fsm.event("download_back_to_source")
+    for i in range(pieces):
+        p.add_finished_piece(i, cost_ms=50)
+    return p
+
+
+class TestFSMs:
+    def test_task_lifecycle(self):
+        t = Task("t1", "http://x")
+        assert t.state == TaskState.PENDING
+        t.fsm.event("download")
+        assert t.state == TaskState.RUNNING
+        t.fsm.event("download_succeeded")
+        assert t.state == TaskState.SUCCEEDED
+        t.fsm.event("download")  # re-download allowed
+        assert t.state == TaskState.RUNNING
+
+    def test_peer_lifecycle(self):
+        t = Task("t1")
+        h = make_host("h1")
+        p = Peer("p1", t, h)
+        p.fsm.event("register_normal")
+        p.fsm.event("download")
+        p.fsm.event("download_succeeded")
+        assert p.is_done()
+
+    def test_peer_back_to_source_path(self):
+        t = Task("t1")
+        p = Peer("p1", t, make_host("h1"))
+        p.fsm.event("register_normal")
+        p.fsm.event("download_back_to_source")
+        assert p.state == PeerState.BACK_TO_SOURCE
+        p.fsm.event("download_succeeded")
+        assert p.state == PeerState.SUCCEEDED
+
+
+class TestEvaluator:
+    def test_more_pieces_scores_higher(self):
+        t = Task("t1")
+        t.total_piece_count = 10
+        child = make_peer("c", t, make_host("hc"))
+        rich = make_peer("rich", t, make_host("h1"), state=PeerState.RUNNING, pieces=9)
+        poor = make_peer("poor", t, make_host("h2"), state=PeerState.RUNNING, pieces=1)
+        ev = Evaluator()
+        ranked = ev.evaluate_parents([poor, rich], child, 10)
+        assert ranked[0].id == "rich"
+
+    def test_seed_outranks_normal(self):
+        t = Task("t1")
+        t.total_piece_count = 10
+        child = make_peer("c", t, make_host("hc"))
+        seed = make_peer("seed", t, make_host("hs", host_type=HostType.SUPER_SEED),
+                         state=PeerState.SUCCEEDED, pieces=10)
+        normal = make_peer("n", t, make_host("hn"), state=PeerState.SUCCEEDED, pieces=10)
+        ev = Evaluator()
+        ranked = ev.evaluate_parents([normal, seed], child, 10)
+        assert ranked[0].id == "seed"
+
+    def test_same_slice_beats_cross_slice(self):
+        """TPU topology: an ICI-local parent must beat a remote seed-grade
+        parent with the same piece count."""
+        t = Task("t1")
+        t.total_piece_count = 10
+        child = make_peer("c", t, make_host("hc", tpu_slice="slice-a", idc="pod-1"))
+        local = make_peer("local", t,
+                          make_host("h1", tpu_slice="slice-a", idc="pod-1"),
+                          state=PeerState.SUCCEEDED, pieces=10)
+        remote = make_peer("remote", t,
+                           make_host("h2", tpu_slice="slice-z", idc="pod-9"),
+                           state=PeerState.SUCCEEDED, pieces=10)
+        ev = Evaluator()
+        ranked = ev.evaluate_parents([remote, local], child, 10)
+        assert ranked[0].id == "local"
+
+    def test_location_affinity_prefix(self):
+        ev = Evaluator()
+        a = make_host("a", location="us|pod1|slice1|host1")
+        b = make_host("b", location="us|pod1|slice2|host9")
+        c = make_host("c", location="eu|podx")
+        assert ev._location_score(a, b) == pytest.approx(2 / 5)
+        assert ev._location_score(a, c) == 0.0
+
+    def test_bad_node_20x_mean(self):
+        t = Task("t1")
+        p = make_peer("p", t, make_host("h"))
+        for _ in range(5):
+            p.piece_costs.append(10)
+        p.piece_costs.append(500)  # 50x mean
+        assert Evaluator.is_bad_node(p)
+
+    def test_bad_node_3_sigma(self):
+        t = Task("t1")
+        p = make_peer("p", t, make_host("h"))
+        for _ in range(35):
+            p.piece_costs.append(100)
+        p.piece_costs.append(101)  # sigma 0 → any increase trips
+        assert Evaluator.is_bad_node(p)
+        p2 = make_peer("p2", t, make_host("h2"))
+        for i in range(35):
+            p2.piece_costs.append(100 + (i % 5))
+        p2.piece_costs.append(103)  # within band
+        assert not Evaluator.is_bad_node(p2)
+
+
+class TestSchedulingFilters:
+    def _setup(self):
+        cfg = SchedulingConfig(retry_interval=0.01)
+        s = Scheduling(cfg)
+        t = Task("t1", "http://x")
+        t.total_piece_count = 10
+        child = make_peer("child", t, make_host("hc"))
+        return s, t, child
+
+    def test_filters_self_and_same_host(self):
+        s, t, child = self._setup()
+        same_host = make_peer("same", t, child.host, state=PeerState.RUNNING, pieces=5)
+        assert s.find_candidate_parents(child) == []
+
+    def test_filters_blocklist_and_states(self):
+        s, t, child = self._setup()
+        good = make_peer("good", t, make_host("h1"), state=PeerState.RUNNING, pieces=5)
+        pending = make_peer("pend", t, make_host("h2"))  # still pending
+        parents = s.find_candidate_parents(child)
+        assert [p.id for p in parents] == ["good"]
+        assert s.find_candidate_parents(child, {"good"}) == []
+
+    def test_filters_no_free_upload(self):
+        s, t, child = self._setup()
+        h = make_host("h1")
+        h.concurrent_upload_count = h.concurrent_upload_limit
+        make_peer("busy", t, h, state=PeerState.RUNNING, pieces=5)
+        assert s.find_candidate_parents(child) == []
+
+    def test_candidate_limit(self):
+        s, t, child = self._setup()
+        for i in range(10):
+            make_peer(f"p{i}", t, make_host(f"h{i}"), state=PeerState.SUCCEEDED, pieces=10)
+        parents = s.find_candidate_parents(child)
+        assert len(parents) == s.config.candidate_parent_limit
+
+    def test_reattach_edges(self):
+        s, t, child = self._setup()
+        p1 = make_peer("p1", t, make_host("h1"), state=PeerState.SUCCEEDED, pieces=10)
+        p2 = make_peer("p2", t, make_host("h2"), state=PeerState.SUCCEEDED, pieces=10)
+        s.reattach_peer(child, [p1])
+        assert t.peer_out_degree("p1") == 1
+        s.reattach_peer(child, [p2])
+        assert t.peer_out_degree("p1") == 0
+        assert t.peer_out_degree("p2") == 1
+
+    def test_schedule_need_back_source_when_empty(self, run_async):
+        s, t, child = self._setup()
+        child.fsm.event("register_normal")
+
+        async def body():
+            result = await s.schedule_candidate_parents(child)
+            from dragonfly2_tpu.scheduler.scheduling.scheduling import ScheduleResult
+
+            assert result.kind == ScheduleResult.NEED_BACK_SOURCE
+
+        run_async(body())
