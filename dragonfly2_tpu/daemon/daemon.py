@@ -32,6 +32,12 @@ class Daemon:
         path = config.dfpath.ensure()
         dflog.configure(log_dir=path.log_dir)
 
+        # TPU topology autodetection feeds the scheduler's ICI/DCN-aware
+        # evaluator (env-based; never initializes JAX unless opted in).
+        from dragonfly2_tpu.parallel.topology import apply_to_host_config
+
+        apply_to_host_config(config.host)
+
         self.storage = StorageManager(
             StorageOption(
                 data_dir=path.data_dir,
@@ -76,19 +82,12 @@ class Daemon:
 
     def _make_conductor(self, *, task_id: str, peer_id: str, request, store,
                         on_piece, is_seed: bool = False) -> PeerTaskConductor:
-        host = self.config.host
-        host_info = {
-            "id": self.announcer.host_id if self.announcer else host.hostname,
-            "hostname": host.hostname,
-            "ip": host.ip,
-            "port": self.rpc.peer_server.port() if self.rpc.peer_server._servers else 0,
-            "upload_port": self.upload.port,
-            "type": int(self.config.host_type_enum),
-            "idc": host.idc,
-            "location": host.location,
-            "tpu_slice": host.tpu_slice,
-            "tpu_worker_index": host.tpu_worker_index,
-        }
+        if self.announcer is None:
+            raise RuntimeError("conductor requires a started daemon (announcer missing)")
+        # Single source of truth for the host record: the announcer's wire
+        # form (minus telemetry) — scheduler must see ONE identity per host.
+        host_info = self.announcer.host_wire()
+        host_info.pop("telemetry", None)
         meta = {
             "tag": request.meta.tag,
             "application": request.meta.application,

@@ -339,8 +339,10 @@ class PeerTaskConductor:
             if self._complete() or self._need_back_source:
                 return False
             # Another worker may have already refreshed the parent set
-            # (peek only — try_get would leak an in-flight reservation).
-            if self.dispatcher.has_assignable() or self.dispatcher.active_parents():
+            # (peek only — try_get would leak an in-flight reservation). An
+            # active parent with nothing assignable does NOT count: missing
+            # pieces held only by dead parents must still trigger reschedule.
+            if self.dispatcher.has_assignable():
                 return True
             self._reschedules += 1
             if self._reschedules > MAX_RESCHEDULES:
@@ -351,7 +353,10 @@ class PeerTaskConductor:
             await self._safe_send({"type": "reschedule", "blocklist": blocklist,
                                    "description": "piece starvation"})
             try:
-                await asyncio.wait_for(self._sched_update.wait(), timeout=30.0)
+                # Longer than the scheduler's 30s seed-patience hold: a
+                # reschedule during a slow seed fetch must outwait it, not
+                # deterministically tie and abort.
+                await asyncio.wait_for(self._sched_update.wait(), timeout=60.0)
             except asyncio.TimeoutError:
                 raise DfError(Code.SchedError, "scheduler silent during reschedule")
             return not self._need_back_source

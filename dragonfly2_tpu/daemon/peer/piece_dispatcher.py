@@ -41,13 +41,37 @@ class PieceAssignment:
 class PieceDispatcher:
     def __init__(self, *, max_parent_failures: int = 3):
         self.parents: dict[str, ParentInfo] = {}
-        self.total_piece_count = -1
+        self._total_piece_count = -1
         self.piece_size = 0
         self.content_length = -1
         self._done: set[int] = set()
         self._inflight: set[int] = set()
+        # Incremental ready-tracking: O(1) amortized per assignment instead
+        # of rescanning all pieces (a 100 GiB task is ~25k pieces).
+        self._needed: set[int] = set()
+        self._heap: list[int] = []
         self._max_parent_failures = max_parent_failures
         self._wakeup = asyncio.Event()
+
+    @property
+    def total_piece_count(self) -> int:
+        return self._total_piece_count
+
+    @total_piece_count.setter
+    def total_piece_count(self, value: int) -> None:
+        if value >= 0 and value != self._total_piece_count:
+            self._total_piece_count = value
+            self._add_needed(range(value))
+        elif value >= 0:
+            self._total_piece_count = value
+
+    def _add_needed(self, nums) -> None:
+        import heapq
+
+        for n in nums:
+            if n not in self._done and n not in self._inflight and n not in self._needed:
+                self._needed.add(n)
+                heapq.heappush(self._heap, n)
 
     # -- topology updates --------------------------------------------------
 
@@ -80,6 +104,9 @@ class PieceDispatcher:
         p.pieces.update(piece_nums)
         if total_piece_count >= 0:
             self.total_piece_count = total_piece_count
+        if self._total_piece_count < 0:
+            # Unknown total: advertised pieces define the known universe.
+            self._add_needed(piece_nums)
         if content_length >= 0:
             self.content_length = content_length
         if piece_size > 0:
@@ -91,10 +118,12 @@ class PieceDispatcher:
     def mark_downloaded(self, piece_num: int) -> None:
         self._done.add(piece_num)
         self._inflight.discard(piece_num)
+        self._needed.discard(piece_num)
         self._wakeup.set()
 
     def mark_known_downloaded(self, piece_nums) -> None:
         self._done.update(piece_nums)
+        self._needed -= set(piece_nums)
 
     def report_success(self, assignment: PieceAssignment, cost_ms: int) -> None:
         p = assignment.parent
@@ -109,6 +138,7 @@ class PieceDispatcher:
         if parent_gone or p.failures >= self._max_parent_failures:
             p.blocked = True
         self._inflight.discard(assignment.piece_num)
+        self._add_needed([assignment.piece_num])
         self._wakeup.set()
 
     # -- completion --------------------------------------------------------
@@ -124,17 +154,6 @@ class PieceDispatcher:
 
     # -- assignment (reference getDesiredReq :104-168) ---------------------
 
-    def _candidate_pieces(self) -> list[int]:
-        if self.total_piece_count >= 0:
-            universe = range(self.total_piece_count)
-            missing = [n for n in universe if n not in self._done and n not in self._inflight]
-        else:
-            advertised: set[int] = set()
-            for p in self.active_parents():
-                advertised |= p.pieces
-            missing = sorted(advertised - self._done - self._inflight)
-        return missing
-
     def _pick_parent(self, piece_num: int) -> ParentInfo | None:
         holders = [p for p in self.active_parents() if piece_num in p.pieces]
         if not holders:
@@ -145,24 +164,37 @@ class PieceDispatcher:
 
     def has_assignable(self) -> bool:
         """Non-mutating peek: could try_get() return an assignment now?"""
-        for piece_num in self._candidate_pieces():
-            if any(piece_num in p.pieces for p in self.active_parents()):
-                return True
-        return False
+        actives = self.active_parents()
+        return any(
+            any(n in p.pieces for p in actives) for n in self._needed)
 
     def try_get(self) -> PieceAssignment | None:
-        for piece_num in self._candidate_pieces():
-            parent = self._pick_parent(piece_num)
+        """Lowest-numbered needed piece with a live holder; unheld pieces go
+        back on the heap (O(log n) amortized)."""
+        import heapq
+
+        deferred: list[int] = []
+        found: PieceAssignment | None = None
+        while self._heap:
+            n = heapq.heappop(self._heap)
+            if n not in self._needed:
+                continue  # stale entry (downloaded meanwhile)
+            parent = self._pick_parent(n)
             if parent is None:
+                deferred.append(n)
                 continue
-            self._inflight.add(piece_num)
+            self._needed.discard(n)
+            self._inflight.add(n)
             expected = -1
             if self.piece_size > 0 and self.content_length >= 0:
                 from dragonfly2_tpu.pkg.piece import piece_length
 
-                expected = piece_length(piece_num, self.piece_size, self.content_length)
-            return PieceAssignment(piece_num, parent, expected)
-        return None
+                expected = piece_length(n, self.piece_size, self.content_length)
+            found = PieceAssignment(n, parent, expected)
+            break
+        for n in deferred:
+            heapq.heappush(self._heap, n)
+        return found
 
     async def get(self, timeout: float = 30.0) -> PieceAssignment | None:
         """Next assignment; None when the task is complete or no parents can

@@ -67,6 +67,7 @@ class PieceTaskSynchronizer:
                 {"task_id": self.task_id, "src_peer_id": self.peer_id,
                  "dst_peer_id": parent_peer_id},
             )
+            done = False
             while True:
                 msg = await stream.recv(timeout=60.0)
                 if msg is None:
@@ -79,7 +80,15 @@ class PieceTaskSynchronizer:
                     msg.get("piece_size", 0),
                 )
                 if msg.get("done"):
+                    done = True
                     break
+            if not done:
+                # Clean close without done: the parent went away mid-task; it
+                # must not linger as an 'active' parent with a stale subset.
+                log.info("sync stream closed early", parent=parent_peer_id[:24])
+                self.dispatcher.drop_parent(parent_peer_id)
+                if self.on_parent_dead is not None:
+                    self.on_parent_dead(parent_peer_id)
         except asyncio.CancelledError:
             raise
         except Exception as e:

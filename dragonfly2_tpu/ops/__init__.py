@@ -1,0 +1,6 @@
+"""TPU compute ops: HBM piece sink + on-device checksums (JAX/Pallas)."""
+
+from dragonfly2_tpu.ops.checksum import chunk_checksums, checksum_numpy
+from dragonfly2_tpu.ops.hbm_sink import HBMSink
+
+__all__ = ["HBMSink", "chunk_checksums", "checksum_numpy"]

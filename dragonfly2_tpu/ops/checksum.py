@@ -1,0 +1,136 @@
+"""On-device piece checksums.
+
+The TPU sink's integrity check: every landed piece gets a 64-bit
+(sum32, xorfold32) checksum computed ON DEVICE and compared against the
+value the daemon computed host-side during download. Cryptographic digests
+(md5/sha256 — pkg/digest) stay on the host path; this kernel answers "did
+these exact bytes land in HBM?" at HBM bandwidth.
+
+Definition over a piece p of 4-byte words w_i (uint8 little-endian padded):
+  sum32  = Σ w_i  mod 2^32
+  xor32  = ⊕ w_i
+Both are order-independent per word lane, so host (numpy) and device (XLA /
+Pallas) agree bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _pad_to_words(data: bytes) -> np.ndarray:
+    pad = (-len(data)) % 4
+    if pad:
+        data = data + b"\x00" * pad
+    return np.frombuffer(data, dtype="<u4")
+
+
+def checksum_numpy(data: bytes) -> tuple[int, int]:
+    """Host-side reference: (sum32, xor32)."""
+    words = _pad_to_words(data)
+    s = int(np.sum(words, dtype=np.uint64) & 0xFFFFFFFF)
+    x = int(np.bitwise_xor.reduce(words, initial=np.uint32(0)))
+    return s, x
+
+
+@functools.partial(jax.jit, static_argnames=("piece_words",))
+def _chunk_checksums_xla(words, piece_words: int):
+    """words: uint32[n_pieces * piece_words] → (sum32[n], xor32[n])."""
+    w = words.reshape(-1, piece_words)
+    # uint32 accumulation wraps mod 2^32 — exactly the checksum definition.
+    sums = jnp.sum(w, axis=1, dtype=jnp.uint32)
+    xors = jax.lax.reduce(w, jnp.uint32(0), jax.lax.bitwise_xor, (1,))
+    return sums, xors
+
+
+def _pallas_available() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+@functools.partial(jax.jit, static_argnames=("piece_words",))
+def _chunk_checksums_pallas(words, piece_words: int):
+    """Pallas kernel: one grid step per piece; the piece's words stream
+    HBM→VMEM once and reduce on the VPU. int32 ops (TPU has no uint32
+    vector unit type); bit patterns match uint32 exactly."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_pieces = words.shape[0] // piece_words
+    LANES = 128
+    PB = 8                      # pieces per block: (8, 128) output tiles
+    rows = piece_words // LANES
+    RC = min(rows, 512)         # row chunk: 8×512×128×4B = 2 MiB in VMEM
+    assert rows % RC == 0
+
+    def _xor_fold(x, axis_len):
+        # Halving tree over axis 1 (log2 VPU ops; lax.reduce with xor has
+        # no Pallas lowering).
+        r = axis_len
+        while r > 1:
+            half = r // 2
+            folded = x[:, :half, :] ^ x[:, half : 2 * half, :]
+            if r % 2:
+                folded = folded.at[:, 0, :].set(folded[:, 0, :] ^ x[:, r - 1, :])
+            x = folded
+            r = half
+        return x[:, 0, :]
+
+    def kernel(w_ref, sum_ref, xor_ref):
+        j = pl.program_id(1)
+        w = w_ref[...]  # (PB, RC, LANES) int32
+        part_x = _xor_fold(w, RC)
+        # int32 accumulation wraps mod 2^32 — same bit pattern as the
+        # uint32 checksum definition.
+        part_s = jnp.sum(w, axis=1, dtype=jnp.int32)
+
+        @pl.when(j == 0)
+        def _init():
+            sum_ref[...] = part_s
+            xor_ref[...] = part_x
+
+        @pl.when(j != 0)
+        def _accum():
+            sum_ref[...] = sum_ref[...] + part_s
+            xor_ref[...] = xor_ref[...] ^ part_x
+
+    sums, xors = pl.pallas_call(
+        kernel,
+        grid=(n_pieces // PB, rows // RC),
+        in_specs=[pl.BlockSpec((PB, RC, LANES), lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=[
+            pl.BlockSpec((PB, LANES), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((PB, LANES), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pieces, LANES), jnp.int32),
+            jax.ShapeDtypeStruct((n_pieces, LANES), jnp.int32),
+        ],
+    )(jax.lax.bitcast_convert_type(words, jnp.int32).reshape(n_pieces, rows, LANES))
+    sums = jnp.sum(sums, axis=1, dtype=jnp.int32)
+    xors = jax.lax.reduce(xors, jnp.int32(0), jax.lax.bitwise_xor, (1,))
+    return (jax.lax.bitcast_convert_type(sums, jnp.uint32),
+            jax.lax.bitcast_convert_type(xors, jnp.uint32))
+
+
+def chunk_checksums(words, piece_words: int, *, use_pallas: bool | None = None):
+    """(sum32[n], xor32[n]) per piece on the current backend.
+
+    ``words``: uint32 device array, length = n_pieces * piece_words.
+    ``piece_words`` must be a multiple of 128 for the Pallas path; falls
+    back to the XLA reduction otherwise (identical results).
+    """
+    n_pieces = words.shape[0] // piece_words
+    if use_pallas is None:
+        use_pallas = (_pallas_available() and piece_words % 128 == 0
+                      and n_pieces % 8 == 0)
+    if use_pallas:
+        try:
+            return _chunk_checksums_pallas(words, piece_words)
+        except Exception:
+            pass
+    return _chunk_checksums_xla(words, piece_words)

@@ -1,0 +1,83 @@
+"""TPU pod topology model.
+
+Feeds the scheduler's evaluator (ICI vs DCN distance — evaluator.py
+_topology_score) and the daemon announcer (slice/worker autodetection). A
+"slice" is one ICI domain: transfers inside it should ride device
+collectives; transfers between slices cross the DCN.
+
+Detection sources, in order: explicit env (DF_TPU_SLICE/DF_TPU_WORKER),
+GCE TPU VM env (TPU_NAME/TPU_WORKER_ID/TPU_WORKER_HOSTNAMES), JAX process
+info when a TPU backend is initialized.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TpuTopology:
+    slice_name: str = ""        # ICI domain identifier
+    worker_index: int = -1      # host index within the slice
+    num_workers: int = 0        # hosts in the slice
+    chips_per_host: int = 0
+    pod_name: str = ""          # DCN cluster (fills Host.idc)
+    zone: str = ""
+
+    @property
+    def present(self) -> bool:
+        return bool(self.slice_name)
+
+    def location_path(self) -> str:
+        """'|'-separated affinity path for the evaluator's location term:
+        zone|pod|slice|worker (most-significant first)."""
+        parts = [self.zone or "zone", self.pod_name or "pod",
+                 self.slice_name or "slice", f"w{self.worker_index}"]
+        return "|".join(parts)
+
+
+def detect_topology() -> TpuTopology:
+    topo = TpuTopology()
+    topo.slice_name = os.environ.get("DF_TPU_SLICE", "") or os.environ.get("TPU_NAME", "")
+    worker = os.environ.get("DF_TPU_WORKER", "") or os.environ.get("TPU_WORKER_ID", "")
+    if worker:
+        try:
+            topo.worker_index = int(worker)
+        except ValueError:
+            pass
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if hostnames:
+        topo.num_workers = len(hostnames.split(","))
+    topo.pod_name = os.environ.get("DF_TPU_POD", "")
+    topo.zone = os.environ.get("DF_ZONE", "")
+
+    if not topo.present and os.environ.get("DF_DETECT_JAX", "") == "1":
+        # Optional: initialize JAX to read process topology (slow first call;
+        # opt-in because the daemon should not grab TPU chips by default).
+        try:
+            import jax
+
+            if jax.default_backend() == "tpu":
+                topo.slice_name = f"jax-slice-{jax.process_count()}x"
+                topo.worker_index = jax.process_index()
+                topo.num_workers = jax.process_count()
+                topo.chips_per_host = jax.local_device_count()
+        except Exception:
+            pass
+    return topo
+
+
+def apply_to_host_config(host_cfg, topo: TpuTopology | None = None) -> None:
+    """Fill a daemon HostOption from detected topology (daemon bootstrap)."""
+    topo = topo or detect_topology()
+    if not topo.present:
+        return
+    if not host_cfg.tpu_slice:
+        host_cfg.tpu_slice = topo.slice_name
+    if host_cfg.tpu_worker_index < 0:
+        host_cfg.tpu_worker_index = topo.worker_index
+    if not host_cfg.idc:
+        host_cfg.idc = topo.pod_name or topo.slice_name
+    if not host_cfg.location:
+        host_cfg.location = topo.location_path()

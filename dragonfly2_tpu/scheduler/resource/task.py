@@ -93,7 +93,24 @@ class Task:
         if not self.dag.has_vertex(peer.id):
             self.dag.add_vertex(peer.id, peer)
 
+    def _release_upload_slots(self, peer_id: str, *, parents: bool, children: bool) -> None:
+        """Upload-concurrency accounting: each parent→child edge holds one
+        upload slot on the parent's host (reference: ConcurrentUploadLimit;
+        evaluator free-upload term + scheduling filter read this)."""
+        if not self.dag.has_vertex(peer_id):
+            return
+        v = self.dag.get_vertex(peer_id)
+        if parents:
+            for p in v.parents.values():
+                host = p.value.host
+                host.concurrent_upload_count = max(0, host.concurrent_upload_count - 1)
+        if children:
+            host = v.value.host
+            host.concurrent_upload_count = max(
+                0, host.concurrent_upload_count - v.out_degree())
+
     def delete_peer(self, peer_id: str) -> None:
+        self._release_upload_slots(peer_id, parents=True, children=True)
         self.dag.delete_vertex(peer_id)
 
     def load_peer(self, peer_id: str):
@@ -109,13 +126,16 @@ class Task:
 
     def add_peer_edge(self, parent_id: str, child_id: str) -> None:
         self.dag.add_edge(parent_id, child_id)
+        self.dag.get_vertex(parent_id).value.host.concurrent_upload_count += 1
 
     def delete_peer_in_edges(self, peer_id: str) -> None:
         """Detach a peer from its parents before rescheduling
         (reference task.go DeletePeerInEdges)."""
+        self._release_upload_slots(peer_id, parents=True, children=False)
         self.dag.delete_vertex_in_edges(peer_id)
 
     def delete_peer_out_edges(self, peer_id: str) -> None:
+        self._release_upload_slots(peer_id, parents=False, children=True)
         self.dag.delete_vertex_out_edges(peer_id)
 
     def can_add_peer_edge(self, parent_id: str, child_id: str) -> bool:

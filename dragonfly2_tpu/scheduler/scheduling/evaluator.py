@@ -113,7 +113,9 @@ class Evaluator:
         same zone (location first element) → 0.3
         else → 0.1
         """
-        if not a.tpu_slice and not b.tpu_slice:
+        if not a.tpu_slice or not b.tpu_slice:
+            # Mixed fleets score on the classic idc/location scale; the
+            # topology scale only applies when BOTH ends have coordinates.
             return None
         if a.tpu_slice and a.tpu_slice == b.tpu_slice:
             return 1.0

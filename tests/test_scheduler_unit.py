@@ -199,3 +199,36 @@ class TestSchedulingFilters:
             assert result.kind == ScheduleResult.NEED_BACK_SOURCE
 
         run_async(body())
+
+
+class TestUploadAccounting:
+    def test_edges_hold_and_release_slots(self):
+        from dragonfly2_tpu.scheduler.scheduling import Scheduling
+
+        s = Scheduling(SchedulingConfig(retry_interval=0.01))
+        t = Task("t1")
+        t.total_piece_count = 10
+        parent = make_peer("p", t, make_host("hp"), state=PeerState.SUCCEEDED, pieces=10)
+        c1 = make_peer("c1", t, make_host("h1"))
+        c2 = make_peer("c2", t, make_host("h2"))
+        t.add_peer_edge("p", "c1")
+        t.add_peer_edge("p", "c2")
+        assert parent.host.concurrent_upload_count == 2
+        t.delete_peer_in_edges("c1")
+        assert parent.host.concurrent_upload_count == 1
+        t.delete_peer("c2")
+        assert parent.host.concurrent_upload_count == 0
+
+    def test_full_parent_filtered(self):
+        from dragonfly2_tpu.scheduler.scheduling import Scheduling
+
+        s = Scheduling(SchedulingConfig(retry_interval=0.01))
+        t = Task("t1")
+        t.total_piece_count = 10
+        h = make_host("hp")
+        h.concurrent_upload_limit = 1
+        parent = make_peer("p", t, h, state=PeerState.SUCCEEDED, pieces=10)
+        c1 = make_peer("c1", t, make_host("h1"))
+        t.add_peer_edge("p", "c1")  # slot taken
+        c2 = make_peer("c2", t, make_host("h2"))
+        assert s.find_candidate_parents(c2) == []

@@ -1,0 +1,167 @@
+"""TPU ops + parallel plans on the virtual 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from dragonfly2_tpu.ops.checksum import checksum_numpy, chunk_checksums  # noqa: E402
+from dragonfly2_tpu.ops.hbm_sink import HBMSink  # noqa: E402
+from dragonfly2_tpu.parallel.ici import (  # noqa: E402
+    all_gather_shards,
+    bitcast_landed_bytes,
+    make_mesh,
+    replicate_to_mesh,
+    ring_all_gather,
+    scatter_shards,
+)
+from dragonfly2_tpu.parallel.topology import TpuTopology, detect_topology  # noqa: E402
+
+
+class TestChecksum:
+    def test_numpy_reference(self):
+        s, x = checksum_numpy(b"\x01\x00\x00\x00\x02\x00\x00\x00")
+        assert s == 3 and x == 3
+        s, x = checksum_numpy(b"\xff\xff\xff\xff" * 2)
+        assert s == (2 * 0xFFFFFFFF) % (1 << 32)
+        assert x == 0
+
+    def test_tail_padding_neutral(self):
+        # Trailing zero bytes change nothing (HBM sink tail pieces).
+        a = checksum_numpy(b"hello world!")
+        b = checksum_numpy(b"hello world!" + b"\x00" * 8)
+        assert a == b
+
+    def test_device_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        piece_words = 256
+        n = 4
+        data = rng.randint(0, 2**31, size=(n * piece_words,)).astype(np.uint32)
+        sums, xors = chunk_checksums(jnp.asarray(data), piece_words)
+        for i in range(n):
+            piece = data[i * piece_words : (i + 1) * piece_words].tobytes()
+            want_s, want_x = checksum_numpy(piece)
+            assert int(sums[i]) == want_s
+            assert int(xors[i]) == want_x
+
+
+class TestHBMSink:
+    def test_land_verify_roundtrip(self):
+        rng = np.random.RandomState(1)
+        content = rng.bytes(40_000)  # not piece-aligned → tail piece
+        sink = HBMSink(len(content), piece_size=16_384, batch_pieces=2)
+        piece = 16_384
+        nums = list(range((len(content) + piece - 1) // piece))
+        rng.shuffle(nums)
+        for n in nums:
+            sink.land_piece(n, content[n * piece : (n + 1) * piece])
+        assert sink.complete()
+        assert sink.verify()
+        out = np.asarray(sink.as_bytes_array()).tobytes()
+        assert out == content
+
+    def test_corruption_detected(self):
+        content = np.random.RandomState(2).bytes(16_384 * 2)
+        sink = HBMSink(len(content), piece_size=16_384)
+        sink.land_piece(0, content[:16_384])
+        # Lie about the host checksum → device verify must catch it.
+        sink.host_checksums[0] = (123, 456)
+        sink.land_piece(1, content[16_384:])
+        with pytest.raises(ValueError, match="piece 0 corrupt"):
+            sink.verify()
+
+    def test_as_tensor_bitcast(self):
+        vals = np.arange(64, dtype=np.float32)
+        content = vals.tobytes()
+        sink = HBMSink(len(content), piece_size=64)
+        for n in range(len(content) // 64):
+            sink.land_piece(n, content[n * 64 : (n + 1) * 64])
+        t = sink.as_tensor("float32", (8, 8))
+        np.testing.assert_array_equal(np.asarray(t).reshape(-1), vals)
+
+    def test_shard_to_mesh(self):
+        mesh = make_mesh(8)
+        content = np.random.RandomState(3).bytes(8 * 1024)
+        sink = HBMSink(len(content), piece_size=1024)
+        for n in range(8):
+            sink.land_piece(n, content[n * 1024 : (n + 1) * 1024])
+        sharded = sink.shard_to_mesh(mesh)
+        assert len(sharded.sharding.device_set) == 8
+        np.testing.assert_array_equal(
+            np.asarray(sharded), np.frombuffer(content, "<u4"))
+
+
+class TestICI:
+    def test_scatter_then_all_gather(self):
+        mesh = make_mesh(8)
+        data = np.arange(8 * 16, dtype=np.uint32)
+        sharded = scatter_shards(mesh, data)
+        assert len(sharded.sharding.device_set) == 8
+        full = all_gather_shards(mesh, sharded)
+        np.testing.assert_array_equal(np.asarray(full), data)
+
+    def test_replicate(self):
+        mesh = make_mesh(8)
+        data = np.arange(32, dtype=np.float32)
+        rep = replicate_to_mesh(mesh, data)
+        assert rep.sharding.is_fully_replicated
+
+    def test_ring_all_gather_matches(self):
+        mesh = make_mesh(8)
+        data = np.arange(8 * 8, dtype=np.uint32)
+        sharded = scatter_shards(mesh, data)
+        ringed = ring_all_gather(mesh, sharded)
+        # Every device's logical row is the full gather.
+        out = np.asarray(ringed)
+        np.testing.assert_array_equal(out.reshape(8, -1)[0], data)
+
+    def test_bitcast_landed_bytes(self):
+        vals = np.arange(16, dtype=np.float32)
+        words = jnp.asarray(np.frombuffer(vals.tobytes(), "<u1"))
+        t = bitcast_landed_bytes(words, "float32", (4, 4))
+        np.testing.assert_array_equal(np.asarray(t).reshape(-1), vals)
+
+
+class TestTopology:
+    def test_env_detection(self, monkeypatch):
+        monkeypatch.setenv("DF_TPU_SLICE", "v5p-slice-3")
+        monkeypatch.setenv("DF_TPU_WORKER", "7")
+        monkeypatch.setenv("DF_TPU_POD", "pod-a")
+        monkeypatch.setenv("DF_ZONE", "us-east5-a")
+        topo = detect_topology()
+        assert topo.present
+        assert topo.worker_index == 7
+        assert topo.location_path() == "us-east5-a|pod-a|v5p-slice-3|w7"
+
+    def test_apply_to_host_config(self, monkeypatch):
+        from dragonfly2_tpu.daemon.config import HostOption
+        from dragonfly2_tpu.parallel.topology import apply_to_host_config
+
+        monkeypatch.setenv("DF_TPU_SLICE", "s1")
+        monkeypatch.setenv("DF_TPU_WORKER", "2")
+        host = HostOption()
+        apply_to_host_config(host)
+        assert host.tpu_slice == "s1"
+        assert host.tpu_worker_index == 2
+        assert host.idc == "s1"
+
+
+def test_graft_entry_single_chip():
+    import __graft_entry__
+
+    fn, args = __graft_entry__.entry()
+    buffer, sums, xors = fn(*args)
+    assert buffer.shape[0] == 8 * 1024
+    # Checksums must match the host reference for each landed piece.
+    pieces = np.asarray(args[1])
+    for i in range(pieces.shape[0]):
+        want_s, want_x = checksum_numpy(pieces[i].tobytes())
+        assert int(sums[i]) == want_s
+        assert int(xors[i]) == want_x
+
+
+def test_graft_entry_dryrun_multichip():
+    import __graft_entry__
+
+    __graft_entry__.dryrun_multichip(8)
