@@ -8,6 +8,39 @@ import asyncio
 
 def register(sub: argparse._SubParsersAction) -> None:
     _add_scheduler(sub)
+    _add_manager(sub)
+
+
+def _add_manager(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser("manager", help="run the manager global control plane")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080, help="REST port")
+    p.add_argument("--grpc-port", type=int, default=65003, help="drpc port")
+    p.add_argument("--db", default=":memory:", help="sqlite path (default in-memory)")
+    p.set_defaults(func=_run_manager)
+
+
+def _run_manager(args: argparse.Namespace) -> int:
+    from dragonfly2_tpu.manager.config import DatabaseConfig, GrpcConfig, ManagerConfig, RestConfig
+    from dragonfly2_tpu.manager.server import ManagerServer
+
+    cfg = ManagerConfig(
+        server=RestConfig(host=args.host, port=args.port),
+        grpc=GrpcConfig(host=args.host, port=args.grpc_port),
+        database=DatabaseConfig(path=args.db),
+    )
+
+    async def run() -> int:
+        server = ManagerServer(cfg)
+        import signal
+
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, lambda: asyncio.ensure_future(server.stop()))
+        await server.serve()
+        return 0
+
+    return asyncio.run(run())
 
 
 def _add_scheduler(sub: argparse._SubParsersAction) -> None:

@@ -1,0 +1,10 @@
+"""Manager: global control plane (reference: manager/).
+
+Cluster relationships, dynamic config, users/RBAC, async jobs, and the
+scheduler/seed-peer registry that dynconfig clients pull from.
+"""
+
+from dragonfly2_tpu.manager.config import ManagerConfig
+from dragonfly2_tpu.manager.server import ManagerServer
+
+__all__ = ["ManagerConfig", "ManagerServer"]

@@ -1,0 +1,87 @@
+"""Auth: password hashing, signed session tokens, RBAC, personal access tokens.
+
+Reference: manager's JWT middleware (appleboy/gin-jwt), casbin RBAC
+(manager/permission/rbac/rbac.go) and personal access tokens
+(manager/models/personal_access_token.go). The equivalent here is
+HMAC-signed tokens (stdlib only — no external JWT dependency) and a
+two-role policy (root: full access, guest: read-only), which is what the
+reference's default casbin policy amounts to.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import secrets
+import time
+
+ROLE_ROOT = "root"
+ROLE_GUEST = "guest"
+
+_PBKDF2_ITERS = 100_000
+
+
+def hash_password(password: str, salt: bytes | None = None) -> str:
+    salt = salt or os.urandom(16)
+    dk = hashlib.pbkdf2_hmac("sha256", password.encode(), salt, _PBKDF2_ITERS)
+    return f"{salt.hex()}${dk.hex()}"
+
+
+def verify_password(password: str, encrypted: str) -> bool:
+    try:
+        salt_hex, dk_hex = encrypted.split("$", 1)
+    except ValueError:
+        return False
+    dk = hashlib.pbkdf2_hmac("sha256", password.encode(), bytes.fromhex(salt_hex),
+                             _PBKDF2_ITERS)
+    return hmac.compare_digest(dk.hex(), dk_hex)
+
+
+class TokenSigner:
+    """HMAC-SHA256 signed bearer tokens: base64(json payload) + '.' + sig."""
+
+    def __init__(self, secret: bytes | None = None, ttl: float = 7 * 24 * 3600):
+        self.secret = secret or os.urandom(32)
+        self.ttl = ttl
+
+    def sign(self, user_id: int, name: str, roles: list[str]) -> str:
+        payload = json.dumps({
+            "uid": user_id, "name": name, "roles": roles,
+            "exp": time.time() + self.ttl,
+        }, separators=(",", ":")).encode()
+        b64 = base64.urlsafe_b64encode(payload).rstrip(b"=")
+        sig = hmac.new(self.secret, b64, hashlib.sha256).hexdigest()
+        return f"{b64.decode()}.{sig}"
+
+    def verify(self, token: str) -> dict | None:
+        try:
+            b64, sig = token.rsplit(".", 1)
+        except ValueError:
+            return None
+        expect = hmac.new(self.secret, b64.encode(), hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(sig, expect):
+            return None
+        try:
+            pad = b64 + "=" * (-len(b64) % 4)
+            payload = json.loads(base64.urlsafe_b64decode(pad))
+        except Exception:
+            return None
+        if payload.get("exp", 0) < time.time():
+            return None
+        return payload
+
+
+def new_personal_access_token() -> str:
+    return "dfp_" + secrets.token_hex(24)
+
+
+def can(roles: list[str], method: str) -> bool:
+    """Default policy: root does anything; guest is read-only (GET)."""
+    if ROLE_ROOT in roles:
+        return True
+    if ROLE_GUEST in roles:
+        return method.upper() in ("GET", "HEAD", "OPTIONS")
+    return False

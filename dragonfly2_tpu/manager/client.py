@@ -1,0 +1,104 @@
+"""Manager drpc client used by schedulers and daemons.
+
+Reference: pkg/rpc/manager/client/client_v2.go — typed wrappers plus the
+KeepAlive helper goroutine (the reference client reconnects and re-opens the
+keepalive stream on failure; same loop here as an asyncio task).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg.types import NetAddr
+from dragonfly2_tpu.rpc.client import Client
+
+log = dflog.get("manager.client")
+
+
+class ManagerClient:
+    def __init__(self, addr: NetAddr):
+        self.addr = addr
+        self._client = Client(addr)
+        self._keepalive_task: asyncio.Task | None = None
+
+    async def close(self) -> None:
+        if self._keepalive_task is not None:
+            self._keepalive_task.cancel()
+            try:
+                await self._keepalive_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._keepalive_task = None
+        await self._client.close()
+
+    # -- registry ----------------------------------------------------------
+
+    async def update_scheduler(self, **req: Any) -> dict:
+        return await self._client.call("Manager.UpdateScheduler", req)
+
+    async def update_seed_peer(self, **req: Any) -> dict:
+        return await self._client.call("Manager.UpdateSeedPeer", req)
+
+    async def get_scheduler_cluster_config(self, cluster_id: int) -> dict:
+        return await self._client.call("Manager.GetSchedulerClusterConfig",
+                                       {"scheduler_cluster_id": cluster_id})
+
+    async def list_schedulers(self, **req: Any) -> list[dict]:
+        resp = await self._client.call("Manager.ListSchedulers", req)
+        return resp["schedulers"]
+
+    async def list_seed_peers(self, scheduler_cluster_id: int) -> list[dict]:
+        resp = await self._client.call("Manager.ListSeedPeers",
+                                       {"scheduler_cluster_id": scheduler_cluster_id})
+        return resp["seed_peers"]
+
+    async def list_applications(self) -> list[dict]:
+        resp = await self._client.call("Manager.ListApplications", {})
+        return resp["applications"]
+
+    async def upsert_peer(self, **req: Any) -> dict:
+        return await self._client.call("Manager.UpsertPeer", req)
+
+    # -- jobs --------------------------------------------------------------
+
+    async def poll_job(self, queue: str, timeout: float = 30.0) -> dict | None:
+        resp = await self._client.call("Manager.PollJob",
+                                       {"queue": queue, "timeout": timeout},
+                                       timeout=timeout + 10.0)
+        return resp.get("item")
+
+    async def complete_job(self, group_id: str, task_uuid: str, state: str,
+                           result: dict[str, Any]) -> None:
+        await self._client.call("Manager.CompleteJob", {
+            "group_id": group_id, "task_uuid": task_uuid,
+            "state": state, "result": result})
+
+    # -- keepalive ---------------------------------------------------------
+
+    def start_keepalive(self, *, source_type: str, hostname: str, ip: str,
+                        cluster_id: int, interval: float = 5.0) -> None:
+        if self._keepalive_task is None or self._keepalive_task.done():
+            self._keepalive_task = asyncio.create_task(self._keepalive_loop(
+                source_type=source_type, hostname=hostname, ip=ip,
+                cluster_id=cluster_id, interval=interval))
+
+    async def _keepalive_loop(self, *, source_type: str, hostname: str, ip: str,
+                              cluster_id: int, interval: float) -> None:
+        while True:
+            try:
+                stream = await self._client.open_stream("Manager.KeepAlive", {
+                    "source_type": source_type, "hostname": hostname,
+                    "ip": ip, "cluster_id": cluster_id})
+                try:
+                    while True:
+                        await asyncio.sleep(interval)
+                        await stream.send({"ts": asyncio.get_event_loop().time()})
+                finally:
+                    await stream.close()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.warning("keepalive stream lost, retrying", error=str(e))
+                await asyncio.sleep(interval)

@@ -1,0 +1,46 @@
+"""Manager config (reference: manager/config/config.go, 706 LoC of nested
+structs; here the same knobs collapsed to what the Python stack consumes)."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class RestConfig:
+    host: str = "127.0.0.1"
+    port: int = 0              # 0 = ephemeral (reference default 8080)
+
+
+@dataclass
+class GrpcConfig:
+    host: str = "127.0.0.1"
+    port: int = 0              # reference default 65003
+
+
+@dataclass
+class DatabaseConfig:
+    # ":memory:" or a path; reference supports mysql/postgres via GORM.
+    path: str = ":memory:"
+
+
+@dataclass
+class ManagerConfig:
+    server: RestConfig = field(default_factory=RestConfig)
+    grpc: GrpcConfig = field(default_factory=GrpcConfig)
+    database: DatabaseConfig = field(default_factory=DatabaseConfig)
+    keepalive_gc_interval: float = 30.0
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ManagerConfig":
+        cfg = cls()
+        if "server" in d:
+            cfg.server = RestConfig(**d["server"])
+        if "grpc" in d:
+            cfg.grpc = GrpcConfig(**d["grpc"])
+        if "database" in d:
+            cfg.database = DatabaseConfig(**d["database"])
+        cfg.keepalive_gc_interval = d.get(
+            "keepalive_gc_interval", cfg.keepalive_gc_interval)
+        return cfg

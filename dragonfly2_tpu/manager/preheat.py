@@ -1,0 +1,77 @@
+"""Preheat argument expansion: image manifests → layer URLs.
+
+Reference: manager/job/preheat.go — CreatePreheat (:111) distinguishes file
+vs image preheats; getImageLayers (:198) fetches the registry manifest and
+emits one preheat URL per layer blob. Scope handling (single seed peer /
+all seed peers / all peers) happens scheduler-side (scheduler/job.py).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import aiohttp
+
+from dragonfly2_tpu.pkg import dflog
+
+log = dflog.get("manager.preheat")
+
+# docker image URL: https://registry/v2/<name>/manifests/<tag>
+_IMAGE_MANIFEST_RE = re.compile(r"^(?P<base>https?://[^/]+)/v2/(?P<name>.+)/manifests/(?P<tag>.+)$")
+
+_MANIFEST_ACCEPT = ", ".join([
+    "application/vnd.docker.distribution.manifest.v2+json",
+    "application/vnd.oci.image.manifest.v1+json",
+    "application/vnd.docker.distribution.manifest.list.v2+json",
+    "application/vnd.oci.image.index.v1+json",
+])
+
+
+async def get_image_layers(url: str, headers: dict[str, str] | None = None,
+                           platform: str = "") -> list[str]:
+    """Resolve a manifest URL into per-layer blob URLs
+    (reference preheat.go:198 getImageLayers, :241 parseLayers)."""
+    m = _IMAGE_MANIFEST_RE.match(url)
+    if not m:
+        raise ValueError(f"not an image manifest URL: {url}")
+    base, name = m.group("base"), m.group("name")
+    req_headers = dict(headers or {})
+    req_headers["Accept"] = _MANIFEST_ACCEPT
+    async with aiohttp.ClientSession() as session:
+        async with session.get(url, headers=req_headers) as resp:
+            resp.raise_for_status()
+            manifest = await resp.json(content_type=None)
+        # Manifest list/index: pick the matching (or first) platform manifest.
+        if "manifests" in manifest:
+            entry = manifest["manifests"][0]
+            if platform:
+                want_os, _, want_arch = platform.partition("/")
+                for cand in manifest["manifests"]:
+                    p = cand.get("platform", {})
+                    if p.get("os") == want_os and p.get("architecture") == want_arch:
+                        entry = cand
+                        break
+            digest = entry["digest"]
+            async with session.get(f"{base}/v2/{name}/manifests/{digest}",
+                                   headers=req_headers) as resp:
+                resp.raise_for_status()
+                manifest = await resp.json(content_type=None)
+    layers = manifest.get("layers", [])
+    return [f"{base}/v2/{name}/blobs/{layer['digest']}" for layer in layers]
+
+
+async def expand_preheat_args(args: dict[str, Any]) -> dict[str, Any]:
+    """Normalise REST preheat args into {urls, tag, application, headers,
+    filtered_query_params, scope, piece_length}."""
+    out = dict(args)
+    ptype = args.get("type", "file")
+    if ptype == "image":
+        layers = await get_image_layers(args["url"], args.get("headers"),
+                                        args.get("platform", ""))
+        out["urls"] = layers
+        log.info("image preheat expanded", url=args["url"], layers=len(layers))
+    else:
+        out.setdefault("urls", [args["url"]] if args.get("url") else [])
+    out.setdefault("scope", "single_seed_peer")
+    return out

@@ -1,0 +1,293 @@
+"""Manager REST API (reference: manager/handlers/*.go, gin router in
+manager/router/router.go; swagger at api/manager/swagger.yaml).
+
+aiohttp application with bearer-token auth middleware (session tokens or
+personal access tokens) and the two-role policy from manager/auth.py.
+Resources mirror the reference handler files: users, scheduler-clusters,
+schedulers, seed-peer-clusters, seed-peers, peers, applications, configs,
+personal-access-tokens, oauth, jobs, healthy.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from aiohttp import web
+
+from dragonfly2_tpu.manager import auth, jobqueue
+from dragonfly2_tpu.manager.preheat import expand_preheat_args
+from dragonfly2_tpu.manager.service import ManagerService
+from dragonfly2_tpu.pkg import dflog, metrics
+from dragonfly2_tpu.pkg.errors import Code, DfError
+
+log = dflog.get("manager.rest")
+
+_PUBLIC = {("POST", "/api/v1/users/signin"), ("POST", "/api/v1/users/signup"),
+           ("GET", "/healthy"), ("GET", "/metrics")}
+
+# table -> mutable columns accepted from the API
+_RESOURCES: dict[str, set[str]] = {
+    "scheduler-clusters": {"name", "bio", "config", "client_config", "scopes",
+                           "is_default"},
+    "seed-peer-clusters": {"name", "bio", "config"},
+    "schedulers": {"hostname", "idc", "location", "ip", "port", "state",
+                   "features", "scheduler_cluster_id"},
+    "seed-peers": {"hostname", "type", "idc", "location", "ip", "port",
+                   "download_port", "object_storage_port", "state",
+                   "seed_peer_cluster_id"},
+    "peers": set(),  # read/delete only; rows come from sync-peers jobs
+    "applications": {"name", "url", "bio", "priority", "user_id"},
+    "configs": {"name", "value", "bio", "user_id"},
+    "oauth": {"name", "bio", "client_id", "client_secret", "redirect_url"},
+    "buckets": {"name"},
+}
+_TABLE_OF = {r: r.replace("-", "_") for r in _RESOURCES}
+
+
+def _redact(table: str, row: dict[str, Any]) -> dict[str, Any]:
+    """Secrets never leave via read endpoints (tokens are shown once at
+    creation; oauth client secrets are write-only)."""
+    if table == "oauth" and row.get("client_secret"):
+        row = dict(row)
+        row["client_secret"] = "***"
+    if table == "personal_access_tokens" and row.get("token"):
+        row = dict(row)
+        row["token"] = "***"
+    return row
+
+
+def json_error(e: Exception) -> web.Response:
+    if isinstance(e, DfError):
+        status = {Code.NotFound: 404, Code.Unauthorized: 401,
+                  Code.InvalidArgument: 400}.get(e.code, 500)
+        return web.json_response({"message": e.message}, status=status)
+    return web.json_response({"message": str(e)}, status=500)
+
+
+class RestServer:
+    def __init__(self, service: ManagerService):
+        self.service = service
+        self._runner: web.AppRunner | None = None
+        self._port = 0
+
+    def build_app(self) -> web.Application:
+        app = web.Application(middlewares=[self._auth_middleware])
+        r = app.router
+        r.add_get("/healthy", self._healthy)
+        r.add_get("/metrics", self._metrics)
+        r.add_post("/api/v1/users/signin", self._signin)
+        r.add_post("/api/v1/users/signup", self._signup)
+        r.add_get("/api/v1/users/{id}", self._get_user)
+        r.add_post("/api/v1/users/{id}/reset_password", self._reset_password)
+        r.add_get("/api/v1/users/{id}/roles", self._get_roles)
+        r.add_post("/api/v1/personal-access-tokens", self._create_pat)
+        r.add_get("/api/v1/personal-access-tokens", self._list_pats)
+        r.add_delete("/api/v1/personal-access-tokens/{id}", self._delete_pat)
+        r.add_post("/api/v1/jobs", self._create_job)
+        r.add_get("/api/v1/jobs", self._list_jobs)
+        r.add_get("/api/v1/jobs/{id}", self._get_job)
+        for res in _RESOURCES:
+            r.add_post(f"/api/v1/{res}", self._create(res))
+            r.add_get(f"/api/v1/{res}", self._list(res))
+            r.add_get(f"/api/v1/{res}/{{id}}", self._get(res))
+            r.add_patch(f"/api/v1/{res}/{{id}}", self._patch(res))
+            r.add_delete(f"/api/v1/{res}/{{id}}", self._delete(res))
+        r.add_put("/api/v1/scheduler-clusters/{id}/seed-peer-clusters/{spc_id}",
+                  self._link_clusters)
+        return app
+
+    async def serve(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._runner = web.AppRunner(self.build_app(), access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self._port = site._server.sockets[0].getsockname()[1]
+        log.info("manager REST up", port=self._port)
+        return self._port
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # -- middleware --------------------------------------------------------
+
+    @web.middleware
+    async def _auth_middleware(self, request: web.Request, handler):
+        if (request.method, request.path) in _PUBLIC:
+            return await handler(request)
+        token = request.headers.get("Authorization", "")
+        if token.startswith("Bearer "):
+            token = token[7:]
+        identity = self.service.verify_token(token) if token else None
+        if identity is None:
+            return web.json_response({"message": "unauthorized"}, status=401)
+        if not auth.can(identity.get("roles", []), request.method):
+            return web.json_response({"message": "forbidden"}, status=403)
+        request["identity"] = identity
+        try:
+            return await handler(request)
+        except (DfError, KeyError, ValueError) as e:
+            if isinstance(e, DfError):
+                return json_error(e)
+            return web.json_response({"message": str(e)}, status=400)
+
+    # -- auth endpoints ----------------------------------------------------
+
+    async def _signin(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        try:
+            token = self.service.signin(body["name"], body["password"])
+        except DfError as e:
+            return json_error(e)
+        return web.json_response({"token": token})
+
+    async def _signup(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        try:
+            user = self.service.signup(body["name"], body["password"],
+                                       body.get("email", ""))
+        except DfError as e:
+            return json_error(e)
+        return web.json_response(user)
+
+    async def _get_user(self, request: web.Request) -> web.Response:
+        user = self.service.db.get("users", int(request.match_info["id"]))
+        if not user:
+            return web.json_response({"message": "not found"}, status=404)
+        return web.json_response(self.service._public_user(user))
+
+    async def _get_roles(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"roles": self.service.roles_of(int(request.match_info["id"]))})
+
+    async def _reset_password(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        self.service.reset_password(int(request.match_info["id"]),
+                                    body["new_password"])
+        return web.json_response({})
+
+    async def _create_pat(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        token = auth.new_personal_access_token()
+        row = self.service.db.insert("personal_access_tokens", {
+            "name": body["name"], "token": token,
+            "bio": body.get("bio", ""), "scopes": body.get("scopes", []),
+            "expired_at": body.get("expired_at", 0),
+            "user_id": request["identity"]["uid"],
+        })
+        return web.json_response(row)
+
+    async def _list_pats(self, request: web.Request) -> web.Response:
+        ident = request["identity"]
+        rows = self.service.db.list("personal_access_tokens")
+        if auth.ROLE_ROOT not in ident.get("roles", []):
+            rows = [r for r in rows if r["user_id"] == ident["uid"]]
+        # The secret is shown exactly once, at creation time.
+        for r in rows:
+            r["token"] = "***"
+        return web.json_response(rows)
+
+    async def _delete_pat(self, request: web.Request) -> web.Response:
+        self.service.db.delete("personal_access_tokens", int(request.match_info["id"]))
+        return web.json_response({})
+
+    # -- jobs --------------------------------------------------------------
+
+    async def _create_job(self, request: web.Request) -> web.Response:
+        """POST /api/v1/jobs {type: preheat|sync_peers|get_task|delete_task,
+        args: {...}, scheduler_cluster_ids: [...]} — reference
+        manager/handlers/job.go:42 + manager/job/preheat.go:111."""
+        body = await request.json()
+        job_type = body.get("type")
+        if job_type not in (jobqueue.PREHEAT_JOB, jobqueue.SYNC_PEERS_JOB,
+                            jobqueue.GET_TASK_JOB, jobqueue.DELETE_TASK_JOB):
+            return web.json_response({"message": f"unknown job type {job_type}"},
+                                     status=400)
+        args = body.get("args", {})
+        if job_type == jobqueue.PREHEAT_JOB:
+            args = await expand_preheat_args(args)
+        cluster_ids = body.get("scheduler_cluster_ids") or [
+            c["id"] for c in self.service.db.list("scheduler_clusters")]
+        job = self.service.jobs.enqueue_job(
+            job_type, args, cluster_ids,
+            user_id=request["identity"]["uid"], bio=body.get("bio", ""))
+        return web.json_response(job)
+
+    async def _list_jobs(self, request: web.Request) -> web.Response:
+        where: dict[str, Any] = {}
+        if "state" in request.query:
+            where["state"] = request.query["state"]
+        return web.json_response(self.service.db.list("jobs", **where))
+
+    async def _get_job(self, request: web.Request) -> web.Response:
+        job = self.service.db.get("jobs", int(request.match_info["id"]))
+        if not job:
+            return web.json_response({"message": "not found"}, status=404)
+        return web.json_response(job)
+
+    # -- generic resource CRUD --------------------------------------------
+
+    def _create(self, res: str):
+        table, cols = _TABLE_OF[res], _RESOURCES[res]
+        async def handler(request: web.Request) -> web.Response:
+            body = await request.json()
+            values = {k: v for k, v in body.items() if k in cols}
+            row = self.service.db.insert(table, values)
+            return web.json_response(row)
+        return handler
+
+    def _list(self, res: str):
+        table = _TABLE_OF[res]
+        async def handler(request: web.Request) -> web.Response:
+            q = request.query
+            where = {k: q[k] for k in ("state", "name", "hostname", "ip") if k in q}
+            page = int(q.get("page", 0))
+            per_page = int(q.get("per_page", 0))
+            rows = self.service.db.list(
+                table, limit=per_page, offset=max(page - 1, 0) * per_page, **where)
+            return web.json_response([_redact(table, r) for r in rows])
+        return handler
+
+    def _get(self, res: str):
+        table = _TABLE_OF[res]
+        async def handler(request: web.Request) -> web.Response:
+            row = self.service.db.get(table, int(request.match_info["id"]))
+            if not row:
+                return web.json_response({"message": "not found"}, status=404)
+            return web.json_response(_redact(table, row))
+        return handler
+
+    def _patch(self, res: str):
+        table, cols = _TABLE_OF[res], _RESOURCES[res]
+        async def handler(request: web.Request) -> web.Response:
+            body = await request.json()
+            values = {k: v for k, v in body.items() if k in cols}
+            row = self.service.db.update(table, int(request.match_info["id"]), values)
+            if not row:
+                return web.json_response({"message": "not found"}, status=404)
+            return web.json_response(row)
+        return handler
+
+    def _delete(self, res: str):
+        table = _TABLE_OF[res]
+        async def handler(request: web.Request) -> web.Response:
+            ok = self.service.db.delete(table, int(request.match_info["id"]))
+            return web.json_response({}, status=200 if ok else 404)
+        return handler
+
+    async def _link_clusters(self, request: web.Request) -> web.Response:
+        self.service.db.link_seed_peer_cluster(
+            int(request.match_info["id"]), int(request.match_info["spc_id"]))
+        return web.json_response({})
+
+    async def _healthy(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", "ts": time.time()})
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        body, ctype = metrics.render()
+        return web.Response(body=body, content_type=ctype.split(";")[0])

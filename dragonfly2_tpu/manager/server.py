@@ -1,0 +1,61 @@
+"""Manager server bootstrap (reference: manager/manager.go:107 New — gin REST
++ gRPC v1/v2 + metrics + cache, graceful stop)."""
+
+from __future__ import annotations
+
+import asyncio
+
+from dragonfly2_tpu.manager.config import ManagerConfig
+from dragonfly2_tpu.manager.database import Database
+from dragonfly2_tpu.manager.rest import RestServer
+from dragonfly2_tpu.manager.rpcserver import ManagerRpcServer
+from dragonfly2_tpu.manager.service import ManagerService
+from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg.cache import GC, GCTask
+from dragonfly2_tpu.pkg.types import NetAddr
+from dragonfly2_tpu.rpc import Server
+
+log = dflog.get("manager.server")
+
+
+class ManagerServer:
+    def __init__(self, config: ManagerConfig | None = None):
+        self.config = config or ManagerConfig()
+        self.db = Database(self.config.database.path)
+        self.service = ManagerService(self.db)
+        self.rest = RestServer(self.service)
+        self.rpc = Server("manager")
+        ManagerRpcServer(self.service).register(self.rpc)
+        self.gc = GC(log)
+        self.gc.add(GCTask("keepalive", self.config.keepalive_gc_interval, 10.0,
+                           self._expire))
+        self._stopped = asyncio.Event()
+
+    async def _expire(self) -> None:
+        n = self.service.expire_stale()
+        if n:
+            log.info("keepalive expiry", flipped=n)
+
+    async def start(self) -> None:
+        await self.rest.serve(self.config.server.host, self.config.server.port)
+        await self.rpc.serve(NetAddr.tcp(self.config.grpc.host, self.config.grpc.port))
+        self.gc.serve()
+        log.info("manager up", rest_port=self.rest.port, grpc_port=self.rpc.port())
+
+    async def serve(self) -> None:
+        await self.start()
+        await self._stopped.wait()
+
+    @property
+    def rest_port(self) -> int:
+        return self.rest.port
+
+    def grpc_port(self) -> int:
+        return self.rpc.port()
+
+    async def stop(self) -> None:
+        self.gc.stop()
+        await self.rest.close()
+        await self.rpc.close()
+        self.db.close()
+        self._stopped.set()

@@ -1,0 +1,261 @@
+"""Manager business logic (reference: manager/service/*.go).
+
+One service object over the Database; REST handlers and the RPC server both
+call into it. Read paths that dynconfig clients hammer (GetScheduler,
+ListSchedulers, seed-peer listings) go through a short TTL cache, mirroring
+the reference's Redis+LFU cache layer (manager/cache/).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from dragonfly2_tpu.manager import auth, jobqueue
+from dragonfly2_tpu.manager.database import Database
+from dragonfly2_tpu.manager.searcher import Searcher, SearchRequest
+from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg.cache import TTLCache
+from dragonfly2_tpu.pkg.errors import Code, DfError
+
+log = dflog.get("manager.service")
+
+ACTIVE = "active"
+INACTIVE = "inactive"
+
+# Keepalive liveness window (reference manager/rpcserver keepalive TTL).
+KEEPALIVE_TIMEOUT = 60.0
+_CACHE_TTL = 10.0
+
+
+class ManagerService:
+    def __init__(self, db: Database | None = None):
+        self.db = db or Database()
+        self.searcher = Searcher()
+        self.jobs = jobqueue.JobQueue(self.db)
+        self.signer = auth.TokenSigner()
+        self._cache = TTLCache(default_ttl=_CACHE_TTL)
+        # Keepalive stream generations: the newest stream per instance owns
+        # liveness; stale stream teardowns must not flip an instance inactive.
+        self._ka_gen: dict[tuple, int] = {}
+        self._ensure_defaults()
+
+    def _ensure_defaults(self) -> None:
+        """Seed a root user and default clusters so a fresh deployment works
+        out of the box (the reference ships migrations doing the same)."""
+        if not self.db.find("users", name="root"):
+            root = self.db.insert("users", {
+                "name": "root",
+                "encrypted_password": auth.hash_password("dragonfly"),
+            })
+            self.db.insert("user_roles", {"user_id": root["id"], "role": auth.ROLE_ROOT})
+        if not self.db.find("scheduler_clusters", name="default"):
+            sc = self.db.insert("scheduler_clusters", {
+                "name": "default", "is_default": 1,
+                "config": {"candidate_parent_limit": 4, "filter_parent_limit": 15},
+                "client_config": {"load_limit": 200},
+            })
+            spc = self.db.insert("seed_peer_clusters", {
+                "name": "default",
+                "config": {"load_limit": 2000},
+            })
+            self.db.link_seed_peer_cluster(sc["id"], spc["id"])
+
+    # -- users / auth ------------------------------------------------------
+
+    def signup(self, name: str, password: str, email: str = "") -> dict:
+        if self.db.find("users", name=name):
+            raise DfError(Code.InvalidArgument, f"user {name} exists")
+        user = self.db.insert("users", {
+            "name": name, "encrypted_password": auth.hash_password(password),
+            "email": email,
+        })
+        self.db.insert("user_roles", {"user_id": user["id"], "role": auth.ROLE_GUEST})
+        return self._public_user(user)
+
+    def signin(self, name: str, password: str) -> str:
+        user = self.db.find("users", name=name)
+        if not user or not auth.verify_password(password, user["encrypted_password"]):
+            raise DfError(Code.Unauthorized, "bad credentials")
+        return self.signer.sign(user["id"], name, self.roles_of(user["id"]))
+
+    def roles_of(self, user_id: int) -> list[str]:
+        return [r["role"] for r in self.db.list("user_roles", user_id=user_id)]
+
+    def reset_password(self, user_id: int, new_password: str) -> None:
+        self.db.update("users", user_id,
+                       {"encrypted_password": auth.hash_password(new_password)})
+
+    def _public_user(self, user: dict) -> dict:
+        out = dict(user)
+        out.pop("encrypted_password", None)
+        return out
+
+    def verify_token(self, token: str) -> dict | None:
+        """Session token or personal access token -> identity payload."""
+        payload = self.signer.verify(token)
+        if payload:
+            return payload
+        pat = self.db.find("personal_access_tokens", token=token)
+        if pat and pat["state"] == "active" and (
+                pat["expired_at"] == 0 or pat["expired_at"] > time.time()):
+            # Fail closed: a PAT grants exactly its owner's roles; an owner
+            # with no roles (disabled account) authenticates to nothing.
+            return {"uid": pat["user_id"], "name": pat["name"],
+                    "roles": self.roles_of(pat["user_id"]), "pat": True}
+        return None
+
+    # -- registry (self-registration + keepalive) --------------------------
+
+    def update_scheduler(self, req: dict[str, Any]) -> dict:
+        """Upsert by (hostname, ip, cluster) — reference
+        manager_server_v2.go:236 UpdateScheduler."""
+        cluster_id = int(req.get("scheduler_cluster_id") or
+                         self._default_cluster_id("scheduler_clusters"))
+        row = self.db.find("schedulers", hostname=req["hostname"], ip=req["ip"],
+                           scheduler_cluster_id=cluster_id)
+        values = {
+            "hostname": req["hostname"], "ip": req["ip"],
+            "port": int(req.get("port", 8002)),
+            "idc": req.get("idc", ""), "location": req.get("location", ""),
+            "features": req.get("features", []),
+            "scheduler_cluster_id": cluster_id,
+            "state": ACTIVE, "last_keepalive_at": time.time(),
+        }
+        self._cache = TTLCache(default_ttl=_CACHE_TTL)  # invalidate
+        ka_key = ("scheduler", req["hostname"], req["ip"], cluster_id)
+        self._ka_gen[ka_key] = self._ka_gen.get(ka_key, 0) + 1
+        if row:
+            return self.db.update("schedulers", row["id"], values)
+        return self.db.insert("schedulers", values)
+
+    def update_seed_peer(self, req: dict[str, Any]) -> dict:
+        cluster_id = int(req.get("seed_peer_cluster_id") or
+                         self._default_cluster_id("seed_peer_clusters"))
+        row = self.db.find("seed_peers", hostname=req["hostname"], ip=req["ip"],
+                           seed_peer_cluster_id=cluster_id)
+        values = {
+            "hostname": req["hostname"], "ip": req["ip"],
+            "port": int(req.get("port", 65000)),
+            "download_port": int(req.get("download_port", 0)),
+            "object_storage_port": int(req.get("object_storage_port", 0)),
+            "type": req.get("type", "super"),
+            "idc": req.get("idc", ""), "location": req.get("location", ""),
+            "seed_peer_cluster_id": cluster_id,
+            "state": ACTIVE, "last_keepalive_at": time.time(),
+        }
+        self._cache = TTLCache(default_ttl=_CACHE_TTL)
+        ka_key = ("seed_peer", req["hostname"], req["ip"], cluster_id)
+        self._ka_gen[ka_key] = self._ka_gen.get(ka_key, 0) + 1
+        if row:
+            return self.db.update("seed_peers", row["id"], values)
+        return self.db.insert("seed_peers", values)
+
+    def _default_cluster_id(self, table: str) -> int:
+        row = self.db.find(table, name="default")
+        if not row:
+            raise DfError(Code.NotFound, f"no default {table}")
+        return row["id"]
+
+    def keepalive_open(self, source_type: str, hostname: str, ip: str,
+                       cluster_id: int) -> int:
+        """New keepalive stream: bump the generation and mark active. The
+        returned token must be passed back to mark_inactive."""
+        key = (source_type, hostname, ip, cluster_id)
+        gen = self._ka_gen.get(key, 0) + 1
+        self._ka_gen[key] = gen
+        self.keepalive(source_type, hostname, ip, cluster_id)
+        return gen
+
+    def keepalive(self, source_type: str, hostname: str, ip: str, cluster_id: int) -> None:
+        table = "schedulers" if source_type == "scheduler" else "seed_peers"
+        key = ("scheduler_cluster_id" if table == "schedulers"
+               else "seed_peer_cluster_id")
+        row = self.db.find(table, hostname=hostname, ip=ip, **{key: cluster_id})
+        if row:
+            self.db.update(table, row["id"],
+                           {"state": ACTIVE, "last_keepalive_at": time.time()})
+
+    def mark_inactive(self, source_type: str, hostname: str, ip: str,
+                      cluster_id: int, gen: int | None = None) -> None:
+        if gen is not None and self._ka_gen.get(
+                (source_type, hostname, ip, cluster_id)) != gen:
+            return  # a newer stream (or re-registration) owns liveness
+        table = "schedulers" if source_type == "scheduler" else "seed_peers"
+        key = ("scheduler_cluster_id" if table == "schedulers"
+               else "seed_peer_cluster_id")
+        row = self.db.find(table, hostname=hostname, ip=ip, **{key: cluster_id})
+        if row:
+            self.db.update(table, row["id"], {"state": INACTIVE})
+
+    def expire_stale(self) -> int:
+        """Flip rows whose keepalive lapsed to inactive (GC task)."""
+        cutoff = time.time() - KEEPALIVE_TIMEOUT
+        n = 0
+        for table in ("schedulers", "seed_peers"):
+            for row in self.db.list(table, state=ACTIVE):
+                if row["last_keepalive_at"] < cutoff:
+                    self.db.update(table, row["id"], {"state": INACTIVE})
+                    n += 1
+        return n
+
+    # -- dynconfig read paths ---------------------------------------------
+
+    def list_schedulers(self, req: dict[str, Any]) -> list[dict]:
+        """Searcher-ranked active schedulers for a requesting daemon
+        (reference manager_server_v2.go:151 ListSchedulers)."""
+        cache_key = "ls:" + repr(sorted(req.items()))
+        hit, ok = self._cache.get(cache_key)
+        if ok:
+            return hit
+        sreq = SearchRequest(hostname=req.get("hostname", ""), ip=req.get("ip", ""),
+                             idc=req.get("idc", ""), location=req.get("location", ""),
+                             pod=req.get("pod", ""))
+        clusters = self.searcher.find_scheduler_clusters(
+            self.db.list("scheduler_clusters"), sreq)
+        out: list[dict] = []
+        for cluster in clusters:
+            out += self.db.list("schedulers", scheduler_cluster_id=cluster["id"],
+                                state=ACTIVE)
+        self._cache.set(cache_key, out)
+        return out
+
+    def get_scheduler_cluster_config(self, cluster_id: int) -> dict:
+        cluster = self.db.get("scheduler_clusters", cluster_id)
+        if not cluster:
+            raise DfError(Code.NotFound, f"scheduler cluster {cluster_id}")
+        return cluster
+
+    def list_seed_peers_for_cluster(self, scheduler_cluster_id: int) -> list[dict]:
+        """Active seed peers of every seed-peer cluster linked to this
+        scheduler cluster (what scheduler dynconfig pulls)."""
+        cache_key = f"sp:{scheduler_cluster_id}"
+        hit, ok = self._cache.get(cache_key)
+        if ok:
+            return hit
+        out: list[dict] = []
+        for spc_id in self.db.seed_peer_clusters_of(scheduler_cluster_id):
+            out += self.db.list("seed_peers", seed_peer_cluster_id=spc_id,
+                                state=ACTIVE)
+        self._cache.set(cache_key, out)
+        return out
+
+    def list_applications(self) -> list[dict]:
+        return self.db.list("applications")
+
+    # -- peers (sync-peers results) ---------------------------------------
+
+    def upsert_peer(self, req: dict[str, Any]) -> dict:
+        cluster_id = int(req.get("scheduler_cluster_id", 0))
+        row = self.db.find("peers", hostname=req.get("hostname", ""),
+                           ip=req.get("ip", ""), scheduler_cluster_id=cluster_id)
+        values = {k: req[k] for k in (
+            "hostname", "type", "idc", "location", "ip", "port", "download_port",
+            "object_storage_port", "os", "platform", "platform_family",
+            "platform_version", "kernel_version", "git_version", "git_commit",
+            "build_platform") if k in req}
+        values["scheduler_cluster_id"] = cluster_id
+        values["state"] = ACTIVE
+        if row:
+            return self.db.update("peers", row["id"], values)
+        return self.db.insert("peers", values)

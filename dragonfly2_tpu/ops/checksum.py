@@ -125,6 +125,7 @@ def chunk_checksums(words, piece_words: int, *, use_pallas: bool | None = None):
     back to the XLA reduction otherwise (identical results).
     """
     n_pieces = words.shape[0] // piece_words
+    explicit = use_pallas is not None
     if use_pallas is None:
         use_pallas = (_pallas_available() and piece_words % 128 == 0
                       and n_pieces % 8 == 0)
@@ -132,5 +133,6 @@ def chunk_checksums(words, piece_words: int, *, use_pallas: bool | None = None):
         try:
             return _chunk_checksums_pallas(words, piece_words)
         except Exception:
-            pass
+            if explicit:
+                raise  # the caller demanded the kernel; surface its failure
     return _chunk_checksums_xla(words, piece_words)

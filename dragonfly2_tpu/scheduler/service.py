@@ -331,6 +331,13 @@ class SchedulerService:
             msg.get("piece_size", task.piece_size),
             msg.get("total_piece_count", task.total_piece_count),
         )
+        # Detach from parents: the finished peer downloads nothing anymore, so
+        # its parents' upload slots must come back (it stays in the DAG as a
+        # parent candidate via its own out-edges).
+        try:
+            task.delete_peer_in_edges(peer.id)
+        except Exception:
+            pass
         if task.fsm.can("download_succeeded"):
             task.fsm.event("download_succeeded")
         log.info("peer finished", peer=peer.id[:24], task=task.id[:16])

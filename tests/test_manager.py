@@ -1,0 +1,261 @@
+"""Manager tests: database CRUD, searcher affinity, auth, REST API, RPC
+registry + keepalive, job queue. Mirrors the reference's per-handler tests
+(manager/handlers/*_test.go) and searcher_test.go."""
+
+from __future__ import annotations
+
+import asyncio
+
+import aiohttp
+import pytest
+
+from dragonfly2_tpu.manager import auth, jobqueue
+from dragonfly2_tpu.manager.client import ManagerClient
+from dragonfly2_tpu.manager.config import DatabaseConfig, ManagerConfig
+from dragonfly2_tpu.manager.database import Database
+from dragonfly2_tpu.manager.searcher import Searcher, SearchRequest
+from dragonfly2_tpu.manager.server import ManagerServer
+from dragonfly2_tpu.manager.service import ManagerService
+from dragonfly2_tpu.pkg.types import NetAddr
+
+
+# -- database ---------------------------------------------------------------
+
+def test_database_crud_json_roundtrip():
+    db = Database()
+    row = db.insert("scheduler_clusters", {
+        "name": "c1", "config": {"x": 1}, "scopes": {"idc": "idc-a"}})
+    assert row["config"] == {"x": 1}
+    got = db.find("scheduler_clusters", name="c1")
+    assert got["scopes"]["idc"] == "idc-a"
+    db.update("scheduler_clusters", row["id"], {"config": {"x": 2}})
+    assert db.get("scheduler_clusters", row["id"])["config"] == {"x": 2}
+    assert db.count("scheduler_clusters") == 1
+    assert db.delete("scheduler_clusters", row["id"])
+    assert db.get("scheduler_clusters", row["id"]) is None
+
+
+def test_database_cluster_links():
+    db = Database()
+    db.link_seed_peer_cluster(1, 7)
+    db.link_seed_peer_cluster(1, 7)  # idempotent
+    db.link_seed_peer_cluster(1, 9)
+    assert sorted(db.seed_peer_clusters_of(1)) == [7, 9]
+
+
+# -- searcher ---------------------------------------------------------------
+
+def _cluster(name, scopes, is_default=0):
+    return {"id": hash(name) % 1000, "name": name, "scopes": scopes,
+            "is_default": is_default}
+
+
+def test_searcher_prefers_scope_matches():
+    s = Searcher()
+    default = _cluster("default", {}, is_default=1)
+    idc = _cluster("idc", {"idc": "tpu-v5p"})
+    cidr = _cluster("cidr", {"cidrs": ["10.0.0.0/8"]})
+    req = SearchRequest(hostname="host-1", ip="10.1.2.3", idc="tpu-v5p")
+    ranked = s.find_scheduler_clusters([default, idc, cidr], req)
+    # Both scope clusters outrank the default; cidr (0.3) == idc (0.3).
+    assert {c["name"] for c in ranked[:2]} == {"idc", "cidr"}
+
+
+def test_searcher_falls_back_to_default():
+    s = Searcher()
+    default = _cluster("default", {}, is_default=1)
+    other = _cluster("other", {"idc": "nope"})
+    req = SearchRequest(hostname="h", ip="192.168.1.1", idc="different")
+    ranked = s.find_scheduler_clusters([other, default], req)
+    assert ranked[0]["name"] == "default"
+
+
+def test_searcher_location_prefix_and_hostname_regex():
+    s = Searcher()
+    loc = _cluster("loc", {"location": "us|west|zone-a"})
+    host = _cluster("host", {"hostnames": ["^tpu-worker-\\d+$"]})
+    req = SearchRequest(hostname="tpu-worker-17", location="us|west|zone-b")
+    assert s.evaluate(req, loc) == pytest.approx(0.08 * 2 / 5)
+    assert s.evaluate(req, host) == pytest.approx(0.3)
+
+
+# -- auth -------------------------------------------------------------------
+
+def test_password_and_token_roundtrip():
+    enc = auth.hash_password("s3cret")
+    assert auth.verify_password("s3cret", enc)
+    assert not auth.verify_password("wrong", enc)
+    signer = auth.TokenSigner()
+    token = signer.sign(1, "root", ["root"])
+    payload = signer.verify(token)
+    assert payload["name"] == "root" and payload["roles"] == ["root"]
+    assert signer.verify(token + "x") is None
+    assert auth.can(["root"], "DELETE")
+    assert auth.can(["guest"], "GET")
+    assert not auth.can(["guest"], "POST")
+
+
+def test_service_signup_signin_and_pat():
+    svc = ManagerService()
+    svc.signup("alice", "pw", email="a@b.c")
+    token = svc.signin("alice", "pw")
+    ident = svc.verify_token(token)
+    assert ident["name"] == "alice" and auth.ROLE_GUEST in ident["roles"]
+    with pytest.raises(Exception):
+        svc.signin("alice", "bad")
+
+
+def test_service_defaults_seeded():
+    svc = ManagerService()
+    assert svc.db.find("users", name="root") is not None
+    sc = svc.db.find("scheduler_clusters", name="default")
+    assert sc["is_default"]
+    assert svc.db.seed_peer_clusters_of(sc["id"])
+
+
+# -- registry + keepalive over real RPC ------------------------------------
+
+def test_manager_rpc_registry_and_keepalive(run_async):
+    run_async(_rpc_registry_and_keepalive())
+
+
+async def _rpc_registry_and_keepalive():
+    server = ManagerServer(ManagerConfig())
+    await server.start()
+    client = ManagerClient(NetAddr.tcp("127.0.0.1", server.grpc_port()))
+    try:
+        sched = await client.update_scheduler(
+            hostname="sched-1", ip="127.0.0.1", port=8002, idc="tpu-v5p")
+        assert sched["state"] == "active"
+        cluster_id = sched["scheduler_cluster_id"]
+
+        seed = await client.update_seed_peer(
+            hostname="seed-1", ip="127.0.0.1", port=65000, download_port=65002)
+        assert seed["state"] == "active"
+
+        # dynconfig read paths
+        listed = await client.list_schedulers(hostname="worker", ip="10.0.0.1")
+        assert any(s["hostname"] == "sched-1" for s in listed)
+        seeds = await client.list_seed_peers(cluster_id)
+        assert any(s["hostname"] == "seed-1" for s in seeds)
+        cfg = await client.get_scheduler_cluster_config(cluster_id)
+        assert cfg["client_config"]["load_limit"] == 200
+
+        # keepalive stream: close -> inactive
+        stream = await client._client.open_stream("Manager.KeepAlive", {
+            "source_type": "scheduler", "hostname": "sched-1",
+            "ip": "127.0.0.1", "cluster_id": cluster_id})
+        await stream.send({})
+        await asyncio.sleep(0.05)
+        await stream.close()
+        await asyncio.sleep(0.1)
+        row = server.db.find("schedulers", hostname="sched-1", ip="127.0.0.1",
+                             scheduler_cluster_id=cluster_id)
+        assert row["state"] == "inactive"
+    finally:
+        await client.close()
+        await server.stop()
+
+
+# -- job queue --------------------------------------------------------------
+
+def test_job_queue_group_aggregation(run_async):
+    run_async(_job_queue_group_aggregation())
+
+
+async def _job_queue_group_aggregation():
+    svc = ManagerService()
+    job = svc.jobs.enqueue_job(jobqueue.PREHEAT_JOB, {"urls": ["http://x/f"]},
+                               [1, 2])
+    i1 = await svc.jobs.poll(jobqueue.queue_name(1), timeout=1.0)
+    i2 = await svc.jobs.poll(jobqueue.queue_name(2), timeout=1.0)
+    assert i1.type == jobqueue.PREHEAT_JOB and i2.group_id == i1.group_id
+    svc.jobs.complete(i1.group_id, i1.task_uuid, jobqueue.SUCCESS, {"n": 1})
+    assert svc.db.get("jobs", job["id"])["state"] == jobqueue.STARTED
+    svc.jobs.complete(i2.group_id, i2.task_uuid, jobqueue.SUCCESS, {"n": 2})
+    done = svc.db.get("jobs", job["id"])
+    assert done["state"] == jobqueue.SUCCESS
+    assert len(done["result"]["group_results"]) == 2
+
+
+def test_job_queue_failure_propagates(run_async):
+    run_async(_job_queue_failure_propagates())
+
+
+async def _job_queue_failure_propagates():
+    svc = ManagerService()
+    job = svc.jobs.enqueue_job(jobqueue.SYNC_PEERS_JOB, {}, [1])
+    item = await svc.jobs.poll(jobqueue.queue_name(1), timeout=1.0)
+    svc.jobs.complete(item.group_id, item.task_uuid, jobqueue.FAILURE,
+                      {"error": "boom"})
+    assert svc.db.get("jobs", job["id"])["state"] == jobqueue.FAILURE
+
+
+# -- REST -------------------------------------------------------------------
+
+def test_rest_auth_and_crud(run_async):
+    run_async(_rest_auth_and_crud())
+
+
+async def _rest_auth_and_crud():
+    server = ManagerServer(ManagerConfig())
+    await server.start()
+    base = f"http://127.0.0.1:{server.rest_port}"
+    try:
+        async with aiohttp.ClientSession() as http:
+            # unauthenticated rejected
+            resp = await http.get(f"{base}/api/v1/scheduler-clusters")
+            assert resp.status == 401
+            # signin as root
+            resp = await http.post(f"{base}/api/v1/users/signin",
+                                   json={"name": "root", "password": "dragonfly"})
+            assert resp.status == 200
+            token = (await resp.json())["token"]
+            hdr = {"Authorization": f"Bearer {token}"}
+
+            # CRUD a scheduler cluster
+            resp = await http.post(f"{base}/api/v1/scheduler-clusters", headers=hdr,
+                                   json={"name": "tpu", "scopes": {"idc": "v5p"}})
+            assert resp.status == 200
+            cluster = await resp.json()
+            resp = await http.patch(
+                f"{base}/api/v1/scheduler-clusters/{cluster['id']}",
+                headers=hdr, json={"bio": "tpu pod cluster"})
+            assert (await resp.json())["bio"] == "tpu pod cluster"
+            resp = await http.get(f"{base}/api/v1/scheduler-clusters", headers=hdr)
+            assert len(await resp.json()) == 2  # default + tpu
+
+            # guest is read-only
+            resp = await http.post(f"{base}/api/v1/users/signup",
+                                   json={"name": "bob", "password": "pw"})
+            assert resp.status == 200
+            resp = await http.post(f"{base}/api/v1/users/signin",
+                                   json={"name": "bob", "password": "pw"})
+            guest_hdr = {"Authorization": f"Bearer {(await resp.json())['token']}"}
+            resp = await http.get(f"{base}/api/v1/scheduler-clusters",
+                                  headers=guest_hdr)
+            assert resp.status == 200
+            resp = await http.post(f"{base}/api/v1/scheduler-clusters",
+                                   headers=guest_hdr, json={"name": "x"})
+            assert resp.status == 403
+
+            # personal access token auth
+            resp = await http.post(f"{base}/api/v1/personal-access-tokens",
+                                   headers=hdr, json={"name": "ci"})
+            pat = (await resp.json())["token"]
+            resp = await http.get(f"{base}/api/v1/schedulers",
+                                  headers={"Authorization": f"Bearer {pat}"})
+            assert resp.status == 200
+
+            # jobs endpoint enqueues to per-cluster queues
+            resp = await http.post(f"{base}/api/v1/jobs", headers=hdr, json={
+                "type": "preheat",
+                "args": {"type": "file", "url": "http://origin/blob"},
+            })
+            assert resp.status == 200
+            job = await resp.json()
+            assert job["state"] == "PENDING"
+            resp = await http.get(f"{base}/api/v1/jobs/{job['id']}", headers=hdr)
+            assert (await resp.json())["args"]["urls"] == ["http://origin/blob"]
+    finally:
+        await server.stop()

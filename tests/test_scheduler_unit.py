@@ -232,3 +232,25 @@ class TestUploadAccounting:
         t.add_peer_edge("p", "c1")  # slot taken
         c2 = make_peer("c2", t, make_host("h2"))
         assert s.find_candidate_parents(c2) == []
+
+
+class TestSlotReleaseOnFinish:
+    def test_download_finished_releases_parent_slots(self):
+        """A finished child must hand back its parents' upload slots
+        (regression: slots leaked until peer GC, starving the task)."""
+        from dragonfly2_tpu.scheduler.service import SchedulerService
+
+        svc = SchedulerService()
+        task = Task("t-slots", "http://x")
+        parent_host = make_host("hp")
+        child_host = make_host("hc")
+        parent = make_peer("pp", task, parent_host, state=PeerState.RUNNING, pieces=4)
+        child = make_peer("pc", task, child_host, state=PeerState.RUNNING)
+        task.add_peer_edge(parent.id, child.id)
+        assert parent_host.concurrent_upload_count == 1
+
+        svc._handle_download_finished(
+            {"content_length": 1024, "piece_size": 256, "total_piece_count": 4},
+            task, child)
+        assert child.state == PeerState.SUCCEEDED
+        assert parent_host.concurrent_upload_count == 0
