@@ -128,6 +128,8 @@ def _add_daemon(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--seed-peer", action="store_true")
     p.add_argument("--scheduler", action="append", default=[],
                    help="scheduler host:port (repeatable)")
+    p.add_argument("--manager", default="",
+                   help="manager drpc host:port (dynconfig scheduler resolution)")
     p.add_argument("--alive-time", type=float, default=0.0)
     p.set_defaults(func=_run_daemon)
 
@@ -147,6 +149,8 @@ def _run_daemon(args: argparse.Namespace) -> int:
         cfg.seed_peer = True
     if args.scheduler:
         cfg.scheduler.addrs = args.scheduler
+    if args.manager:
+        cfg.manager_addr = args.manager
     if args.alive_time:
         cfg.alive_time = args.alive_time
 

@@ -74,6 +74,9 @@ class Daemon:
         )
         self.rpc = DaemonRpcServer(self.task_manager)
         self.announcer: Announcer | None = None
+        self.dynconfig = None  # manager-source scheduler resolution
+        self._started = False
+        self._peer_port = 0
         self.gc = GC(log)
         self.gc.add(GCTask("storage", config.gc_interval, 30.0, self._gc_storage))
         self._stopped = asyncio.Event()
@@ -111,6 +114,51 @@ class Daemon:
             on_piece=on_piece,
         )
 
+    async def _resolve_schedulers_from_manager(self) -> None:
+        """Manager-source dynconfig: resolve (and keep fresh) the scheduler
+        set; static config addrs stay as fallback (reference
+        client/config/dynconfig_manager.go). The refresh loop always runs, so
+        a daemon started before any scheduler registers picks one up on the
+        next refresh instead of staying sourceless forever."""
+        from dragonfly2_tpu.daemon.dynconfig import DaemonDynconfig
+
+        h = self.config.host
+        self.dynconfig = DaemonDynconfig(
+            local_addrs=self.config.scheduler.addrs,
+            manager_addr=self.config.manager_addr,
+            host_info={"hostname": h.hostname, "ip": h.ip, "idc": h.idc,
+                       "location": h.location, "pod": h.tpu_slice},
+            cache_dir=self.config.dfpath.cache_dir)
+        addrs = await self.dynconfig.scheduler_addrs()
+        if addrs:
+            self._apply_scheduler_addrs(addrs)
+        else:
+            log.warning("manager returned no schedulers yet; will keep polling")
+
+        def _on_change(data: dict) -> None:
+            fresh = [f"{s['ip']}:{s['port']}" for s in data.get("schedulers", [])
+                     if s.get("state") == "active"]
+            if fresh:
+                self._apply_scheduler_addrs(fresh)
+
+        self.dynconfig.register(_on_change)
+        self.dynconfig.serve()
+
+    def _apply_scheduler_addrs(self, addrs: list[str]) -> None:
+        if self.scheduler_client is None:
+            self.scheduler_client = SchedulerClient(addrs)
+            self.task_manager.scheduler_client = self.scheduler_client
+            self.task_manager.conductor_factory = self._make_conductor
+            # Late discovery (daemon already serving): bring the announcer up
+            # now so the scheduler learns this host.
+            if self._started and self.announcer is None:
+                self.announcer = Announcer(
+                    self.config, self.scheduler_client,
+                    peer_port=self._peer_port, upload_port=self.upload.port)
+                asyncio.create_task(self.announcer.start())
+        else:
+            self.scheduler_client.update_addrs(addrs)
+
     async def _gc_storage(self) -> None:
         self.storage.gc()
 
@@ -118,12 +166,16 @@ class Daemon:
 
     async def start(self) -> None:
         """Bring every service up (non-blocking)."""
+        if self.config.manager_addr:
+            await self._resolve_schedulers_from_manager()
         await self.rpc.serve_download(NetAddr.unix(self.config.unix_sock))
         if self.config.download.peer_port >= 0:  # -1 disables the peer service
             await self.rpc.serve_peer(
                 NetAddr.tcp(self.config.host.ip, self.config.download.peer_port))
         await self.upload.serve(self.config.host.ip, self.config.upload.port)
         peer_port = self.rpc.peer_server.port() if self.rpc.peer_server._servers else 0
+        self._peer_port = peer_port
+        self._started = True
         if self.scheduler_client is not None:
             self.announcer = Announcer(
                 self.config, self.scheduler_client,
@@ -152,6 +204,8 @@ class Daemon:
 
     async def stop(self) -> None:
         self.gc.stop()
+        if self.dynconfig is not None:
+            await self.dynconfig.stop()
         if self.announcer is not None:
             await self.announcer.stop()
         if self.scheduler_client is not None:

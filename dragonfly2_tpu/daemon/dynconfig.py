@@ -1,0 +1,73 @@
+"""Daemon dynconfig: resolve scheduler addresses (and seed peers) from the
+manager, or serve the static local list.
+
+Reference: client/config/dynconfig_manager.go:84-278 (manager source:
+ListSchedulers via the searcher, observer notification into the scheduler
+resolver) and dynconfig.go:185 (local source).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from dragonfly2_tpu.manager.client import ManagerClient
+from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg.dynconfig import Dynconfig
+from dragonfly2_tpu.pkg.types import NetAddr
+
+log = dflog.get("daemon.dynconfig")
+
+
+class DaemonDynconfig:
+    """source='local': static addrs from config. source='manager': pull
+    searcher-ranked schedulers from the manager and keep them fresh."""
+
+    def __init__(self, *, local_addrs: list[str] | None = None,
+                 manager_addr: str = "", host_info: dict[str, Any] | None = None,
+                 refresh_interval: float = 10.0, cache_dir: str = ""):
+        self.local_addrs = list(local_addrs or [])
+        self.manager_addr = manager_addr
+        self.host_info = host_info or {}
+        self.client: ManagerClient | None = None
+        self.dc: Dynconfig | None = None
+        if manager_addr:
+            host, _, port = manager_addr.rpartition(":")
+            self.client = ManagerClient(NetAddr.tcp(host, int(port)))
+            self.dc = Dynconfig("daemon", self._fetch,
+                                refresh_interval=refresh_interval,
+                                cache_dir=cache_dir)
+
+    @property
+    def source(self) -> str:
+        return "manager" if self.client else "local"
+
+    async def _fetch(self) -> dict[str, Any]:
+        schedulers = await self.client.list_schedulers(
+            hostname=self.host_info.get("hostname", ""),
+            ip=self.host_info.get("ip", ""),
+            idc=self.host_info.get("idc", ""),
+            location=self.host_info.get("location", ""),
+            pod=self.host_info.get("pod", ""))
+        return {"schedulers": schedulers}
+
+    async def scheduler_addrs(self) -> list[str]:
+        if self.dc is None:
+            return self.local_addrs
+        data = await self.dc.get()
+        addrs = [f"{s['ip']}:{s['port']}" for s in data.get("schedulers", [])
+                 if s.get("state") == "active"]
+        return addrs or self.local_addrs
+
+    def register(self, observer) -> None:
+        if self.dc is not None:
+            self.dc.register(observer)
+
+    def serve(self) -> None:
+        if self.dc is not None:
+            self.dc.serve()
+
+    async def stop(self) -> None:
+        if self.dc is not None:
+            self.dc.stop()
+        if self.client is not None:
+            await self.client.close()

@@ -26,6 +26,24 @@ class SchedulerClient:
     def _client_for(self, task_id: str) -> Client:
         return self._client_for_addr(self._ring.pick(task_id))
 
+    def update_addrs(self, addrs: list[str]) -> None:
+        """Dynconfig observer: rebuild the hash ring when the manager's
+        scheduler set changes (reference pkg/resolver/scheduler_resolver.go).
+        Clients for removed schedulers are closed, not leaked."""
+        if not addrs or set(addrs) == set(self._ring.members()):
+            return
+        log.info("scheduler set changed", addrs=addrs)
+        self._ring = HashRing(addrs)
+        stale = [a for a in self._clients if a not in set(addrs)]
+        for addr in stale:
+            cli = self._clients.pop(addr)
+            try:
+                import asyncio
+
+                asyncio.get_running_loop().create_task(cli.close())
+            except RuntimeError:  # no loop: close() at daemon stop handled it
+                pass
+
     async def open_announce_stream(self, open_body: dict) -> ClientStream:
         cli = self._client_for(open_body["task_id"])
         return await cli.open_stream("Scheduler.AnnouncePeer", open_body)

@@ -66,6 +66,28 @@ class JobQueue:
         self._queues: dict[str, asyncio.Queue[QueueItem]] = {}
         self._pending_members: dict[str, set[str]] = {}   # group_id -> task uuids
         self._group_results: dict[str, list[dict]] = {}
+        self._recover()
+
+    def _recover(self) -> None:
+        """Re-enqueue unfinished jobs found in a persistent DB after restart
+        (queue state is memory-only; job rows are durable). At-least-once:
+        every member cluster gets the work again with fresh task uuids."""
+        for state in (PENDING, STARTED):
+            for job in self.db.list("jobs", state=state):
+                group_id = job["task_id"]
+                members: set[str] = set()
+                for cid in job.get("scheduler_cluster_ids") or []:
+                    item = QueueItem(group_id=group_id, job_id=job["id"],
+                                     task_uuid=uuid.uuid4().hex,
+                                     type=job["type"], args=job.get("args", {}),
+                                     queue=queue_name(cid))
+                    members.add(item.task_uuid)
+                    self._q(item.queue).put_nowait(item)
+                if members:
+                    self._pending_members[group_id] = members
+                    self._group_results[group_id] = []
+                    self.db.update("jobs", job["id"], {"state": PENDING})
+                    log.info("job recovered after restart", job_id=job["id"])
 
     def _q(self, name: str) -> asyncio.Queue[QueueItem]:
         if name not in self._queues:

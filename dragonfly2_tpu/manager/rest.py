@@ -88,10 +88,11 @@ class RestServer:
         r.add_get("/api/v1/jobs", self._list_jobs)
         r.add_get("/api/v1/jobs/{id}", self._get_job)
         for res in _RESOURCES:
-            r.add_post(f"/api/v1/{res}", self._create(res))
+            if _RESOURCES[res]:  # no mutable columns -> read/delete only
+                r.add_post(f"/api/v1/{res}", self._create(res))
+                r.add_patch(f"/api/v1/{res}/{{id}}", self._patch(res))
             r.add_get(f"/api/v1/{res}", self._list(res))
             r.add_get(f"/api/v1/{res}/{{id}}", self._get(res))
-            r.add_patch(f"/api/v1/{res}/{{id}}", self._patch(res))
             r.add_delete(f"/api/v1/{res}/{{id}}", self._delete(res))
         r.add_put("/api/v1/scheduler-clusters/{id}/seed-peer-clusters/{spc_id}",
                   self._link_clusters)
@@ -118,20 +119,24 @@ class RestServer:
 
     @web.middleware
     async def _auth_middleware(self, request: web.Request, handler):
-        if (request.method, request.path) in _PUBLIC:
-            return await handler(request)
-        token = request.headers.get("Authorization", "")
-        if token.startswith("Bearer "):
-            token = token[7:]
-        identity = self.service.verify_token(token) if token else None
-        if identity is None:
-            return web.json_response({"message": "unauthorized"}, status=401)
-        if not auth.can(identity.get("roles", []), request.method):
-            return web.json_response({"message": "forbidden"}, status=403)
-        request["identity"] = identity
         try:
+            if (request.method, request.path) in _PUBLIC:
+                return await handler(request)
+            token = request.headers.get("Authorization", "")
+            if token.startswith("Bearer "):
+                token = token[7:]
+            identity = self.service.verify_token(token) if token else None
+            if identity is None:
+                return web.json_response({"message": "unauthorized"}, status=401)
+            if not auth.can(identity.get("roles", []), request.method):
+                return web.json_response({"message": "forbidden"}, status=403)
+            request["identity"] = identity
             return await handler(request)
-        except (DfError, KeyError, ValueError) as e:
+        except web.HTTPException:
+            raise
+        except (DfError, KeyError, ValueError, TypeError) as e:
+            # Malformed bodies / missing fields are client errors (400), on
+            # public and authenticated endpoints alike.
             if isinstance(e, DfError):
                 return json_error(e)
             return web.json_response({"message": str(e)}, status=400)

@@ -1,0 +1,47 @@
+"""Scheduler announcer: self-registration with the manager + keepalive.
+
+Reference: scheduler/announcer/announcer.go — New (:51) calls
+UpdateScheduler, announceToManager (:91) keeps alive over the stream.
+"""
+
+from __future__ import annotations
+
+import socket
+
+from dragonfly2_tpu.manager.client import ManagerClient
+from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg.types import NetAddr
+
+log = dflog.get("scheduler.announcer")
+
+
+class SchedulerAnnouncer:
+    def __init__(self, manager_addr: str, *, cluster_id: int, port: int,
+                 ip: str = "", hostname: str = "", idc: str = "",
+                 location: str = "", keepalive_interval: float = 5.0):
+        host, _, mport = manager_addr.rpartition(":")
+        self.client = ManagerClient(NetAddr.tcp(host, int(mport)))
+        self.cluster_id = cluster_id
+        self.port = port
+        self.hostname = hostname or socket.gethostname()
+        self.ip = ip or "127.0.0.1"
+        self.idc = idc
+        self.location = location
+        self.keepalive_interval = keepalive_interval
+        self.registered: dict | None = None
+
+    async def start(self) -> dict:
+        self.registered = await self.client.update_scheduler(
+            hostname=self.hostname, ip=self.ip, port=self.port,
+            idc=self.idc, location=self.location,
+            scheduler_cluster_id=self.cluster_id)
+        self.client.start_keepalive(
+            source_type="scheduler", hostname=self.hostname, ip=self.ip,
+            cluster_id=self.registered["scheduler_cluster_id"],
+            interval=self.keepalive_interval)
+        log.info("registered with manager", id=self.registered["id"],
+                 cluster=self.registered["scheduler_cluster_id"])
+        return self.registered
+
+    async def stop(self) -> None:
+        await self.client.close()

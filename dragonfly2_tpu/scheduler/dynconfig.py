@@ -1,0 +1,69 @@
+"""Scheduler dynconfig: pulls cluster config + seed peers from the manager.
+
+Reference: scheduler/config/dynconfig.go — NewDynconfig wraps the generic
+puller with {scheduler cluster client/config, seed peers} from the manager,
+feeding the resource layer and seed-peer client.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from dragonfly2_tpu.manager.client import ManagerClient
+from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg.dynconfig import Dynconfig
+from dragonfly2_tpu.pkg.types import HostType
+
+log = dflog.get("scheduler.dynconfig")
+
+
+class SchedulerDynconfig:
+    def __init__(self, manager_client: ManagerClient, cluster_id: int, *,
+                 refresh_interval: float = 10.0, cache_dir: str = ""):
+        self.client = manager_client
+        self.cluster_id = cluster_id
+        self.dc = Dynconfig(f"scheduler-c{cluster_id}", self._fetch,
+                            refresh_interval=refresh_interval,
+                            cache_dir=cache_dir)
+
+    async def _fetch(self) -> dict[str, Any]:
+        cluster = await self.client.get_scheduler_cluster_config(self.cluster_id)
+        seed_peers = await self.client.list_seed_peers(self.cluster_id)
+        return {
+            "config": cluster.get("config", {}),
+            "client_config": cluster.get("client_config", {}),
+            "scopes": cluster.get("scopes", {}),
+            "seed_peers": seed_peers,
+        }
+
+    async def get(self) -> dict[str, Any]:
+        return await self.dc.get()
+
+    async def seed_peers(self) -> list[dict]:
+        return (await self.get()).get("seed_peers", [])
+
+    def register(self, observer) -> None:
+        self.dc.register(observer)
+
+    def serve(self) -> None:
+        self.dc.serve()
+
+    def stop(self) -> None:
+        self.dc.stop()
+
+
+def seed_peer_host_wire(sp: dict) -> dict:
+    """Convert a manager seed-peer row into an AnnounceHost-shaped dict so the
+    resource layer can pre-register the seed before it announces itself."""
+    type_map = {"super": HostType.SUPER_SEED, "strong": HostType.STRONG_SEED,
+                "weak": HostType.WEAK_SEED}
+    return {
+        "id": f"{sp['hostname']}-{sp['ip']}-seed",
+        "hostname": sp["hostname"],
+        "ip": sp["ip"],
+        "port": sp["port"],
+        "upload_port": sp.get("download_port", 0),
+        "type": int(type_map.get(sp.get("type", "super"), HostType.SUPER_SEED)),
+        "idc": sp.get("idc", ""),
+        "location": sp.get("location", ""),
+    }

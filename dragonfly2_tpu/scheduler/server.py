@@ -27,6 +27,9 @@ class SchedulerServer:
         self._register()
         self.gc = GC(log)
         self.gc.add(GCTask("resource", self.config.gc.interval, 30.0, self._gc))
+        self.announcer = None       # manager registration (set in start)
+        self.dynconfig = None       # manager-fed cluster config + seed peers
+        self._manager_retry: asyncio.Task | None = None
         self._stopped = asyncio.Event()
 
     def _register(self) -> None:
@@ -45,8 +48,7 @@ class SchedulerServer:
             log.info("resource gc", **counts)
 
     async def serve(self) -> None:
-        await self.rpc.serve(NetAddr.tcp(self.config.server.host, self.config.server.port))
-        self.gc.serve()
+        await self.start()
         log.info("scheduler up", port=self.port())
         await self._stopped.wait()
 
@@ -54,12 +56,71 @@ class SchedulerServer:
         """Non-blocking variant for embedding in tests."""
         await self.rpc.serve(NetAddr.tcp(self.config.server.host, self.config.server.port))
         self.gc.serve()
+        if self.config.manager_addr:
+            try:
+                await self._connect_manager()
+            except Exception as e:
+                # Manager briefly down must not kill a serving scheduler:
+                # keep serving with local config and retry in the background.
+                log.warning("manager unreachable, retrying in background",
+                            error=str(e))
+                self._manager_retry = asyncio.create_task(self._retry_manager())
+
+    async def _retry_manager(self) -> None:
+        while True:
+            await asyncio.sleep(10.0)
+            try:
+                await self._connect_manager()
+                return
+            except Exception as e:
+                log.warning("manager still unreachable", error=str(e))
+                if self.announcer is not None:  # drop the half-open client
+                    await self.announcer.stop()
+                    self.announcer = None
+
+    async def _connect_manager(self) -> None:
+        """Register with the manager and keep cluster config + seed peers
+        fresh (reference scheduler.go wiring of announcer + dynconfig)."""
+        from dragonfly2_tpu.scheduler.announcer import SchedulerAnnouncer
+        from dragonfly2_tpu.scheduler.dynconfig import (
+            SchedulerDynconfig,
+            seed_peer_host_wire,
+        )
+        from dragonfly2_tpu.scheduler.resource import Host
+        from dragonfly2_tpu.pkg.types import HostType
+
+        self.announcer = SchedulerAnnouncer(
+            self.config.manager_addr, cluster_id=self.config.cluster_id,
+            port=self.port(), ip=self.config.server.advertise_ip or "127.0.0.1")
+        await self.announcer.start()
+        self.dynconfig = SchedulerDynconfig(
+            self.announcer.client,
+            self.announcer.registered["scheduler_cluster_id"])
+
+        def _sync_seed_peers(data: dict) -> None:
+            for sp in data.get("seed_peers", []):
+                w = seed_peer_host_wire(sp)
+                host = self.service.hosts.load_or_store(Host(
+                    w["id"], hostname=w["hostname"], ip=w["ip"], port=w["port"],
+                    upload_port=w["upload_port"], host_type=HostType(w["type"]),
+                    idc=w["idc"], location=w["location"]))
+                host.touch()
+
+        self.dynconfig.register(_sync_seed_peers)
+        await self.dynconfig.dc.refresh()
+        self.dynconfig.serve()
 
     def port(self) -> int:
         return self.rpc.port()
 
     async def stop(self) -> None:
         self.gc.stop()
+        if self._manager_retry is not None:
+            self._manager_retry.cancel()
+        if self.dynconfig is not None:
+            self.dynconfig.stop()
+        if self.announcer is not None:
+            await self.announcer.stop()
         await self.service.seed_clients.close()
         await self.rpc.close()
         self._stopped.set()

@@ -1,0 +1,132 @@
+"""Dynconfig + announcer wiring tests: generic puller semantics, scheduler
+registration with the manager, seed-peer pre-registration, daemon scheduler
+resolution via manager. Mirrors reference internal/dynconfig tests and the
+announcer wiring in scheduler/scheduler.go."""
+
+from __future__ import annotations
+
+import asyncio
+
+from dragonfly2_tpu.manager.config import ManagerConfig
+from dragonfly2_tpu.manager.server import ManagerServer
+from dragonfly2_tpu.pkg.dynconfig import Dynconfig
+from dragonfly2_tpu.scheduler.config import SchedulerConfig, SchedulerServerConfig
+from dragonfly2_tpu.scheduler.server import SchedulerServer
+
+
+# -- generic puller ---------------------------------------------------------
+
+def test_dynconfig_observer_and_cache(tmp_path, run_async):
+    run_async(_dynconfig_observer_and_cache(tmp_path))
+
+
+async def _dynconfig_observer_and_cache(tmp_path):
+    calls = {"n": 0}
+    fail = {"on": False}
+
+    async def fetch():
+        if fail["on"]:
+            raise RuntimeError("manager down")
+        calls["n"] += 1
+        return {"v": calls["n"]}
+
+    seen = []
+    dc = Dynconfig("t", fetch, cache_dir=str(tmp_path))
+    dc.register(seen.append)
+    assert (await dc.get()) == {"v": 1}
+    assert seen == [{"v": 1}]
+    await dc.refresh()
+    assert seen == [{"v": 1}, {"v": 2}]
+
+    # Failure keeps last data; a fresh instance falls back to the disk cache.
+    fail["on"] = True
+    assert await dc.refresh()
+    assert (await dc.get()) == {"v": 2}
+    dc2 = Dynconfig("t", fetch, cache_dir=str(tmp_path))
+    assert await dc2.refresh()           # fetch fails -> disk cache
+    assert (await dc2.get()) == {"v": 2}
+
+
+def test_dynconfig_unchanged_data_no_notify(run_async):
+    async def fetch():
+        return {"same": True}
+
+    async def body():
+        seen = []
+        dc = Dynconfig("u", fetch)
+        dc.register(seen.append)
+        await dc.refresh()
+        await dc.refresh()
+        assert len(seen) == 1
+
+    run_async(body())
+
+
+# -- scheduler <-> manager --------------------------------------------------
+
+def test_scheduler_registers_and_pulls_seed_peers(run_async):
+    run_async(_scheduler_registers_and_pulls_seed_peers())
+
+
+async def _scheduler_registers_and_pulls_seed_peers():
+    manager = ManagerServer(ManagerConfig())
+    await manager.start()
+    # A seed peer registered only in the manager (it has not announced to the
+    # scheduler yet) must still be visible as a seed host after dynconfig.
+    manager.service.update_seed_peer({
+        "hostname": "seed-a", "ip": "127.0.0.1", "port": 60991,
+        "download_port": 60992})
+
+    cfg = SchedulerConfig(server=SchedulerServerConfig(port=0),
+                          manager_addr=f"127.0.0.1:{manager.grpc_port()}")
+    sched = SchedulerServer(cfg)
+    try:
+        await sched.start()
+        assert sched.announcer.registered["state"] == "active"
+        # Seed pre-registered into the host manager via the dynconfig observer.
+        seeds = [h for h in sched.service.hosts.all() if h.is_seed()]
+        assert len(seeds) == 1 and seeds[0].ip == "127.0.0.1"
+        assert seeds[0].port == 60991 and seeds[0].upload_port == 60992
+        # And the manager now lists the scheduler as active for daemons.
+        listed = manager.service.list_schedulers({"hostname": "w", "ip": "10.0.0.2"})
+        assert any(s["port"] == sched.port() for s in listed)
+    finally:
+        await sched.stop()
+        await manager.stop()
+
+
+# -- daemon <-> manager -----------------------------------------------------
+
+def test_daemon_resolves_schedulers_from_manager(tmp_path, run_async):
+    run_async(_daemon_resolves(tmp_path))
+
+
+async def _daemon_resolves(tmp_path):
+    from dragonfly2_tpu.daemon.config import DaemonConfig
+    from dragonfly2_tpu.daemon.daemon import Daemon
+
+    manager = ManagerServer(ManagerConfig())
+    await manager.start()
+    cfg = SchedulerConfig(server=SchedulerServerConfig(port=0),
+                          manager_addr=f"127.0.0.1:{manager.grpc_port()}")
+    sched = SchedulerServer(cfg)
+    await sched.start()
+
+    dcfg = DaemonConfig()
+    dcfg.work_home = str(tmp_path / "dfhome")
+    dcfg.__post_init__()
+    dcfg.host.ip = "127.0.0.1"
+    dcfg.manager_addr = f"127.0.0.1:{manager.grpc_port()}"
+    daemon = Daemon(dcfg)
+    try:
+        await daemon.start()
+        # No static scheduler addrs; the manager supplied the active one.
+        assert daemon.scheduler_client is not None
+        assert f"127.0.0.1:{sched.port()}" in daemon.scheduler_client._ring.members()
+        # The daemon announced itself to that scheduler.
+        await asyncio.sleep(0.1)
+        assert any(not h.is_seed() for h in sched.service.hosts.all())
+    finally:
+        await daemon.stop()
+        await sched.stop()
+        await manager.stop()
