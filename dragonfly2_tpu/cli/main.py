@@ -130,6 +130,10 @@ def _add_daemon(sub: argparse._SubParsersAction) -> None:
                    help="scheduler host:port (repeatable)")
     p.add_argument("--manager", default="",
                    help="manager drpc host:port (dynconfig scheduler resolution)")
+    p.add_argument("--proxy-port", type=int, default=-1,
+                   help="enable the HTTP proxy on this port (0 = ephemeral)")
+    p.add_argument("--registry-mirror", default="",
+                   help="remote registry URL to mirror through the proxy")
     p.add_argument("--alive-time", type=float, default=0.0)
     p.set_defaults(func=_run_daemon)
 
@@ -151,6 +155,12 @@ def _run_daemon(args: argparse.Namespace) -> int:
         cfg.scheduler.addrs = args.scheduler
     if args.manager:
         cfg.manager_addr = args.manager
+    if args.proxy_port >= 0:
+        cfg.proxy.enabled = True
+        cfg.proxy.port = args.proxy_port
+    if args.registry_mirror:
+        cfg.proxy.enabled = True
+        cfg.proxy.registry_mirror = args.registry_mirror
     if args.alive_time:
         cfg.alive_time = args.alive_time
 

@@ -73,6 +73,19 @@ class Daemon:
             total_rate_limit=rate,
         )
         self.rpc = DaemonRpcServer(self.task_manager)
+        self.proxy = None
+        if config.proxy.enabled:
+            from dragonfly2_tpu.daemon.proxy import Proxy
+            from dragonfly2_tpu.daemon.transport import P2PTransport, ProxyRule
+
+            rules = [ProxyRule(regex=r.get("regex", ""),
+                               direct=bool(r.get("direct", False)))
+                     for r in config.proxy.rules if r.get("regex")]
+            self.proxy = Proxy(
+                P2PTransport(self.task_manager, rules=rules),
+                registry_mirror=config.proxy.registry_mirror,
+                max_concurrency=config.proxy.max_concurrency,
+                white_list_ports=config.proxy.white_list_ports)
         self.announcer: Announcer | None = None
         self.dynconfig = None  # manager-source scheduler resolution
         self._started = False
@@ -173,6 +186,8 @@ class Daemon:
             await self.rpc.serve_peer(
                 NetAddr.tcp(self.config.host.ip, self.config.download.peer_port))
         await self.upload.serve(self.config.host.ip, self.config.upload.port)
+        if self.proxy is not None:
+            await self.proxy.serve(self.config.host.ip, self.config.proxy.port)
         peer_port = self.rpc.peer_server.port() if self.rpc.peer_server._servers else 0
         self._peer_port = peer_port
         self._started = True
@@ -210,6 +225,8 @@ class Daemon:
             await self.announcer.stop()
         if self.scheduler_client is not None:
             await self.scheduler_client.close()
+        if self.proxy is not None:
+            await self.proxy.close()
         await self.upload.close()
         await self.rpc.close()
         self.storage.close()

@@ -45,6 +45,28 @@ class FileTaskRequest:
 
 
 @dataclass
+class StreamTaskRequest:
+    """Stream task: ordered bytes delivered as pieces land (reference
+    peertask_stream.go). The task id excludes the range so concurrent ranged
+    readers share one underlying whole-content task."""
+
+    url: str
+    meta: UrlMeta = field(default_factory=UrlMeta)
+    peer_id: str = ""
+    range: Range | None = None          # bytes to emit (None = everything)
+    disable_back_source: bool = False
+
+    def task_id(self) -> str:
+        return idgen.task_id_v1(
+            self.url,
+            digest=self.meta.digest,
+            tag=self.meta.tag,
+            application=self.meta.application,
+            filters=self.meta.filter,
+        )
+
+
+@dataclass
 class FileTaskProgress:
     state: str                  # running | done | failed
     task_id: str = ""
@@ -279,6 +301,177 @@ class TaskManager:
             store.unpin()
             run.done.set()
             self._running.pop(task_id, None)
+
+    # -- stream task (reference StartStreamTask :357, peertask_stream.go) --
+
+    async def start_stream_task(self, req: StreamTaskRequest):
+        """Returns (attrs, body_iterator). attrs carries task/peer id,
+        content_length (may be -1 for unknown-length origins until done) and
+        reuse flags; the iterator yields ordered byte chunks as pieces land
+        (reference peertask_stream.go:274 writeOrderedPieces)."""
+        task_id = req.task_id()
+        peer_id = req.peer_id or idgen.peer_id_v1(self.host_ip)
+
+        store = self.storage.find_completed_task(task_id)
+        if store is not None:
+            attrs = self._stream_attrs(store, task_id, peer_id, from_reuse=True)
+            rng = self._resolve_range(req.range, attrs["content_length"])
+            attrs["range"] = rng
+            return attrs, self._stream_from_store(store, rng)
+
+        q = self.broker.subscribe(task_id)
+        run = self._running.get(task_id)
+        if run is None:
+            # The task may have completed between the reuse check and the
+            # subscribe — re-check before starting a fresh download.
+            store = self.storage.find_completed_task(task_id)
+            if store is not None:
+                self.broker.unsubscribe(task_id, q)
+                attrs = self._stream_attrs(store, task_id, peer_id, from_reuse=True)
+                rng = self._resolve_range(req.range, attrs["content_length"])
+                attrs["range"] = rng
+                return attrs, self._stream_from_store(store, rng)
+            file_req = FileTaskRequest(
+                url=req.url, output="", meta=req.meta, peer_id=peer_id,
+                disable_back_source=req.disable_back_source)
+            store = self.storage.register_task(TaskStoreMetadata(
+                task_id=task_id, peer_id=peer_id, url=req.url,
+                tag=req.meta.tag, application=req.meta.application,
+                header=dict(req.meta.header)))
+            run = _RunningTask(store)
+            self._running[task_id] = run
+            store.pin()
+            asyncio.ensure_future(
+                self._run_background_download(task_id, peer_id, file_req, store, run))
+        else:
+            store = run.store
+
+        # Wait for enough metadata to answer headers: content length, the
+        # first piece, or a terminal event.
+        try:
+            while (store.metadata.content_length < 0
+                   and not store.has_piece(0)
+                   and run.error is None and not run.done.is_set()):
+                ev = await q.get()
+                if ev.failed:
+                    break
+        except asyncio.CancelledError:
+            self.broker.unsubscribe(task_id, q)
+            raise
+        if run.error is not None:
+            self.broker.unsubscribe(task_id, q)
+            raise run.error
+        attrs = self._stream_attrs(store, task_id, peer_id)
+        rng = self._resolve_range(req.range, attrs["content_length"])
+        attrs["range"] = rng
+        return attrs, self._stream_ordered(task_id, store, run, q, rng)
+
+    @staticmethod
+    def _resolve_range(rng: Range | None, content_length: int) -> Range | None:
+        """Open-ended ranges (``bytes=N-`` parsed as length=-1) resolve to
+        [start, content_length) once the length is known; with an
+        unknown-length origin the open end means "to EOF"."""
+        if rng is not None and rng.length < 0 and content_length >= 0:
+            return Range(rng.start, max(0, content_length - rng.start))
+        return rng
+
+    async def _run_background_download(self, task_id: str, peer_id: str,
+                                       req: FileTaskRequest, store, run: _RunningTask) -> None:
+        """Download driver for stream tasks (no output file, no progress
+        aggregator; completion is observed through the broker)."""
+        try:
+            await self._run_download(task_id, peer_id, req, store, None)
+            if req.meta.digest:
+                store.validate_digest(req.meta.digest)
+                store.metadata.digest = req.meta.digest
+            store.mark_done()
+            self.broker.publish(task_id, PieceEvent(
+                [], store.metadata.total_piece_count,
+                store.metadata.content_length, store.metadata.piece_size,
+                done=True))
+        except DfError as e:
+            store.mark_invalid()
+            run.error = e
+            self.broker.publish(task_id, PieceEvent([], failed=True))
+        except Exception as e:  # pragma: no cover - defensive
+            log.error("stream download crashed", exc_info=True)
+            store.mark_invalid()
+            run.error = DfError(Code.UnknownError, str(e))
+            self.broker.publish(task_id, PieceEvent([], failed=True))
+        finally:
+            store.unpin()
+            run.done.set()
+            self._running.pop(task_id, None)
+
+    def _stream_attrs(self, store, task_id: str, peer_id: str, *,
+                      from_reuse: bool = False) -> dict:
+        m = store.metadata
+        return {
+            "task_id": task_id,
+            "peer_id": peer_id,
+            "content_length": m.content_length,
+            "piece_size": m.piece_size,
+            "total_piece_count": m.total_piece_count,
+            "from_reuse": from_reuse,
+        }
+
+    @staticmethod
+    def _slice_piece(data: bytes, piece_offset: int, rng: Range | None) -> bytes:
+        if rng is None:
+            return data
+        lo = max(rng.start, piece_offset)
+        hi = (piece_offset + len(data) if rng.length < 0    # open end: to EOF
+              else min(rng.start + rng.length, piece_offset + len(data)))
+        if hi <= lo:
+            return b""
+        return data[lo - piece_offset:hi - piece_offset]
+
+    async def _stream_from_store(self, store, rng: Range | None) -> AsyncIterator[bytes]:
+        """Completed task: emit ordered pieces straight off disk."""
+        store.pin()
+        try:
+            m = store.metadata
+            for num in range(max(m.total_piece_count, 0)):
+                data = store.read_piece(num)
+                chunk = self._slice_piece(data, num * m.piece_size, rng)
+                if chunk:
+                    yield chunk
+        finally:
+            store.unpin()
+
+    async def _stream_ordered(self, task_id: str, store, run: _RunningTask,
+                              q: asyncio.Queue, rng: Range | None) -> AsyncIterator[bytes]:
+        """Running task: emit pieces in order as they land; pieces ahead of
+        the contiguous frontier wait in the store until the gap fills."""
+        next_num = 0
+        store.pin()
+        try:
+            while True:
+                m = store.metadata
+                while store.has_piece(next_num):
+                    data = store.read_piece(next_num)
+                    chunk = self._slice_piece(data, next_num * m.piece_size, rng)
+                    if chunk:
+                        yield chunk
+                    next_num += 1
+                    # Past the requested range: nothing further to emit
+                    # (open-ended ranges run to EOF).
+                    if rng is not None and rng.length >= 0 and m.piece_size > 0 and \
+                            next_num * m.piece_size >= rng.start + rng.length:
+                        return
+                if run.error is not None:
+                    raise run.error
+                if m.total_piece_count >= 0 and next_num >= m.total_piece_count:
+                    return
+                if run.done.is_set() and not store.has_piece(next_num):
+                    # Completed without the piece we need -> invalidated.
+                    raise DfError(Code.UnknownError, "stream task ended short")
+                ev = await q.get()
+                if ev.failed and run.error is not None:
+                    raise run.error
+        finally:
+            store.unpin()
+            self.broker.unsubscribe(task_id, q)
 
     def is_task_running(self, task_id: str) -> bool:
         return task_id in self._running

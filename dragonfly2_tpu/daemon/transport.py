@@ -1,0 +1,89 @@
+"""P2P transport: turns HTTP GETs into stream peer tasks.
+
+Reference: client/daemon/transport/transport.go — RoundTrip (:230) decides
+P2P vs direct via regex rules, roundTripWithDragonfly (:259) starts a stream
+task and plumbs range/tag/application through. Here the "RoundTripper" is an
+async fetch() used by the proxy and the object-storage gateway.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from dragonfly2_tpu.daemon.peer.task_manager import StreamTaskRequest, TaskManager
+from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg.errors import Code, DfError
+from dragonfly2_tpu.pkg.piece import Range
+from dragonfly2_tpu.proto.common import UrlMeta
+
+log = dflog.get("daemon.transport")
+
+# Headers the reference strips/interprets before task identity is computed
+# (transport.go pickHeader: tag/application/filter ride custom headers).
+HDR_TAG = "X-Dragonfly-Tag"
+HDR_APPLICATION = "X-Dragonfly-Application"
+HDR_FILTER = "X-Dragonfly-Filter"
+HDR_NO_P2P = "X-Dragonfly-No-P2P"
+
+# Registry blob URLs are content-addressed -> always safe to P2P.
+_BLOB_RE = re.compile(r"/v2/.+/blobs/sha256:[0-9a-f]{64}")
+
+
+@dataclass
+class ProxyRule:
+    """Reference config proxy rule: regex + direct/useHTTPS flags."""
+
+    regex: str
+    direct: bool = False           # match -> bypass P2P
+    use_https: bool = False        # rewrite scheme when hijacking
+    _compiled: re.Pattern = field(init=False, repr=False, default=None)
+
+    def __post_init__(self):
+        self._compiled = re.compile(self.regex)
+
+    def matches(self, url: str) -> bool:
+        return bool(self._compiled.search(url))
+
+
+class P2PTransport:
+    def __init__(self, task_manager: TaskManager, *, rules: list[ProxyRule] | None = None,
+                 default_tag: str = ""):
+        self.task_manager = task_manager
+        self.rules = rules or []
+        self.default_tag = default_tag
+
+    def should_use_p2p(self, method: str, url: str,
+                       headers: dict[str, str] | None = None) -> bool:
+        """shouldUseDragonfly (reference proxy.go:662-699): only GETs; rules
+        decide, registry blobs always qualify."""
+        if method.upper() != "GET":
+            return False
+        if headers and headers.get(HDR_NO_P2P, "").lower() in ("1", "true"):
+            return False
+        for rule in self.rules:
+            if rule.matches(url):
+                return not rule.direct
+        return bool(_BLOB_RE.search(url))
+
+    async def fetch(self, url: str, headers: dict[str, str] | None = None):
+        """Fetch through the P2P fabric. Returns (attrs, body_iterator).
+        Raises DfError on task failure before the first byte."""
+        headers = dict(headers or {})
+        rng = None
+        range_header = headers.pop("Range", headers.pop("range", ""))
+        if range_header:
+            try:
+                rng = Range.parse_http(range_header)
+            except ValueError as e:
+                raise DfError(Code.BadRequest, f"bad range: {e}")
+        meta = UrlMeta(
+            tag=headers.pop(HDR_TAG, self.default_tag),
+            application=headers.pop(HDR_APPLICATION, ""),
+            filter=headers.pop(HDR_FILTER, ""),
+            header=headers,
+        )
+        req = StreamTaskRequest(url=url, meta=meta, range=rng)
+        # attrs["range"] is set by the task manager: open-ended ranges come
+        # back resolved against the content length when it is known.
+        return await self.task_manager.start_stream_task(req)

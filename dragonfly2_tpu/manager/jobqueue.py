@@ -74,20 +74,24 @@ class JobQueue:
         every member cluster gets the work again with fresh task uuids."""
         for state in (PENDING, STARTED):
             for job in self.db.list("jobs", state=state):
-                group_id = job["task_id"]
-                members: set[str] = set()
-                for cid in job.get("scheduler_cluster_ids") or []:
-                    item = QueueItem(group_id=group_id, job_id=job["id"],
-                                     task_uuid=uuid.uuid4().hex,
-                                     type=job["type"], args=job.get("args", {}),
-                                     queue=queue_name(cid))
-                    members.add(item.task_uuid)
-                    self._q(item.queue).put_nowait(item)
-                if members:
-                    self._pending_members[group_id] = members
-                    self._group_results[group_id] = []
+                if self._fanout(job, job.get("scheduler_cluster_ids") or []):
                     self.db.update("jobs", job["id"], {"state": PENDING})
                     log.info("job recovered after restart", job_id=job["id"])
+
+    def _fanout(self, job: dict[str, Any], scheduler_cluster_ids: list[int]) -> bool:
+        """Fan one queue item per cluster and arm the group bookkeeping."""
+        group_id = job["task_id"]
+        members: set[str] = set()
+        for cid in scheduler_cluster_ids:
+            item = QueueItem(group_id=group_id, job_id=job["id"],
+                             task_uuid=uuid.uuid4().hex, type=job["type"],
+                             args=job.get("args", {}), queue=queue_name(cid))
+            members.add(item.task_uuid)
+            self._q(item.queue).put_nowait(item)
+        if members:
+            self._pending_members[group_id] = members
+            self._group_results[group_id] = []
+        return bool(members)
 
     def _q(self, name: str) -> asyncio.Queue[QueueItem]:
         if name not in self._queues:
@@ -104,15 +108,7 @@ class JobQueue:
             "args": args, "user_id": user_id, "bio": bio,
             "scheduler_cluster_ids": scheduler_cluster_ids,
         })
-        members: set[str] = set()
-        for cid in scheduler_cluster_ids:
-            item = QueueItem(group_id=group_id, job_id=job["id"],
-                             task_uuid=uuid.uuid4().hex, type=job_type,
-                             args=args, queue=queue_name(cid))
-            members.add(item.task_uuid)
-            self._q(item.queue).put_nowait(item)
-        self._pending_members[group_id] = members
-        self._group_results[group_id] = []
+        self._fanout(job, scheduler_cluster_ids)
         log.info("job enqueued", job_id=job["id"], type=job_type,
                  clusters=scheduler_cluster_ids)
         return job

@@ -64,6 +64,9 @@ class SchedulerServer:
                 # keep serving with local config and retry in the background.
                 log.warning("manager unreachable, retrying in background",
                             error=str(e))
+                if self.announcer is not None:  # drop the half-open client
+                    await self.announcer.stop()
+                    self.announcer = None
                 self._manager_retry = asyncio.create_task(self._retry_manager())
 
     async def _retry_manager(self) -> None:

@@ -1,0 +1,306 @@
+"""Stream tasks + proxy/transport tests.
+
+Mirrors reference test coverage: ordered piece delivery
+(peertask_stream.go), shouldUseDragonfly rules (proxy_test.go), registry
+mirror pull-through (containerd_test.go's proxy path) and CONNECT tunnels.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import random
+
+import aiohttp
+from aiohttp import web
+
+from dragonfly2_tpu.daemon.peer.piece_manager import PieceManager, PieceManagerOption
+from dragonfly2_tpu.daemon.peer.task_manager import StreamTaskRequest, TaskManager
+from dragonfly2_tpu.daemon.proxy import Proxy
+from dragonfly2_tpu.daemon.transport import P2PTransport, ProxyRule
+from dragonfly2_tpu.pkg.piece import Range
+from dragonfly2_tpu.proto.common import UrlMeta
+from dragonfly2_tpu.storage import StorageManager, StorageOption
+
+BLOB = bytes(random.Random(11).randbytes(6 * 1024 * 1024))
+BLOB_SHA = hashlib.sha256(BLOB).hexdigest()
+
+
+async def start_registry():
+    """Fake OCI registry: manifest + content-addressed blob, hit counting."""
+    stats = {"blob_gets": 0}
+
+    async def blob(request: web.Request) -> web.Response:
+        stats["blob_gets"] += 1
+        rng = request.headers.get("Range")
+        if rng:
+            r = Range.parse_http(rng, len(BLOB))
+            return web.Response(
+                status=206, body=BLOB[r.start:r.start + r.length],
+                headers={"Accept-Ranges": "bytes",
+                         "Content-Range": f"bytes {r.start}-{r.start + r.length - 1}/{len(BLOB)}"})
+        return web.Response(body=BLOB, headers={"Accept-Ranges": "bytes"})
+
+    async def manifest(request: web.Request) -> web.Response:
+        return web.json_response({
+            "schemaVersion": 2,
+            "layers": [{"digest": f"sha256:{BLOB_SHA}", "size": len(BLOB)}],
+        })
+
+    app = web.Application()
+    app.router.add_get(f"/v2/library/app/blobs/sha256:{BLOB_SHA}", blob)
+    app.router.add_get("/v2/library/app/manifests/latest", manifest)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, port, stats
+
+
+def make_task_manager(tmp_path) -> TaskManager:
+    storage = StorageManager(StorageOption(data_dir=str(tmp_path / "data")))
+    pm = PieceManager(PieceManagerOption(concurrency=3))
+    return TaskManager(storage, pm)
+
+
+# -- stream task core -------------------------------------------------------
+
+def test_stream_task_ordered_bytes(tmp_path, run_async):
+    run_async(_stream_ordered(tmp_path))
+
+
+async def _stream_ordered(tmp_path):
+    runner, port, stats = await start_registry()
+    tm = make_task_manager(tmp_path)
+    url = f"http://127.0.0.1:{port}/v2/library/app/blobs/sha256:{BLOB_SHA}"
+    try:
+        attrs, body = await tm.start_stream_task(StreamTaskRequest(url=url))
+        assert attrs["content_length"] == len(BLOB)
+        got = b"".join([chunk async for chunk in body])
+        assert got == BLOB
+        assert not attrs["from_reuse"]
+
+        # Second stream: reuse off the completed local store, zero origin hits.
+        before = stats["blob_gets"]
+        attrs2, body2 = await tm.start_stream_task(StreamTaskRequest(url=url))
+        got2 = b"".join([chunk async for chunk in body2])
+        assert got2 == BLOB and attrs2["from_reuse"]
+        assert stats["blob_gets"] == before
+    finally:
+        tm.storage.close()
+        await runner.cleanup()
+
+
+def test_stream_task_range(tmp_path, run_async):
+    run_async(_stream_range(tmp_path))
+
+
+async def _stream_range(tmp_path):
+    runner, port, _ = await start_registry()
+    tm = make_task_manager(tmp_path)
+    url = f"http://127.0.0.1:{port}/v2/library/app/blobs/sha256:{BLOB_SHA}"
+    rng = Range(1_000_000, 3_000_000)
+    try:
+        req = StreamTaskRequest(url=url, range=rng)
+        attrs, body = await tm.start_stream_task(req)
+        got = b"".join([chunk async for chunk in body])
+        assert got == BLOB[1_000_000:4_000_000]
+        # The ranged reader returns early; the shared whole-task download
+        # keeps going. Once it lands, ranged requests reuse the local store.
+        for _ in range(200):
+            if not tm.is_task_running(req.task_id()):
+                break
+            await asyncio.sleep(0.05)
+        attrs2, body2 = await tm.start_stream_task(
+            StreamTaskRequest(url=url, range=Range(0, 100)))
+        assert b"".join([c async for c in body2]) == BLOB[:100]
+        assert attrs2["from_reuse"]
+    finally:
+        tm.storage.close()
+        await runner.cleanup()
+
+
+def test_stream_task_concurrent_readers_share_one_download(tmp_path, run_async):
+    run_async(_stream_concurrent(tmp_path))
+
+
+async def _stream_concurrent(tmp_path):
+    runner, port, stats = await start_registry()
+    tm = make_task_manager(tmp_path)
+    url = f"http://127.0.0.1:{port}/v2/library/app/blobs/sha256:{BLOB_SHA}"
+
+    async def read_all():
+        attrs, body = await tm.start_stream_task(StreamTaskRequest(url=url))
+        return b"".join([chunk async for chunk in body])
+
+    try:
+        results = await asyncio.gather(*[read_all() for _ in range(4)])
+        assert all(r == BLOB for r in results)
+        # One underlying download: origin hits equal the piece/range requests
+        # of a single back-to-source run (not 4x).
+        assert stats["blob_gets"] <= 4
+    finally:
+        tm.storage.close()
+        await runner.cleanup()
+
+
+# -- transport rules --------------------------------------------------------
+
+def test_should_use_p2p_rules():
+    tm = object.__new__(TaskManager)  # rules don't touch the manager
+    # First matching rule wins (reference proxy.go shouldUseDragonfly).
+    t = P2PTransport(tm, rules=[
+        ProxyRule(regex=r"internal\.example", direct=True),
+        ProxyRule(regex=r"\.safetensors$"),
+    ])
+    assert t.should_use_p2p("GET", "http://x/v2/lib/app/blobs/sha256:" + "0" * 64)
+    assert t.should_use_p2p("GET", "http://host/model.safetensors")
+    assert not t.should_use_p2p("GET", "http://internal.example/model.safetensors")
+    assert not t.should_use_p2p("POST", "http://host/model.safetensors")
+    assert not t.should_use_p2p("GET", "http://host/index.html")
+    assert not t.should_use_p2p("GET", "http://host/model.safetensors",
+                                {"X-Dragonfly-No-P2P": "true"})
+
+
+# -- proxy ------------------------------------------------------------------
+
+def test_proxy_registry_mirror_pull_through(tmp_path, run_async):
+    run_async(_proxy_mirror(tmp_path))
+
+
+async def _proxy_mirror(tmp_path):
+    registry, reg_port, stats = await start_registry()
+    tm = make_task_manager(tmp_path)
+    proxy = Proxy(P2PTransport(tm),
+                  registry_mirror=f"http://127.0.0.1:{reg_port}")
+    proxy_port = await proxy.serve()
+    base = f"http://127.0.0.1:{proxy_port}"
+    try:
+        async with aiohttp.ClientSession() as http:
+            # Manifest: not a blob -> direct reverse proxy to the remote.
+            resp = await http.get(f"{base}/v2/library/app/manifests/latest")
+            assert resp.status == 200
+            manifest = await resp.json()
+            digest = manifest["layers"][0]["digest"]
+
+            # Layer blob: P2P pull-through.
+            resp = await http.get(f"{base}/v2/library/app/blobs/{digest}")
+            assert resp.status == 200
+            got = await resp.read()
+            assert got == BLOB
+
+            # Same layer again (another containerd node): served from cache.
+            before = stats["blob_gets"]
+            resp = await http.get(f"{base}/v2/library/app/blobs/{digest}")
+            assert await resp.read() == BLOB
+            assert stats["blob_gets"] == before
+    finally:
+        await proxy.close()
+        tm.storage.close()
+        await registry.cleanup()
+
+
+def test_proxy_forward_and_range(tmp_path, run_async):
+    run_async(_proxy_forward(tmp_path))
+
+
+async def _proxy_forward(tmp_path):
+    registry, reg_port, _ = await start_registry()
+    tm = make_task_manager(tmp_path)
+    proxy = Proxy(P2PTransport(tm))   # plain forward proxy, no mirror
+    proxy_port = await proxy.serve()
+    url = f"http://127.0.0.1:{reg_port}/v2/library/app/blobs/sha256:{BLOB_SHA}"
+    try:
+        async with aiohttp.ClientSession() as http:
+            # Absolute-URI GET through the proxy, ranged.
+            resp = await http.get(url, proxy=f"http://127.0.0.1:{proxy_port}",
+                                  headers={"Range": "bytes=100-299"})
+            assert resp.status == 206
+            assert await resp.read() == BLOB[100:300]
+            assert "Content-Range" in resp.headers
+    finally:
+        await proxy.close()
+        tm.storage.close()
+        await registry.cleanup()
+
+
+def test_proxy_connect_tunnel(tmp_path, run_async):
+    run_async(_proxy_tunnel(tmp_path))
+
+
+async def _proxy_tunnel(tmp_path):
+    registry, reg_port, _ = await start_registry()
+    tm = make_task_manager(tmp_path)
+    proxy = Proxy(P2PTransport(tm), white_list_ports=[reg_port])
+    proxy_port = await proxy.serve()
+    try:
+        reader, writer = await asyncio.open_connection("127.0.0.1", proxy_port)
+        writer.write(f"CONNECT 127.0.0.1:{reg_port} HTTP/1.1\r\n\r\n".encode())
+        await writer.drain()
+        status = await reader.readline()
+        assert b"200" in status
+        while (await reader.readline()) not in (b"\r\n", b""):
+            pass
+        # Speak plain HTTP through the tunnel.
+        writer.write(b"GET /v2/library/app/manifests/latest HTTP/1.1\r\n"
+                     b"Host: registry\r\nConnection: close\r\n\r\n")
+        await writer.drain()
+        payload = await reader.read()
+        assert b"schemaVersion" in payload
+        writer.close()
+    finally:
+        await proxy.close()
+        tm.storage.close()
+        await registry.cleanup()
+
+
+def test_proxy_auth_and_concurrency_gate(tmp_path, run_async):
+    run_async(_proxy_auth(tmp_path))
+
+
+async def _proxy_auth(tmp_path):
+    registry, reg_port, _ = await start_registry()
+    tm = make_task_manager(tmp_path)
+    proxy = Proxy(P2PTransport(tm), basic_auth=("user", "pw"),
+                  registry_mirror=f"http://127.0.0.1:{reg_port}")
+    proxy_port = await proxy.serve()
+    try:
+        async with aiohttp.ClientSession() as http:
+            resp = await http.get(
+                f"http://127.0.0.1:{proxy_port}/v2/library/app/manifests/latest")
+            assert resp.status == 407
+            resp = await http.get(
+                f"http://127.0.0.1:{proxy_port}/v2/library/app/manifests/latest",
+                headers={"Proxy-Authorization": aiohttp.BasicAuth("user", "pw").encode().replace("Basic", "Basic")})
+            assert resp.status == 200
+    finally:
+        await proxy.close()
+        tm.storage.close()
+        await registry.cleanup()
+
+
+def test_stream_task_open_ended_range(tmp_path, run_async):
+    """bytes=N- (docker blob resume) must stream the tail, not empty
+    (regression: unresolved length=-1 sliced everything away)."""
+    run_async(_stream_open_range(tmp_path))
+
+
+async def _stream_open_range(tmp_path):
+    registry, reg_port, _ = await start_registry()
+    tm = make_task_manager(tmp_path)
+    proxy = Proxy(P2PTransport(tm))
+    proxy_port = await proxy.serve()
+    url = f"http://127.0.0.1:{reg_port}/v2/library/app/blobs/sha256:{BLOB_SHA}"
+    try:
+        async with aiohttp.ClientSession() as http:
+            resp = await http.get(url, proxy=f"http://127.0.0.1:{proxy_port}",
+                                  headers={"Range": "bytes=6000000-"})
+            assert resp.status == 206
+            assert resp.headers["Content-Range"] == \
+                f"bytes 6000000-{len(BLOB) - 1}/{len(BLOB)}"
+            assert await resp.read() == BLOB[6000000:]
+    finally:
+        await proxy.close()
+        tm.storage.close()
+        await registry.cleanup()
