@@ -76,11 +76,9 @@ class Daemon:
         self.proxy = None
         if config.proxy.enabled:
             from dragonfly2_tpu.daemon.proxy import Proxy
-            from dragonfly2_tpu.daemon.transport import P2PTransport, ProxyRule
+            from dragonfly2_tpu.daemon.transport import P2PTransport, rules_from_config
 
-            rules = [ProxyRule(regex=r.get("regex", ""),
-                               direct=bool(r.get("direct", False)))
-                     for r in config.proxy.rules if r.get("regex")]
+            rules = rules_from_config(config.proxy.rules)
             self.proxy = Proxy(
                 P2PTransport(self.task_manager, rules=rules),
                 registry_mirror=config.proxy.registry_mirror,

@@ -19,6 +19,9 @@ class PieceEvent:
     piece_size: int = 0
     done: bool = False
     failed: bool = False
+    # piece_num → "algo:encoded" — children verify against the parent's
+    # advertised digest (reference commonv1 PieceInfo.piece_md5).
+    digests: dict[int, str] = field(default_factory=dict)
 
 
 @dataclass

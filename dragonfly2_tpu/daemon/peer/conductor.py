@@ -313,7 +313,9 @@ class PeerTaskConductor:
             data, cost_ms = await self.downloader.download_piece(
                 p.ip, p.upload_port, self.task_id, assignment.piece_num,
                 src_peer_id=self.peer_id, expected_size=assignment.expected_size)
-            rec = self.store.write_piece(assignment.piece_num, data, cost_ms=cost_ms)
+            rec = self.store.write_piece(assignment.piece_num, data,
+                                         expected_digest=assignment.digest,
+                                         cost_ms=cost_ms)
             self.dispatcher.report_success(assignment, cost_ms)
             PIECE_DOWNLOAD_COUNT.labels("ok").inc()
             await self._report_piece(rec, parent_id=p.peer_id)

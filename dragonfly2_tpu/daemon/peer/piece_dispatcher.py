@@ -36,6 +36,7 @@ class PieceAssignment:
     piece_num: int
     parent: ParentInfo
     expected_size: int = -1
+    digest: str = ""   # parent-advertised "algo:encoded"; verified on write
 
 
 class PieceDispatcher:
@@ -46,6 +47,7 @@ class PieceDispatcher:
         self.content_length = -1
         self._done: set[int] = set()
         self._inflight: set[int] = set()
+        self.piece_digests: dict[int, str] = {}
         # Incremental ready-tracking: O(1) amortized per assignment instead
         # of rescanning all pieces (a 100 GiB task is ~25k pieces).
         self._needed: set[int] = set()
@@ -97,11 +99,16 @@ class PieceDispatcher:
 
     def on_parent_pieces(self, peer_id: str, piece_nums: list[int],
                          total_piece_count: int = -1, content_length: int = -1,
-                         piece_size: int = 0) -> None:
+                         piece_size: int = 0,
+                         digests: dict[int, str] | None = None) -> None:
         p = self.parents.get(peer_id)
         if p is None:
             return
         p.pieces.update(piece_nums)
+        if digests:
+            for n, d in digests.items():
+                if d:
+                    self.piece_digests[int(n)] = d
         if total_piece_count >= 0:
             self.total_piece_count = total_piece_count
         if self._total_piece_count < 0:
@@ -190,7 +197,8 @@ class PieceDispatcher:
                 from dragonfly2_tpu.pkg.piece import piece_length
 
                 expected = piece_length(n, self.piece_size, self.content_length)
-            found = PieceAssignment(n, parent, expected)
+            found = PieceAssignment(n, parent, expected,
+                                    digest=self.piece_digests.get(n, ""))
             break
         for n in deferred:
             heapq.heappush(self._heap, n)

@@ -78,6 +78,7 @@ class PieceTaskSynchronizer:
                     msg.get("total_piece_count", -1),
                     msg.get("content_length", -1),
                     msg.get("piece_size", 0),
+                    digests=msg.get("digests") or {},
                 )
                 if msg.get("done"):
                     done = True

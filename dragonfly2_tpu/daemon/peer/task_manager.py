@@ -137,7 +137,8 @@ class TaskManager:
         async def on_piece(st, rec) -> None:
             m = st.metadata
             self.broker.publish(task_id, PieceEvent(
-                [rec.num], m.total_piece_count, m.content_length, m.piece_size))
+                [rec.num], m.total_piece_count, m.content_length, m.piece_size,
+                digests={rec.num: rec.digest}))
             if progress_q is not None:
                 await progress_q.on_piece(st, rec)
 
@@ -304,6 +305,32 @@ class TaskManager:
 
     # -- stream task (reference StartStreamTask :357, peertask_stream.go) --
 
+    class _StreamBody:
+        """Ordered-piece stream body that releases its broker subscription
+        even when aclose()d before the first iteration — an unstarted async
+        generator's finally never runs (PEP 525), which would leak the
+        queue for the lifetime of the daemon."""
+
+        def __init__(self, broker, task_id: str, gen, q):
+            self._broker = broker
+            self._task_id = task_id
+            self._gen = gen
+            self._q = q
+
+        def __aiter__(self):
+            return self
+
+        async def __anext__(self):
+            return await self._gen.__anext__()
+
+        async def aclose(self) -> None:
+            try:
+                await self._gen.aclose()
+            finally:
+                # Idempotent: the generator's own finally also unsubscribes
+                # when it got far enough to run.
+                self._broker.unsubscribe(self._task_id, self._q)
+
     async def start_stream_task(self, req: StreamTaskRequest):
         """Returns (attrs, body_iterator). attrs carries task/peer id,
         content_length (may be -1 for unknown-length origins until done) and
@@ -364,7 +391,8 @@ class TaskManager:
         attrs = self._stream_attrs(store, task_id, peer_id)
         rng = self._resolve_range(req.range, attrs["content_length"])
         attrs["range"] = rng
-        return attrs, self._stream_ordered(task_id, store, run, q, rng)
+        return attrs, self._StreamBody(
+            self.broker, task_id, self._stream_ordered(task_id, store, run, q, rng), q)
 
     @staticmethod
     def _resolve_range(rng: Range | None, content_length: int) -> Range | None:
@@ -427,15 +455,23 @@ class TaskManager:
         return data[lo - piece_offset:hi - piece_offset]
 
     async def _stream_from_store(self, store, rng: Range | None) -> AsyncIterator[bytes]:
-        """Completed task: emit ordered pieces straight off disk."""
+        """Completed task: emit ordered pieces straight off disk, touching
+        only pieces that intersect the range (a tail range on a multi-GiB
+        blob must not read the whole file)."""
         store.pin()
         try:
             m = store.metadata
-            for num in range(max(m.total_piece_count, 0)):
+            start_num = 0
+            if rng is not None and m.piece_size > 0:
+                start_num = rng.start // m.piece_size
+            for num in range(start_num, max(m.total_piece_count, 0)):
                 data = store.read_piece(num)
                 chunk = self._slice_piece(data, num * m.piece_size, rng)
                 if chunk:
                     yield chunk
+                if (rng is not None and rng.length >= 0 and m.piece_size > 0
+                        and (num + 1) * m.piece_size >= rng.start + rng.length):
+                    return
         finally:
             store.unpin()
 
@@ -449,6 +485,12 @@ class TaskManager:
             while True:
                 m = store.metadata
                 while store.has_piece(next_num):
+                    # Pieces wholly before the range advance the frontier
+                    # without touching disk.
+                    if (rng is not None and m.piece_size > 0
+                            and (next_num + 1) * m.piece_size <= rng.start):
+                        next_num += 1
+                        continue
                     data = store.read_piece(next_num)
                     chunk = self._slice_piece(data, next_num * m.piece_size, rng)
                     if chunk:

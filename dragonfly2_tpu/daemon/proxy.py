@@ -32,6 +32,15 @@ _HOP_HEADERS = {"connection", "proxy-connection", "keep-alive", "te", "trailer",
                 "transfer-encoding", "upgrade", "proxy-authorization"}
 
 
+def _hget(headers: dict[str, str], name: str, default: str = "") -> str:
+    """Case-insensitive header lookup (HTTP/2 hops lowercase names)."""
+    lname = name.lower()
+    for k, v in headers.items():
+        if k.lower() == lname:
+            return v
+    return default
+
+
 class Proxy:
     def __init__(self, transport: P2PTransport, *, registry_mirror: str = "",
                  basic_auth: tuple[str, str] | None = None,
@@ -136,7 +145,7 @@ class Proxy:
         return method.upper(), target, version, headers
 
     def _check_auth(self, headers: dict[str, str]) -> bool:
-        cred = headers.get("Proxy-Authorization", "")
+        cred = _hget(headers, "Proxy-Authorization")
         if not cred.startswith("Basic "):
             return False
         try:
@@ -190,7 +199,7 @@ class Proxy:
             # Mirror mode (reference mirrorRegistry :585): we ARE the
             # registry host; rebase the origin-form path onto the remote.
             return urljoin(self.registry_mirror + "/", target.lstrip("/"))
-        host = headers.get("Host", "")
+        host = _hget(headers, "Host")
         return f"http://{host}{target}"
 
     async def _handle_http(self, method: str, target: str,
@@ -201,7 +210,7 @@ class Proxy:
         fwd_headers = {k: v for k, v in headers.items()
                        if k.lower() not in _HOP_HEADERS and k.lower() != "host"}
         body = b""
-        length = int(headers.get("Content-Length", 0) or 0)
+        length = int(_hget(headers, "Content-Length", "0") or 0)
         if length:
             body = await reader.readexactly(length)
 
@@ -232,6 +241,15 @@ class Proxy:
         if rng is not None:
             status = 206
             resp_len = min(rng.length, max(total - rng.start, 0))
+            if resp_len <= 0:
+                # Range at/past EOF: RFC 9110 §15.5.17 — 416 with the
+                # unsatisfied-range form, never a degenerate Content-Range.
+                await body_iter.aclose()
+                await Proxy._respond(
+                    writer, 416, b"range not satisfiable",
+                    extra=f"Content-Range: bytes */{total}\r\n")
+                PROXY_REQUESTS.labels("p2p").inc()
+                return True
             extra = (f"Content-Range: bytes {rng.start}-"
                      f"{rng.start + resp_len - 1}/{total}\r\n")
         else:

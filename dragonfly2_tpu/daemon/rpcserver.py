@@ -108,6 +108,7 @@ class DaemonRpcServer:
             "content_length": m.content_length,
             "piece_size": m.piece_size,
             "done": m.done,
+            "digests": {n: p.digest for n, p in m.pieces.items() if p.digest},
         }
 
     async def _sync_piece_tasks(self, stream: ServerStream, ctx: RpcContext) -> None:
@@ -138,6 +139,7 @@ class DaemonRpcServer:
                     "content_length": event.content_length,
                     "piece_size": event.piece_size,
                     "done": event.done,
+                    "digests": event.digests,
                 })
                 if event.done:
                     return

@@ -30,6 +30,15 @@ HDR_NO_P2P = "X-Dragonfly-No-P2P"
 _BLOB_RE = re.compile(r"/v2/.+/blobs/sha256:[0-9a-f]{64}")
 
 
+def _pop_header(headers: dict[str, str], name: str, default: str = "") -> str:
+    """Case-insensitive pop (HTTP/2-originating hops lowercase names)."""
+    lname = name.lower()
+    for k in list(headers):
+        if k.lower() == lname:
+            return headers.pop(k)
+    return default
+
+
 @dataclass
 class ProxyRule:
     """Reference config proxy rule: regex + direct/useHTTPS flags."""
@@ -46,6 +55,17 @@ class ProxyRule:
         return bool(self._compiled.search(url))
 
 
+def rules_from_config(rule_dicts: list[dict]) -> list[ProxyRule]:
+    """Build proxy rules from config dicts {regex, use_dragonfly, direct}.
+    A rule bypasses P2P when direct=true OR use_dragonfly=false (reference
+    proxy.go shouldUseDragonfly honors both spellings)."""
+    return [ProxyRule(regex=r.get("regex", ""),
+                      direct=bool(r.get("direct", False))
+                      or not r.get("use_dragonfly", True),
+                      use_https=bool(r.get("use_https", False)))
+            for r in rule_dicts if r.get("regex")]
+
+
 class P2PTransport:
     def __init__(self, task_manager: TaskManager, *, rules: list[ProxyRule] | None = None,
                  default_tag: str = ""):
@@ -59,7 +79,9 @@ class P2PTransport:
         decide, registry blobs always qualify."""
         if method.upper() != "GET":
             return False
-        if headers and headers.get(HDR_NO_P2P, "").lower() in ("1", "true"):
+        if headers and any(k.lower() == HDR_NO_P2P.lower()
+                           and str(v).lower() in ("1", "true")
+                           for k, v in headers.items()):
             return False
         for rule in self.rules:
             if rule.matches(url):
@@ -71,16 +93,16 @@ class P2PTransport:
         Raises DfError on task failure before the first byte."""
         headers = dict(headers or {})
         rng = None
-        range_header = headers.pop("Range", headers.pop("range", ""))
+        range_header = _pop_header(headers, "Range")
         if range_header:
             try:
                 rng = Range.parse_http(range_header)
             except ValueError as e:
                 raise DfError(Code.BadRequest, f"bad range: {e}")
         meta = UrlMeta(
-            tag=headers.pop(HDR_TAG, self.default_tag),
-            application=headers.pop(HDR_APPLICATION, ""),
-            filter=headers.pop(HDR_FILTER, ""),
+            tag=_pop_header(headers, HDR_TAG, self.default_tag),
+            application=_pop_header(headers, HDR_APPLICATION),
+            filter=_pop_header(headers, HDR_FILTER),
             header=headers,
         )
         req = StreamTaskRequest(url=url, meta=meta, range=rng)

@@ -1,0 +1,5 @@
+"""Native C++ data-plane core (crc32c, fused piece IO, parallel hashing).
+
+Import ``dragonfly2_tpu.native.binding`` to use it; import errors mean no
+toolchain/library and callers must fall back to pure Python.
+"""

@@ -1,0 +1,99 @@
+"""ctypes binding over libdfnative.so (see src/dfnative.cc).
+
+Importing this module raises if the library can't be built/loaded; callers
+(pkg/digest, storage) catch and fall back to pure Python, mirroring how the
+reference loads optional plugins (internal/dfplugin/dfplugin.go:53-55).
+ctypes calls release the GIL, so piece hashing/writing runs truly parallel
+under the daemon's worker threads.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+from dragonfly2_tpu.native import build as _build
+
+if os.environ.get("DF_DISABLE_NATIVE"):
+    raise ImportError("native library disabled via DF_DISABLE_NATIVE")
+
+_lib = ctypes.CDLL(_build.build())
+
+_lib.df_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32]
+_lib.df_crc32c.restype = ctypes.c_uint32
+
+_lib.df_write_piece_crc.argtypes = [
+    ctypes.c_int, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_size_t,
+    ctypes.POINTER(ctypes.c_uint32),
+]
+_lib.df_write_piece_crc.restype = ctypes.c_int
+
+_lib.df_read_piece_crc.argtypes = [
+    ctypes.c_int, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_size_t,
+    ctypes.POINTER(ctypes.c_uint32),
+]
+_lib.df_read_piece_crc.restype = ctypes.c_int64
+
+_lib.df_hash_pieces_crc.argtypes = [
+    ctypes.c_int,
+    ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+    ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t, ctypes.c_int,
+]
+_lib.df_hash_pieces_crc.restype = ctypes.c_int
+
+_lib.df_copy_range.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_uint64]
+_lib.df_copy_range.restype = ctypes.c_int
+
+_lib.df_has_hw_crc.argtypes = []
+_lib.df_has_hw_crc.restype = ctypes.c_int
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    return _lib.df_crc32c(data, len(data), crc)
+
+
+def has_hw_crc() -> bool:
+    return bool(_lib.df_has_hw_crc())
+
+
+def write_piece_crc(fd: int, offset: int, data: bytes) -> int:
+    """Fused checksum+pwrite; returns the crc32c of ``data``."""
+    out = ctypes.c_uint32(0)
+    rc = _lib.df_write_piece_crc(fd, offset, data, len(data), ctypes.byref(out))
+    if rc < 0:
+        raise OSError(-rc, os.strerror(-rc))
+    return out.value
+
+
+def read_piece_crc(fd: int, offset: int, size: int) -> tuple[bytes, int]:
+    """Fused pread+checksum; returns (data, crc32c)."""
+    buf = ctypes.create_string_buffer(size)
+    out = ctypes.c_uint32(0)
+    n = _lib.df_read_piece_crc(fd, offset, buf, size, ctypes.byref(out))
+    if n < 0:
+        raise OSError(-n, os.strerror(-n))
+    return buf.raw[:n], out.value
+
+
+def hash_pieces_crc(fd: int, offsets: list[int], sizes: list[int],
+                    threads: int = 0) -> list[int]:
+    """Parallel per-piece crc32c table over an open file."""
+    n = len(offsets)
+    if n != len(sizes):
+        raise ValueError("offsets/sizes length mismatch")
+    if n == 0:
+        return []
+    off_arr = (ctypes.c_uint64 * n)(*offsets)
+    size_arr = (ctypes.c_uint64 * n)(*sizes)
+    crc_arr = (ctypes.c_uint32 * n)()
+    rc = _lib.df_hash_pieces_crc(fd, off_arr, size_arr, crc_arr, n, threads)
+    if rc < 0:
+        raise OSError(-rc, os.strerror(-rc))
+    return list(crc_arr)
+
+
+def copy_range(in_fd: int, out_fd: int, length: int) -> None:
+    """copy_file_range loop with read/write fallback."""
+    rc = _lib.df_copy_range(in_fd, out_fd, length)
+    if rc < 0:
+        raise OSError(-rc, os.strerror(-rc))

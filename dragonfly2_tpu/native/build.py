@@ -1,0 +1,57 @@
+"""Build libdfnative.so from source with g++.
+
+Invoked lazily by binding.py on first import (result cached on disk next to
+the source), or explicitly: ``python -m dragonfly2_tpu.native.build``.
+A single translation unit keeps this a one-command build — no cmake needed,
+though the toolchain would support it.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_LIB_DIR = os.path.join(os.path.dirname(__file__), "_lib")
+LIB_PATH = os.path.join(_LIB_DIR, "libdfnative.so")
+
+
+def _sources() -> list[str]:
+    return [os.path.join(_SRC_DIR, f) for f in sorted(os.listdir(_SRC_DIR)) if f.endswith(".cc")]
+
+
+def needs_build() -> bool:
+    if not os.path.exists(LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(LIB_PATH)
+    return any(os.path.getmtime(s) > lib_mtime for s in _sources())
+
+
+def build(quiet: bool = True) -> str:
+    """Compile the shared library; atomic rename so concurrent builders are
+    safe. Raises CalledProcessError / FileNotFoundError when no toolchain."""
+    os.makedirs(_LIB_DIR, exist_ok=True)
+    if not needs_build():
+        return LIB_PATH
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_LIB_DIR)
+    os.close(fd)
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        "-Wall", "-Wextra",
+        *_sources(),
+        "-o", tmp,
+    ]
+    try:
+        subprocess.run(cmd, check=True,
+                       stdout=subprocess.DEVNULL if quiet else None,
+                       stderr=subprocess.PIPE if quiet else None)
+        os.replace(tmp, LIB_PATH)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return LIB_PATH
+
+
+if __name__ == "__main__":
+    print(build(quiet=False))

@@ -125,6 +125,14 @@ class _Crc32cHasher:
         return self._crc.to_bytes(4, "big")
 
 
+def preferred_piece_algorithm() -> str:
+    """Per-piece digest algorithm for newly produced pieces: hardware crc32c
+    via the native library when available (fused checksum+write, and cheap
+    enough to re-verify on-device — ops/checksum.py), else md5 like the
+    reference (local_storage.go WritePiece)."""
+    return ALGORITHM_CRC32C if _native_crc32c() is not None else ALGORITHM_MD5
+
+
 def new_hasher(algorithm: str):
     if algorithm == ALGORITHM_CRC32C:
         return _Crc32cHasher()

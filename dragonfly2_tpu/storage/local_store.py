@@ -23,6 +23,24 @@ from dragonfly2_tpu.pkg.piece import compute_piece_count
 DATA_FILE = "data"
 METADATA_FILE = "metadata.json"
 
+_NATIVE = None
+_NATIVE_PROBED = False
+
+
+def _native():
+    """The C++ data-plane core (dragonfly2_tpu/native), or None. Fuses
+    checksum+pwrite into one buffer pass and parallelizes re-verification."""
+    global _NATIVE, _NATIVE_PROBED
+    if not _NATIVE_PROBED:
+        _NATIVE_PROBED = True
+        try:
+            from dragonfly2_tpu.native import binding
+
+            _NATIVE = binding
+        except Exception:
+            _NATIVE = None
+    return _NATIVE
+
 
 @dataclass
 class PieceRecord:
@@ -180,28 +198,53 @@ class LocalTaskStore:
     # -- piece IO ----------------------------------------------------------
 
     def write_piece(self, num: int, data: bytes, expected_digest: str = "",
-                    cost_ms: int = 0) -> PieceRecord:
+                    cost_ms: int = 0, algorithm: str = "") -> PieceRecord:
         """Write piece ``num``. Verifies the per-piece digest before the
-        write lands (reference local_storage.go:102-196 hashes in-flight)."""
+        write lands (reference local_storage.go:102-196 hashes in-flight).
+        With no ``expected_digest``, a fresh digest is computed with
+        ``algorithm`` (default: preferred_piece_algorithm — hardware crc32c
+        fused into the write when the native library is present)."""
         m = self.metadata
         if m.piece_size <= 0:
             raise StorageError("piece size not set")
-        if expected_digest:
-            d = pkgdigest.parse(expected_digest)
-            actual = pkgdigest.hash_bytes(d.algorithm, data)
-            if actual.encoded != d.encoded:
-                raise StorageError(
-                    f"piece {num} digest mismatch: want {d.encoded}, got {actual.encoded}",
-                    Code.ClientPieceDownloadFail,
-                )
-            digest_str = expected_digest
-        else:
-            digest_str = str(pkgdigest.hash_bytes(pkgdigest.ALGORITHM_MD5, data))
         offset = num * m.piece_size
         fd = self._ensure_fd()
-        written = 0
-        while written < len(data):
-            written += os.pwrite(fd, data[written:], offset + written)
+        native = _native()
+        fused = False
+        if expected_digest:
+            d = pkgdigest.parse(expected_digest)
+            if native is not None and d.algorithm == pkgdigest.ALGORITHM_CRC32C:
+                # Fused path: the C++ core checksums while pwrite()ing (one
+                # memory walk). A mismatched piece is re-requested and the
+                # same offsets are simply overwritten — metadata below is
+                # only recorded on success, so the bad bytes are invisible.
+                crc = native.write_piece_crc(fd, offset, data)
+                if f"{crc:08x}" != d.encoded:
+                    raise StorageError(
+                        f"piece {num} digest mismatch: want {d.encoded}, got {crc:08x}",
+                        Code.ClientPieceDownloadFail,
+                    )
+                fused = True
+            else:
+                actual = pkgdigest.hash_bytes(d.algorithm, data)
+                if actual.encoded != d.encoded:
+                    raise StorageError(
+                        f"piece {num} digest mismatch: want {d.encoded}, got {actual.encoded}",
+                        Code.ClientPieceDownloadFail,
+                    )
+            digest_str = expected_digest
+        else:
+            algorithm = algorithm or pkgdigest.preferred_piece_algorithm()
+            if native is not None and algorithm == pkgdigest.ALGORITHM_CRC32C:
+                crc = native.write_piece_crc(fd, offset, data)
+                digest_str = f"{pkgdigest.ALGORITHM_CRC32C}:{crc:08x}"
+                fused = True
+            else:
+                digest_str = str(pkgdigest.hash_bytes(algorithm, data))
+        if not fused:
+            written = 0
+            while written < len(data):
+                written += os.pwrite(fd, data[written:], offset + written)
         rec = PieceRecord(num=num, offset=offset, size=len(data), digest=digest_str, cost_ms=cost_ms)
         existing = m.pieces.get(num)
         m.pieces[num] = rec
@@ -286,6 +329,35 @@ class LocalTaskStore:
                                Code.ClientPieceDownloadFail)
         return actual
 
+    def reverify_pieces(self, threads: int = 0) -> list[int]:
+        """Re-verify all crc32c-digested pieces against on-disk bytes; returns
+        the piece numbers that fail. Uses the parallel C++ digest table when
+        available (seed re-verification / dfcache import integrity sweep)."""
+        recs = [self.metadata.pieces[n] for n in sorted(self.metadata.pieces)]
+        crc_recs = [r for r in recs
+                    if r.digest.startswith(pkgdigest.ALGORITHM_CRC32C + ":")]
+        bad: list[int] = []
+        native = _native()
+        if native is not None and crc_recs:
+            fd = self._ensure_fd()
+            crcs = native.hash_pieces_crc(
+                fd, [r.offset for r in crc_recs], [r.size for r in crc_recs],
+                threads=threads)
+            for r, crc in zip(crc_recs, crcs):
+                if f"{pkgdigest.ALGORITHM_CRC32C}:{crc:08x}" != r.digest:
+                    bad.append(r.num)
+            checked = {r.num for r in crc_recs}
+        else:
+            checked = set()
+        for r in recs:
+            if r.num in checked or not r.digest:
+                continue
+            d = pkgdigest.parse(r.digest)
+            actual = pkgdigest.hash_bytes(d.algorithm, self.read_piece(r.num))
+            if actual.encoded != d.encoded:
+                bad.append(r.num)
+        return sorted(bad)
+
     def store_to(self, dest: str, *, hardlink: bool = True) -> None:
         """Land the completed content at ``dest``: hardlink when possible,
         else copy (reference local_storage.go:353)."""
@@ -307,4 +379,15 @@ class LocalTaskStore:
                 return
             except OSError:
                 pass
+        native = _native()
+        if native is not None:
+            size = os.path.getsize(self._data_path)
+            in_fd = os.open(self._data_path, os.O_RDONLY)
+            out_fd = os.open(dest, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+            try:
+                native.copy_range(in_fd, out_fd, size)
+                return
+            finally:
+                os.close(in_fd)
+                os.close(out_fd)
         shutil.copyfile(self._data_path, dest)

@@ -304,3 +304,94 @@ async def _stream_open_range(tmp_path):
         await proxy.close()
         tm.storage.close()
         await registry.cleanup()
+
+
+# -- code-review regressions ------------------------------------------------
+
+def test_rules_from_config_use_dragonfly_flag():
+    from dragonfly2_tpu.daemon.transport import rules_from_config
+
+    tm = object.__new__(TaskManager)
+    rules = rules_from_config([
+        {"regex": r"internal\.example", "use_dragonfly": False},
+        {"regex": r"\.safetensors$", "use_dragonfly": True},
+        {"regex": r"\.blocked$", "direct": True},
+        {"regex": ""},  # dropped
+    ])
+    assert len(rules) == 3
+    t = P2PTransport(tm, rules=rules)
+    # use_dragonfly=false must EXCLUDE from P2P, not include.
+    assert not t.should_use_p2p("GET", "http://internal.example/m.safetensors")
+    assert t.should_use_p2p("GET", "http://host/m.safetensors")
+    assert not t.should_use_p2p("GET", "http://host/x.blocked")
+
+
+def test_no_p2p_header_case_insensitive():
+    tm = object.__new__(TaskManager)
+    t = P2PTransport(tm, rules=[ProxyRule(regex=r"\.safetensors$")])
+    assert not t.should_use_p2p("GET", "http://h/m.safetensors",
+                                {"x-dragonfly-no-p2p": "1"})
+
+
+def test_stream_body_aclose_before_iteration_releases_subscription(tmp_path, run_async):
+    async def run():
+        runner, port, _ = await start_registry()
+        tm = make_task_manager(tmp_path)
+        try:
+            url = f"http://127.0.0.1:{port}/v2/library/app/blobs/sha256:{BLOB_SHA}"
+            req = StreamTaskRequest(url=url, meta=UrlMeta())
+            attrs, body = await tm.start_stream_task(req)
+            task_id = attrs["task_id"]
+            assert task_id in tm.broker._tasks
+            await body.aclose()          # before first __anext__
+            # The broker must not keep the queue alive (leak regression:
+            # an unstarted async generator's finally never runs).
+            ch = tm.broker._tasks.get(task_id)
+            assert ch is None or not ch.queues
+            # Let the background download finish so the loop closes clean.
+            for _ in range(200):
+                if not tm.is_task_running(task_id):
+                    break
+                await asyncio.sleep(0.05)
+        finally:
+            await runner.cleanup()
+
+    run_async(run())
+
+
+def test_stream_range_skips_leading_pieces(tmp_path, run_async):
+    async def run():
+        runner, port, _ = await start_registry()
+        tm = make_task_manager(tmp_path)
+        reads = []
+        try:
+            url = f"http://127.0.0.1:{port}/v2/library/app/blobs/sha256:{BLOB_SHA}"
+            # Complete the task first.
+            attrs, body = await tm.start_stream_task(
+                StreamTaskRequest(url=url, meta=UrlMeta()))
+            async for _ in body:
+                pass
+            # Tail range from the completed store: pieces before the range
+            # must not be read off disk.
+            store = tm.storage.find_completed_task(attrs["task_id"])
+            orig = store.read_piece
+
+            def counting_read(num):
+                reads.append(num)
+                return orig(num)
+
+            store.read_piece = counting_read
+            start = len(BLOB) - 100
+            attrs2, body2 = await tm.start_stream_task(
+                StreamTaskRequest(url=url, meta=UrlMeta(),
+                                  range=Range(start, -1)))
+            got = b""
+            async for chunk in body2:
+                got += chunk
+            assert got == BLOB[start:]
+            piece_size = store.metadata.piece_size
+            assert reads and min(reads) >= start // piece_size
+        finally:
+            await runner.cleanup()
+
+    run_async(run())
