@@ -177,6 +177,12 @@ class Daemon:
 
     async def start(self) -> None:
         """Bring every service up (non-blocking)."""
+        # Warm the native data-plane probe off-loop: a cold first import
+        # compiles the C++ library (seconds of g++), which must not freeze
+        # the event loop at the first piece write on the hot path.
+        from dragonfly2_tpu.storage import local_store
+
+        await asyncio.get_running_loop().run_in_executor(None, local_store._native)
         if self.config.manager_addr:
             await self._resolve_schedulers_from_manager()
         await self.rpc.serve_download(NetAddr.unix(self.config.unix_sock))

@@ -38,6 +38,8 @@ class DaemonRpcServer:
         self.peer_server.register_stream("Peer.SyncPieceTasks", self._sync_piece_tasks)
         self.peer_server.register_unary("Peer.GetPieceTasks", self._get_piece_tasks)
         self.peer_server.register_unary("Peer.TriggerDownloadTask", self._trigger_download)
+        self.peer_server.register_unary("Peer.StatTask", self._stat_task)
+        self.peer_server.register_unary("Peer.DeleteTask", self._delete_task)
         self.peer_server.register_unary("Daemon.Health", self._health)
 
     async def serve_download(self, addr: NetAddr) -> None:
@@ -88,7 +90,15 @@ class DaemonRpcServer:
         }
 
     async def _delete_task(self, body, ctx: RpcContext):
+        """Refuses while the task is running or its store is pinned by an
+        active stream/upload — same safety rule storage GC applies
+        (storage/manager.py skips pinned stores)."""
         task_id = (body or {}).get("task_id", "")
+        if self.task_manager.is_task_running(task_id):
+            return {"ok": False, "reason": "task running"}
+        store = self.task_manager.storage.try_get(task_id)
+        if store is not None and store.pinned:
+            return {"ok": False, "reason": "task store in use"}
         self.task_manager.storage.delete_task(task_id)
         return {"ok": True}
 

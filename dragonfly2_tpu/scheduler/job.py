@@ -1,0 +1,227 @@
+"""Scheduler job worker: consumes manager-queued async jobs.
+
+Reference: scheduler/job/job.go — machinery worker on Redis queues (:67
+New, :115 task map) running preheat (:161; single seed peer :221, all seed
+peers :252, all peers :398), sync peers (:627) and get/delete task. Here the
+transport is the manager's drpc long-poll queue (manager/jobqueue.py) — same
+at-least-once contract, no Redis.
+
+Preheat fan-out rides the same ``Peer.TriggerDownloadTask`` RPC the
+scheduler already uses to seed a task (seed_client.py), so a preheat to N
+hosts is N trigger calls; each triggered daemon then pulls through the P2P
+tree like any other peer rather than hammering origin (the scheduler's seed
+dedup keeps origin fetches at ~1 — service.py _maybe_trigger_seed).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any
+
+from dragonfly2_tpu.pkg import dflog, idgen
+from dragonfly2_tpu.scheduler.resource import TaskState
+
+log = dflog.get("scheduler.job")
+
+# Job types / states mirrored from manager/jobqueue.py (single source would
+# couple scheduler→manager imports; these are wire constants).
+PREHEAT_JOB = "preheat"
+SYNC_PEERS_JOB = "sync_peers"
+GET_TASK_JOB = "get_task"
+DELETE_TASK_JOB = "delete_task"
+SUCCESS = "SUCCESS"
+FAILURE = "FAILURE"
+
+SCOPE_SINGLE_SEED = "single_seed_peer"
+SCOPE_ALL_SEEDS = "all_seed_peers"
+SCOPE_ALL_PEERS = "all_peers"
+
+
+class JobWorker:
+    """Long-polls the manager job queue for this scheduler's cluster and
+    executes jobs against the live resource model."""
+
+    def __init__(self, service, manager_client, scheduler_cluster_id: int,
+                 *, poll_timeout: float = 30.0):
+        self.service = service
+        self.manager = manager_client
+        self.cluster_id = scheduler_cluster_id
+        self.queue = f"scheduler_{scheduler_cluster_id}"
+        self.poll_timeout = poll_timeout
+        self._task: asyncio.Task | None = None
+
+    def serve(self) -> None:
+        self._task = asyncio.create_task(self._loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                item = await self.manager.poll_job(self.queue, timeout=self.poll_timeout)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.warning("job poll failed", error=str(e))
+                await asyncio.sleep(5.0)
+                continue
+            if item is None:
+                continue
+            state, result = await self._execute(item)
+            try:
+                await self.manager.complete_job(
+                    item["group_id"], item["task_uuid"], state, result)
+            except Exception as e:
+                log.warning("job completion report failed", error=str(e))
+
+    async def _execute(self, item: dict) -> tuple[str, dict]:
+        jtype, args = item.get("type", ""), item.get("args") or {}
+        log.info("job received", type=jtype, queue=self.queue)
+        try:
+            if jtype == PREHEAT_JOB:
+                return await self._preheat(args)
+            if jtype == SYNC_PEERS_JOB:
+                return await self._sync_peers(args)
+            if jtype == GET_TASK_JOB:
+                return await self._get_task(args)
+            if jtype == DELETE_TASK_JOB:
+                return await self._delete_task(args)
+            return FAILURE, {"error": f"unknown job type {jtype!r}"}
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.error("job failed", type=jtype, error=str(e))
+            return FAILURE, {"error": str(e)}
+
+    # -- preheat (reference job.go:161-625) --------------------------------
+
+    def _preheat_targets(self, scope: str) -> list:
+        hosts = [h for h in self.service.hosts.all() if h.port > 0]
+        seeds = [h for h in hosts if h.is_seed()]
+        if scope == SCOPE_ALL_PEERS:
+            return hosts
+        if scope == SCOPE_ALL_SEEDS:
+            return seeds
+        # single seed peer: least-loaded (same pick as _maybe_trigger_seed)
+        seeds.sort(key=lambda h: len(h.peer_ids))
+        return seeds[:1]
+
+    async def _preheat(self, args: dict[str, Any]) -> tuple[str, dict]:
+        urls = args.get("urls") or ([args["url"]] if args.get("url") else [])
+        if not urls:
+            return FAILURE, {"error": "preheat without urls"}
+        scope = args.get("scope", SCOPE_SINGLE_SEED)
+        timeout = float(args.get("timeout", 60.0))
+        targets = self._preheat_targets(scope)
+        if not targets:
+            return FAILURE, {"error": f"no hosts for scope {scope!r}"}
+
+        tag = args.get("tag", "")
+        application = args.get("application", "")
+        filters = args.get("filtered_query_params", "")
+        if isinstance(filters, list):
+            filters = "&".join(filters)
+
+        async def one_url(url: str) -> dict:
+            task_id = idgen.task_id_v1(
+                url, tag=tag, application=application, filters=filters)
+            spec = {
+                "task_id": task_id, "url": url, "tag": tag,
+                "application": application,
+                "filters": idgen.parse_filtered_query_params(filters),
+                "header": args.get("headers") or {},
+            }
+            # Concurrent fan-out: unreachable hosts cost one RPC timeout in
+            # total, not one per host (reference preheatAllPeers fans via
+            # goroutines, job.go:398).
+            results = await asyncio.gather(*(
+                self.service.seed_clients.trigger_download_task(h, spec)
+                for h in targets))
+            triggered = sum(1 for r in results if r)
+            done = await self._wait_task(task_id, timeout) if triggered else False
+            return {"url": url, "task_id": task_id, "triggered": triggered,
+                    "targets": len(targets), "succeeded": done}
+
+        per_url = list(await asyncio.gather(*(one_url(u) for u in urls)))
+        ok_all = all(r["triggered"] > 0 and r["succeeded"] for r in per_url)
+        return (SUCCESS if ok_all else FAILURE), {"preheat": per_url, "scope": scope}
+
+    async def _wait_task(self, task_id: str, timeout: float) -> bool:
+        """Wait for the resource model to observe the task succeed (the
+        triggered daemons report through their own AnnouncePeer streams).
+        A FAILED state left over from an earlier attempt is not terminal:
+        the trigger restarts the task, so FAILED only counts once we've
+        seen the task leave it (otherwise a preheat retry against a
+        previously-failed task loses the race with the daemon's register)."""
+        deadline = time.monotonic() + timeout
+        seen_fresh = False
+        while time.monotonic() < deadline:
+            task = self.service.tasks.load(task_id)
+            if task is not None:
+                state = task.state
+                if state == TaskState.SUCCEEDED:
+                    return True
+                if state == TaskState.FAILED:
+                    if seen_fresh:
+                        return False
+                else:
+                    seen_fresh = True
+            await asyncio.sleep(0.2)
+        return False
+
+    # -- sync peers (reference job.go:627) ---------------------------------
+
+    async def _sync_peers(self, args: dict[str, Any]) -> tuple[str, dict]:
+        """Push the live host inventory up to the manager's peers table."""
+        count = 0
+        for host in self.service.hosts.all():
+            try:
+                await self.manager.upsert_peer(
+                    host_id=host.id, hostname=host.hostname, ip=host.ip,
+                    port=host.port, type=int(host.type),
+                    idc=host.idc, location=host.location,
+                    scheduler_cluster_id=self.cluster_id,
+                    state="active")
+                count += 1
+            except Exception as e:
+                log.warning("peer sync failed", host=host.id, error=str(e))
+        return SUCCESS, {"synced": count}
+
+    # -- get / delete task (reference job.go getTask/deleteTask) -----------
+
+    def _holders(self, task_id: str) -> list:
+        task = self.service.tasks.load(task_id)
+        if task is None:
+            return []
+        hosts = {}
+        for p in task.peers():
+            if p.is_done() or p.finished_pieces:
+                hosts[p.host.id] = p.host
+        return list(hosts.values())
+
+    async def _get_task(self, args: dict[str, Any]) -> tuple[str, dict]:
+        task_id = args.get("task_id", "")
+        holders = self._holders(task_id)
+        return SUCCESS, {
+            "task_id": task_id,
+            "peers": [{"host_id": h.id, "ip": h.ip, "hostname": h.hostname}
+                      for h in holders],
+        }
+
+    async def _delete_task(self, args: dict[str, Any]) -> tuple[str, dict]:
+        """Fan Peer.DeleteTask out to every host holding the task."""
+        task_id = args.get("task_id", "")
+        holders = self._holders(task_id)
+        deleted, failed = [], []
+        for host in holders:
+            ok = await self.service.seed_clients.delete_task(host, task_id)
+            (deleted if ok else failed).append(host.id)
+        task = self.service.tasks.load(task_id)
+        if task is not None and not failed:
+            self.service.tasks.delete(task_id)
+        return (SUCCESS if not failed else FAILURE), {
+            "task_id": task_id, "deleted": deleted, "failed": failed}

@@ -37,6 +37,25 @@ class SeedPeerClientPool:
             log.warning("seed trigger failed", seed=host.id, error=str(e))
             return False
 
+    async def delete_task(self, host, task_id: str) -> bool:
+        """Remove a task's local store on a daemon (delete_task job fan-out —
+        reference scheduler/job/job.go deleteTask → dfdaemon client)."""
+        cli = self._client(host.ip, host.port)
+        try:
+            resp = await cli.call("Peer.DeleteTask", {"task_id": task_id}, timeout=10.0)
+            return bool(resp and resp.get("ok"))
+        except Exception as e:
+            log.warning("peer delete_task failed", host=host.id, error=str(e))
+            return False
+
+    async def stat_task(self, host, task_id: str) -> dict | None:
+        """Remote task stat on a daemon (get_task job / sync probes)."""
+        cli = self._client(host.ip, host.port)
+        try:
+            return await cli.call("Peer.StatTask", {"task_id": task_id}, timeout=10.0)
+        except Exception:
+            return None
+
     async def close(self) -> None:
         for cli in self._clients.values():
             await cli.close()

@@ -29,6 +29,7 @@ class SchedulerServer:
         self.gc.add(GCTask("resource", self.config.gc.interval, 30.0, self._gc))
         self.announcer = None       # manager registration (set in start)
         self.dynconfig = None       # manager-fed cluster config + seed peers
+        self.job_worker = None      # manager job-queue consumer (preheat etc.)
         self._manager_retry: asyncio.Task | None = None
         self._stopped = asyncio.Event()
 
@@ -113,11 +114,20 @@ class SchedulerServer:
         await self.dynconfig.dc.refresh()
         self.dynconfig.serve()
 
+        from dragonfly2_tpu.scheduler.job import JobWorker
+
+        self.job_worker = JobWorker(
+            self.service, self.announcer.client,
+            self.announcer.registered["scheduler_cluster_id"])
+        self.job_worker.serve()
+
     def port(self) -> int:
         return self.rpc.port()
 
     async def stop(self) -> None:
         self.gc.stop()
+        if self.job_worker is not None:
+            self.job_worker.stop()
         if self._manager_retry is not None:
             self._manager_retry.cancel()
         if self.dynconfig is not None:

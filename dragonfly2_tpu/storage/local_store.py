@@ -211,9 +211,15 @@ class LocalTaskStore:
         fd = self._ensure_fd()
         native = _native()
         fused = False
+        # The fused paths write before verifying, which is only safe when no
+        # valid bytes exist at this offset yet: re-writing a recorded piece
+        # with corrupt data would leave bad bytes under a digest that still
+        # claims the old content. Recorded pieces verify in memory first.
+        piece_is_new = num not in m.pieces
         if expected_digest:
             d = pkgdigest.parse(expected_digest)
-            if native is not None and d.algorithm == pkgdigest.ALGORITHM_CRC32C:
+            if (native is not None and piece_is_new
+                    and d.algorithm == pkgdigest.ALGORITHM_CRC32C):
                 # Fused path: the C++ core checksums while pwrite()ing (one
                 # memory walk). A mismatched piece is re-requested and the
                 # same offsets are simply overwritten — metadata below is
@@ -235,7 +241,8 @@ class LocalTaskStore:
             digest_str = expected_digest
         else:
             algorithm = algorithm or pkgdigest.preferred_piece_algorithm()
-            if native is not None and algorithm == pkgdigest.ALGORITHM_CRC32C:
+            if (native is not None and piece_is_new
+                    and algorithm == pkgdigest.ALGORITHM_CRC32C):
                 crc = native.write_piece_crc(fd, offset, data)
                 digest_str = f"{pkgdigest.ALGORITHM_CRC32C}:{crc:08x}"
                 fused = True

@@ -120,3 +120,18 @@ def test_store_reverify_detects_bitrot(tmp_path):
         f.seek(2 * 4096 + 7)
         f.write(bytes([c[0] ^ 0xFF]))
     assert store.reverify_pieces(threads=2) == [2]
+
+
+def test_store_recorded_piece_never_corrupted_by_bad_rewrite(tmp_path):
+    """A re-download of an already-recorded piece with corrupt bytes must
+    not overwrite the valid on-disk data (the fused write-then-verify path
+    is only safe for unrecorded pieces)."""
+    store = _make_store(tmp_path)
+    good = os.urandom(4096)
+    d = pkgdigest.hash_bytes(pkgdigest.ALGORITHM_CRC32C, good)
+    store.write_piece(0, good, expected_digest=str(d))
+    corrupt = os.urandom(4096)
+    with pytest.raises(Exception):
+        store.write_piece(0, corrupt, expected_digest=str(d))
+    assert store.read_piece(0) == good
+    assert store.reverify_pieces() == []

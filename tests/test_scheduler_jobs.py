@@ -1,0 +1,136 @@
+"""Scheduler job worker: manager-queued preheat / sync-peers / get / delete
+executed against a live scheduler + seed daemon.
+
+Reference model: scheduler/job/job.go consumed machinery queues and fanned
+preheats to seed peers (preheat :161, :252 allSeedPeers) — here the full
+loop runs hermetically: manager REST/queue → drpc long-poll → JobWorker →
+Peer.TriggerDownloadTask on the seed daemon → origin, with group results
+aggregated back into the manager's jobs table.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from dragonfly2_tpu.manager.config import ManagerConfig
+from dragonfly2_tpu.manager.server import ManagerServer
+from dragonfly2_tpu.pkg import idgen
+from dragonfly2_tpu.scheduler.config import SchedulerConfig
+from dragonfly2_tpu.scheduler.server import SchedulerServer
+
+from tests.test_p2p_e2e import start_daemon, start_origin
+
+
+async def _wait(predicate, timeout: float = 15.0, interval: float = 0.05):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+async def _cluster(tmp_path):
+    """manager + scheduler(joined) + seed daemon, all ephemeral ports."""
+    manager = ManagerServer(ManagerConfig())
+    await manager.start()
+    cfg = SchedulerConfig()
+    cfg.server.port = 0
+    cfg.scheduling.retry_interval = 0.05
+    cfg.gc.interval = 3600
+    cfg.manager_addr = f"127.0.0.1:{manager.grpc_port()}"
+    sched = SchedulerServer(cfg)
+    await sched.start()
+    assert sched.job_worker is not None
+    seed = await start_daemon(tmp_path, "seed", sched.port(), seed=True)
+    await _wait(lambda: any(h.is_seed() for h in sched.service.hosts.all()))
+    return manager, sched, seed
+
+
+def test_preheat_job_end_to_end(run_async, tmp_path):
+    async def run():
+        runner, port, stats = await start_origin()
+        manager, sched, seed = await _cluster(tmp_path)
+        try:
+            url = f"http://127.0.0.1:{port}/blob"
+            cluster_id = sched.announcer.registered["scheduler_cluster_id"]
+            job = manager.service.jobs.enqueue_job(
+                "preheat", {"url": url, "scope": "all_seed_peers",
+                            "timeout": 20.0}, [cluster_id])
+            assert await _wait(lambda: manager.db.get("jobs", job["id"])
+                               ["state"] in ("SUCCESS", "FAILURE"), 30.0)
+            row = manager.db.get("jobs", job["id"])
+            assert row["state"] == "SUCCESS", row
+            results = row["result"]["group_results"]
+            assert results and results[0]["preheat"][0]["triggered"] == 1
+            # Seed actually holds the bytes.
+            task_id = idgen.task_id_v1(url)
+            store = seed.task_manager.storage.try_get(task_id)
+            assert store is not None and store.metadata.done
+            assert stats["blob_streams"] >= 1
+        finally:
+            await seed.stop()
+            await sched.stop()
+            await manager.stop()
+            await runner.cleanup()
+
+    run_async(run())
+
+
+def test_get_and_delete_task_jobs(run_async, tmp_path):
+    async def run():
+        runner, port, stats = await start_origin()
+        manager, sched, seed = await _cluster(tmp_path)
+        try:
+            url = f"http://127.0.0.1:{port}/blob"
+            cluster_id = sched.announcer.registered["scheduler_cluster_id"]
+            task_id = idgen.task_id_v1(url)
+            # Preheat first so the task exists on the seed.
+            manager.service.jobs.enqueue_job(
+                "preheat", {"url": url, "timeout": 20.0}, [cluster_id])
+            assert await _wait(
+                lambda: (s := seed.task_manager.storage.try_get(task_id))
+                is not None and s.metadata.done, 30.0)
+
+            job = manager.service.jobs.enqueue_job(
+                "get_task", {"task_id": task_id}, [cluster_id])
+            assert await _wait(lambda: manager.db.get("jobs", job["id"])
+                               ["state"] == "SUCCESS", 15.0)
+            peers = manager.db.get("jobs", job["id"])["result"][
+                "group_results"][0]["peers"]
+            assert any(p["hostname"] == "seed" for p in peers)
+
+            job = manager.service.jobs.enqueue_job(
+                "delete_task", {"task_id": task_id}, [cluster_id])
+            assert await _wait(lambda: manager.db.get("jobs", job["id"])
+                               ["state"] == "SUCCESS", 15.0)
+            assert seed.task_manager.storage.try_get(task_id) is None
+        finally:
+            await seed.stop()
+            await sched.stop()
+            await manager.stop()
+            await runner.cleanup()
+
+    run_async(run())
+
+
+def test_sync_peers_job_populates_manager_table(run_async, tmp_path):
+    async def run():
+        runner, port, _ = await start_origin()
+        manager, sched, seed = await _cluster(tmp_path)
+        try:
+            cluster_id = sched.announcer.registered["scheduler_cluster_id"]
+            job = manager.service.jobs.enqueue_job("sync_peers", {}, [cluster_id])
+            assert await _wait(lambda: manager.db.get("jobs", job["id"])
+                               ["state"] == "SUCCESS", 15.0)
+            synced = manager.db.get("jobs", job["id"])["result"][
+                "group_results"][0]["synced"]
+            assert synced >= 1
+            assert manager.db.find("peers", hostname="seed") is not None
+        finally:
+            await seed.stop()
+            await sched.stop()
+            await manager.stop()
+            await runner.cleanup()
+
+    run_async(run())
