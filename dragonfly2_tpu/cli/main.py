@@ -135,6 +135,12 @@ def _add_daemon(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--registry-mirror", default="",
                    help="remote registry URL to mirror through the proxy")
     p.add_argument("--alive-time", type=float, default=0.0)
+    p.add_argument("--object-storage-port", type=int, default=-1,
+                   help="enable the S3-like object gateway on this port (0 = ephemeral)")
+    p.add_argument("--object-storage-backend", default="fs",
+                   help="fs | s3 | gcs | oss | obs")
+    p.add_argument("--object-storage-option", action="append", default=[],
+                   help="backend kwarg k=v (repeatable), e.g. root=/data/buckets")
     p.set_defaults(func=_run_daemon)
 
 
@@ -163,6 +169,16 @@ def _run_daemon(args: argparse.Namespace) -> int:
         cfg.proxy.registry_mirror = args.registry_mirror
     if args.alive_time:
         cfg.alive_time = args.alive_time
+    if args.object_storage_port >= 0:
+        cfg.object_storage.enabled = True
+        cfg.object_storage.port = args.object_storage_port
+        cfg.object_storage.backend = args.object_storage_backend
+        opts = dict(kv.split("=", 1) for kv in args.object_storage_option if "=" in kv)
+        if args.object_storage_backend == "fs" and "root" not in opts:
+            import os
+
+            opts["root"] = os.path.join(cfg.work_home or ".", "buckets")
+        cfg.object_storage.backend_options = opts
 
     async def run() -> int:
         daemon = Daemon(cfg)

@@ -1,0 +1,89 @@
+"""dfcache: import/export/stat local cache entries as P2P tasks.
+
+Reference: client/dfcache/dfcache.go — Stat (:46), Import (:112), Export
+(:174), Delete (:229) over the daemon's unix drpc. A cache entry is a
+``dfcache://{cache_id}`` task: import makes this host a parent for the
+entry; export on another host pulls it over P2P only (never origin).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from dragonfly2_tpu.pkg import idgen
+from dragonfly2_tpu.pkg.errors import Code, DfError
+from dragonfly2_tpu.pkg.types import NetAddr
+from dragonfly2_tpu.rpc import Client
+
+
+@dataclass
+class DfcacheConfig:
+    daemon_sock: str
+    cache_id: str
+    tag: str = ""
+    application: str = ""
+    timeout: float = 60.0
+
+
+def task_id_of(cfg: DfcacheConfig) -> str:
+    return idgen.task_id_v1(f"dfcache://{cfg.cache_id}",
+                            tag=cfg.tag, application=cfg.application)
+
+
+def _body(cfg: DfcacheConfig) -> dict:
+    return {"cache_id": cfg.cache_id, "tag": cfg.tag,
+            "application": cfg.application}
+
+
+async def import_file(cfg: DfcacheConfig, path: str) -> dict:
+    """Import a local file as this host's copy of the cache entry."""
+    cli = Client(NetAddr.unix(cfg.daemon_sock))
+    try:
+        return await cli.call("Daemon.ImportTask",
+                              {**_body(cfg), "path": path},
+                              timeout=cfg.timeout)
+    finally:
+        await cli.close()
+
+
+async def export_file(cfg: DfcacheConfig, output: str) -> dict:
+    """Land the cache entry at ``output``, pulling over P2P if not local."""
+    cli = Client(NetAddr.unix(cfg.daemon_sock))
+    try:
+        stream = await cli.open_stream("Daemon.ExportTask",
+                                       {**_body(cfg), "output": output})
+        final: dict = {}
+        while True:
+            msg = await stream.recv(timeout=cfg.timeout)
+            if msg is None:
+                break
+            final = msg
+            if msg.get("state") in ("done", "failed"):
+                break
+        await stream.close()
+        if final.get("state") != "done":
+            err = final.get("error") or {}
+            raise DfError(Code(err.get("code", Code.UnknownError)),
+                          err.get("message", "export failed"))
+        return final
+    finally:
+        await cli.close()
+
+
+async def stat(cfg: DfcacheConfig) -> dict:
+    """Local presence check (reference dfcache.go:46 Stat)."""
+    cli = Client(NetAddr.unix(cfg.daemon_sock))
+    try:
+        return await cli.call("Daemon.StatTask", {"task_id": task_id_of(cfg)},
+                              timeout=cfg.timeout)
+    finally:
+        await cli.close()
+
+
+async def delete(cfg: DfcacheConfig) -> dict:
+    cli = Client(NetAddr.unix(cfg.daemon_sock))
+    try:
+        return await cli.call("Daemon.DeleteTask", {"task_id": task_id_of(cfg)},
+                              timeout=cfg.timeout)
+    finally:
+        await cli.close()

@@ -1,0 +1,147 @@
+"""dfstore: HTTP SDK against the daemon's object-storage gateway.
+
+Reference: client/dfstore/dfstore.go — Dfstore iface (:54-112) with
+Get/Put/Copy/Delete object, bucket ops and exist checks (:157-788) over the
+daemon's S3-like HTTP endpoint.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import AsyncIterator
+from urllib.parse import quote
+
+import aiohttp
+
+
+class DfstoreError(Exception):
+    def __init__(self, message: str, status: int = 0):
+        super().__init__(message)
+        self.status = status
+
+
+@dataclass
+class ObjectInfo:
+    key: str
+    content_length: int = -1
+    content_type: str = ""
+    etag: str = ""
+    digest: str = ""
+
+
+class Dfstore:
+    """Async client; endpoint is the daemon gateway, e.g.
+    ``http://127.0.0.1:65004``."""
+
+    def __init__(self, endpoint: str, *, timeout: float = 60.0):
+        self.endpoint = endpoint.rstrip("/")
+        self.timeout = aiohttp.ClientTimeout(total=timeout)
+        self._session: aiohttp.ClientSession | None = None
+
+    def _http(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(timeout=self.timeout)
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    def _object_url(self, bucket: str, key: str) -> str:
+        return f"{self.endpoint}/buckets/{quote(bucket, safe='')}/objects/{quote(key)}"
+
+    # -- buckets -----------------------------------------------------------
+
+    async def create_bucket(self, bucket: str) -> None:
+        async with self._http().put(f"{self.endpoint}/buckets/{quote(bucket, safe='')}") as r:
+            if r.status not in (200, 201):
+                raise DfstoreError(await r.text(), r.status)
+
+    async def delete_bucket(self, bucket: str) -> None:
+        async with self._http().delete(f"{self.endpoint}/buckets/{quote(bucket, safe='')}") as r:
+            if r.status != 200:
+                raise DfstoreError(await r.text(), r.status)
+
+    async def list_buckets(self) -> list[str]:
+        async with self._http().get(f"{self.endpoint}/buckets") as r:
+            if r.status != 200:
+                raise DfstoreError(await r.text(), r.status)
+            return [b["name"] for b in await r.json()]
+
+    # -- objects -----------------------------------------------------------
+
+    async def put_object(self, bucket: str, key: str, data: bytes,
+                         *, mode: str = "async_write_back") -> str:
+        """Returns the stored sha256 digest string."""
+        url = self._object_url(bucket, key) + f"?mode={mode}"
+        async with self._http().put(url, data=data) as r:
+            if r.status != 200:
+                raise DfstoreError(await r.text(), r.status)
+            return (await r.json()).get("digest", "")
+
+    async def get_object(self, bucket: str, key: str,
+                         range_header: str = "") -> bytes:
+        headers = {"Range": range_header} if range_header else {}
+        async with self._http().get(self._object_url(bucket, key),
+                                    headers=headers) as r:
+            if r.status not in (200, 206):
+                raise DfstoreError(await r.text(), r.status)
+            return await r.read()
+
+    async def stream_object(self, bucket: str, key: str) -> AsyncIterator[bytes]:
+        """Streaming GET (webdataset tar shards — BASELINE config #4)."""
+        r = await self._http().get(self._object_url(bucket, key))
+        if r.status not in (200, 206):
+            text = await r.text()
+            r.release()
+            raise DfstoreError(text, r.status)
+
+        async def chunks() -> AsyncIterator[bytes]:
+            try:
+                async for chunk in r.content.iter_chunked(1 << 20):
+                    yield chunk
+            finally:
+                r.release()
+
+        return chunks()
+
+    async def stat_object(self, bucket: str, key: str) -> ObjectInfo:
+        async with self._http().head(self._object_url(bucket, key)) as r:
+            if r.status != 200:
+                raise DfstoreError(f"object {bucket}/{key}: HTTP {r.status}", r.status)
+            return ObjectInfo(
+                key=key,
+                content_length=int(r.headers.get("Content-Length", -1)),
+                content_type=r.headers.get("Content-Type", ""),
+                etag=r.headers.get("ETag", ""),
+                digest=r.headers.get("X-Dragonfly-Digest", ""))
+
+    async def is_object_exist(self, bucket: str, key: str) -> bool:
+        try:
+            await self.stat_object(bucket, key)
+            return True
+        except DfstoreError:
+            return False
+
+    async def delete_object(self, bucket: str, key: str) -> None:
+        async with self._http().delete(self._object_url(bucket, key)) as r:
+            if r.status != 200:
+                raise DfstoreError(await r.text(), r.status)
+
+    async def copy_object(self, bucket: str, src_key: str, dst_key: str) -> None:
+        """GET+PUT copy (reference dfstore CopyObject)."""
+        data = await self.get_object(bucket, src_key)
+        await self.put_object(bucket, dst_key, data)
+
+    async def list_objects(self, bucket: str, prefix: str = "",
+                           limit: int = 1000) -> list[ObjectInfo]:
+        url = (f"{self.endpoint}/buckets/{quote(bucket, safe='')}/metadatas"
+               f"?prefix={quote(prefix, safe='')}&limit={limit}")
+        async with self._http().get(url) as r:
+            if r.status != 200:
+                raise DfstoreError(await r.text(), r.status)
+            metas = (await r.json())["metadatas"]
+            return [ObjectInfo(key=m["key"], content_length=m["content_length"],
+                               content_type=m.get("content_type", ""),
+                               etag=m.get("etag", ""), digest=m.get("digest", ""))
+                    for m in metas]

@@ -88,6 +88,10 @@ class ObjectStorageOption:
     enabled: bool = False
     port: int = 0
     max_replicas: int = 3
+    backend: str = "fs"             # fs | s3 | gcs | oss | obs
+    # Backend constructor kwargs: fs {root}, s3/oss/obs {endpoint,
+    # access_key, secret_key, region}, gcs {endpoint, project}.
+    backend_options: dict = field(default_factory=dict)
 
 
 @dataclass

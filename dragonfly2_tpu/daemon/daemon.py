@@ -71,6 +71,7 @@ class Daemon:
             scheduler_client=self.scheduler_client,
             conductor_factory=self._make_conductor if self.scheduler_client else None,
             total_rate_limit=rate,
+            host_wire=self._host_wire,
         )
         self.rpc = DaemonRpcServer(self.task_manager)
         self.proxy = None
@@ -84,6 +85,18 @@ class Daemon:
                 registry_mirror=config.proxy.registry_mirror,
                 max_concurrency=config.proxy.max_concurrency,
                 white_list_ports=config.proxy.white_list_ports)
+        self.object_storage = None
+        if config.object_storage.enabled:
+            from dragonfly2_tpu.daemon.objectstorage import ObjectStorageService
+            from dragonfly2_tpu.daemon.transport import P2PTransport
+            from dragonfly2_tpu.pkg.objectstorage import new_client
+
+            backend = new_client(config.object_storage.backend,
+                                 **config.object_storage.backend_options)
+            self.object_storage = ObjectStorageService(
+                backend, P2PTransport(self.task_manager),
+                get_seed_peers=self._known_seed_peers,
+                trigger_seed=self._trigger_seed_peer)
         self.announcer: Announcer | None = None
         self.dynconfig = None  # manager-source scheduler resolution
         self._started = False
@@ -92,10 +105,41 @@ class Daemon:
         self.gc.add(GCTask("storage", config.gc_interval, 30.0, self._gc_storage))
         self._stopped = asyncio.Event()
 
+    def _host_wire(self) -> dict:
+        """Canonical host identity, {} before the announcer exists."""
+        if self.announcer is None:
+            return {}
+        return self.announcer.host_wire()
+
+    # -- object-storage replication hooks ----------------------------------
+
+    def _known_seed_peers(self) -> list[dict]:
+        """Seed peers from dynconfig (manager mode); empty otherwise —
+        replication then degrades to backend-only writes."""
+        if self.dynconfig is not None and hasattr(self.dynconfig, "cached_seed_peers"):
+            return self.dynconfig.cached_seed_peers()
+        return []
+
+    async def _trigger_seed_peer(self, seed: dict, spec: dict) -> bool:
+        """Fire Peer.TriggerDownloadTask at a seed daemon (same RPC the
+        scheduler uses — seed_client.py)."""
+        from dragonfly2_tpu.rpc import Client
+
+        addr = NetAddr.tcp(seed.get("ip", ""), int(seed.get("port", 0)))
+        cli = Client(addr)
+        try:
+            resp = await cli.call("Peer.TriggerDownloadTask", spec, timeout=10.0)
+            return bool(resp and resp.get("ok"))
+        except Exception:
+            return False
+        finally:
+            await cli.close()
+
     # -- conductor factory (P2P path) --------------------------------------
 
     def _make_conductor(self, *, task_id: str, peer_id: str, request, store,
                         on_piece, is_seed: bool = False) -> PeerTaskConductor:
+        disable_back_source = getattr(request, "disable_back_source", False)
         if self.announcer is None:
             raise RuntimeError("conductor requires a started daemon (announcer missing)")
         # Single source of truth for the host record: the announcer's wire
@@ -123,6 +167,7 @@ class Daemon:
             piece_parallelism=self.config.download.parent_concurrency,
             limiter=self.task_manager.limiter,
             on_piece=on_piece,
+            disable_back_source=disable_back_source,
         )
 
     async def _resolve_schedulers_from_manager(self) -> None:
@@ -192,6 +237,9 @@ class Daemon:
         await self.upload.serve(self.config.host.ip, self.config.upload.port)
         if self.proxy is not None:
             await self.proxy.serve(self.config.host.ip, self.config.proxy.port)
+        if self.object_storage is not None:
+            await self.object_storage.serve(self.config.host.ip,
+                                            self.config.object_storage.port)
         peer_port = self.rpc.peer_server.port() if self.rpc.peer_server._servers else 0
         self._peer_port = peer_port
         self._started = True
@@ -231,6 +279,8 @@ class Daemon:
             await self.scheduler_client.close()
         if self.proxy is not None:
             await self.proxy.close()
+        if self.object_storage is not None:
+            await self.object_storage.close()
         await self.upload.close()
         await self.rpc.close()
         self.storage.close()

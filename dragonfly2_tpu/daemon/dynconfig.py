@@ -48,7 +48,24 @@ class DaemonDynconfig:
             idc=self.host_info.get("idc", ""),
             location=self.host_info.get("location", ""),
             pod=self.host_info.get("pod", ""))
-        return {"schedulers": schedulers}
+        # Seed peers of our cluster ride along for object-storage
+        # replication (reference client/config/dynconfig_manager.go:84-278
+        # resolves seed peers + object-storage config in the same pull).
+        seed_peers: list[dict[str, Any]] = []
+        cluster_ids = {s.get("scheduler_cluster_id") for s in schedulers
+                       if s.get("scheduler_cluster_id")}
+        for cid in sorted(cluster_ids):
+            try:
+                seed_peers.extend(await self.client.list_seed_peers(cid))
+            except Exception:
+                pass
+        return {"schedulers": schedulers, "seed_peers": seed_peers}
+
+    def cached_seed_peers(self) -> list[dict[str, Any]]:
+        """Last-fetched seed peers, non-blocking (replication fan-out)."""
+        if self.dc is None:
+            return []
+        return list(self.dc.cached().get("seed_peers") or [])
 
     async def scheduler_addrs(self) -> list[str]:
         if self.dc is None:

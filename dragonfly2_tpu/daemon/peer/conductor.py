@@ -56,6 +56,7 @@ class PeerTaskConductor:
         piece_parallelism: int = 4,
         limiter: Limiter | None = None,
         on_piece=None,
+        disable_back_source: bool = False,
     ):
         self.task_id = task_id
         self.peer_id = peer_id
@@ -69,6 +70,7 @@ class PeerTaskConductor:
         self.piece_parallelism = piece_parallelism
         self.limiter = limiter or Limiter()
         self.on_piece = on_piece
+        self.disable_back_source = disable_back_source
 
         self.dispatcher = PieceDispatcher()
         self.downloader = PieceDownloader()
@@ -97,6 +99,7 @@ class PeerTaskConductor:
             "header": self.meta.get("header") or {},
             "priority": self.meta.get("priority", 3),
             "is_seed": self.is_seed,
+            "disable_back_source": self.disable_back_source,
         }
         self._stream = await self.scheduler_client.open_announce_stream(open_body)
         try:
@@ -153,6 +156,13 @@ class PeerTaskConductor:
                 "total_piece_count": m.total_piece_count,
             })
             return
+
+        if self.disable_back_source:
+            # dfget --disable-back-source / dfcache export: origin is off
+            # the table, fail instead (reference peertask_conductor
+            # needBackSource vs disableBackSource handling).
+            raise DfError(Code.ClientBackSourceError,
+                          "scheduler demanded back-to-source but it is disabled")
 
         BACK_SOURCE_COUNT.inc()
         log.info("back-to-source", task=self.task_id[:16], seed=self.is_seed)

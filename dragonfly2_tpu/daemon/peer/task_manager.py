@@ -14,7 +14,7 @@ from typing import AsyncIterator
 
 from dragonfly2_tpu.daemon.peer.broker import PieceBroker, PieceEvent
 from dragonfly2_tpu.daemon.peer.piece_manager import PieceManager
-from dragonfly2_tpu.pkg import dflog, idgen
+from dragonfly2_tpu.pkg import aio, dflog, idgen
 from dragonfly2_tpu.pkg.errors import Code, DfError
 from dragonfly2_tpu.pkg.piece import Range
 from dragonfly2_tpu.pkg.ratelimit import Limiter
@@ -116,12 +116,16 @@ class TaskManager:
         scheduler_client=None,
         conductor_factory=None,
         total_rate_limit: int = 0,
+        host_wire=None,
     ):
         self.storage = storage
         self.piece_manager = piece_manager
         self.host_ip = host_ip
         self.scheduler_client = scheduler_client
         self.conductor_factory = conductor_factory
+        # () -> AnnounceHost-shaped dict (or {} before the daemon starts);
+        # used to advertise imported tasks under the daemon's one identity.
+        self.host_wire = host_wire
         self.limiter = Limiter(total_rate_limit if total_rate_limit > 0 else float("inf"))
         self.broker = PieceBroker()
         self._running: dict[str, _RunningTask] = {}
@@ -160,6 +164,59 @@ class TaskManager:
             limiter=self.limiter,
         )
         return False
+
+    # -- import / export (dfcache — reference client/dfcache + ImportFile) --
+
+    async def import_task(self, path: str, req: "FileTaskRequest") -> dict:
+        """Import a local file as a completed P2P task (reference
+        piece_manager.go:662 ImportFile + dfcache Import)."""
+        task_id = req.task_id()
+        peer_id = req.peer_id or idgen.peer_id_v1(self.host_ip)
+        existing = self.storage.find_completed_task(task_id)
+        if existing is None:
+            store = self.storage.register_task(TaskStoreMetadata(
+                task_id=task_id, peer_id=peer_id, url=req.url,
+                tag=req.meta.tag, application=req.meta.application))
+            with store:
+                try:
+                    await self.piece_manager.import_file(store, path)
+                    if req.meta.digest:
+                        store.validate_digest(req.meta.digest)
+                        store.metadata.digest = req.meta.digest
+                    store.mark_done()
+                except BaseException:
+                    # A half-imported store must not be resumed by a retry:
+                    # stale piece records would outlive a changed source file
+                    # (start_file_task applies the same rule).
+                    store.mark_invalid()
+                    raise
+        else:
+            store = existing
+        await self._announce_local_task(store, task_id, peer_id)
+        return {"task_id": task_id, "peer_id": peer_id,
+                "pieces": len(store.metadata.pieces),
+                "content_length": store.metadata.content_length}
+
+    async def _announce_local_task(self, store, task_id: str, peer_id: str) -> None:
+        """Tell the scheduler this host holds the complete task so it can be
+        scheduled as a parent (Scheduler.AnnounceTask)."""
+        if self.scheduler_client is None or self.host_wire is None:
+            return
+        try:
+            host_info = self.host_wire()
+            if not host_info:
+                return
+            host_info.pop("telemetry", None)
+            m = store.metadata
+            await self.scheduler_client.announce_task({
+                "task_id": task_id, "peer_id": peer_id, "url": m.url,
+                "tag": m.tag, "application": m.application, "host": host_info,
+                "content_length": m.content_length, "piece_size": m.piece_size,
+                "total_piece_count": m.total_piece_count,
+                "piece_nums": sorted(m.pieces.keys()),
+            })
+        except Exception as e:
+            log.warning("announce_task failed", task_id=task_id[:16], error=str(e))
 
     # -- file task (reference peertask_manager.go:328) ---------------------
 
@@ -368,7 +425,7 @@ class TaskManager:
             run = _RunningTask(store)
             self._running[task_id] = run
             store.pin()
-            asyncio.ensure_future(
+            aio.spawn(
                 self._run_background_download(task_id, peer_id, file_req, store, run))
         else:
             store = run.store

@@ -11,7 +11,7 @@ from __future__ import annotations
 import asyncio
 
 from dragonfly2_tpu.daemon.peer.task_manager import FileTaskRequest, TaskManager
-from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg import aio, dflog
 from dragonfly2_tpu.pkg.errors import Code, DfError
 from dragonfly2_tpu.pkg.piece import Range
 from dragonfly2_tpu.pkg.types import NetAddr
@@ -31,6 +31,8 @@ class DaemonRpcServer:
     def _register(self) -> None:
         self.download_server.register_stream("Daemon.Download", self._download)
         self.download_server.register_unary("Daemon.StatTask", self._stat_task)
+        self.download_server.register_unary("Daemon.ImportTask", self._import_task)
+        self.download_server.register_stream("Daemon.ExportTask", self._export_task)
         self.download_server.register_unary("Daemon.DeleteTask", self._delete_task)
         self.download_server.register_unary("Daemon.Health", self._health)
         # Peer-facing service (reference rpcserver.go peer server): piece
@@ -88,6 +90,45 @@ class DaemonRpcServer:
             "total_piece_count": m.total_piece_count,
             "digest": m.digest,
         }
+
+    async def _import_task(self, body, ctx: RpcContext):
+        """dfcache Import: local file → completed P2P task + scheduler
+        announce (reference dfcache.go:112 Import, AnnounceTask)."""
+        body = body or {}
+        path = body.get("path", "")
+        if not path:
+            raise DfError(Code.BadRequest, "path required")
+        req = self._cache_request(body)
+        return await self.task_manager.import_task(path, req)
+
+    async def _export_task(self, stream: ServerStream, ctx: RpcContext) -> None:
+        """dfcache Export: land a cached task at an output path, pulling
+        over P2P (never origin) when not local — reference dfcache.go:174."""
+        body = stream.open_body or {}
+        output = body.get("output", "")
+        if not output:
+            raise DfError(Code.BadRequest, "output required")
+        req = self._cache_request(body)
+        req.output = output
+        req.disable_back_source = True
+        async for progress in self.task_manager.start_file_task(req):
+            await stream.send(progress.to_wire())
+
+    @staticmethod
+    def _cache_request(body: dict) -> "FileTaskRequest":
+        """Cache-entry task identity: dfcache:// URL from the cache id, so
+        import/export agree on the task id across hosts (reference dfcache
+        computes the task id from the content id)."""
+        from dragonfly2_tpu.daemon.peer.task_manager import FileTaskRequest
+        from dragonfly2_tpu.proto.common import UrlMeta
+
+        cache_id = body.get("cache_id", "")
+        if not cache_id:
+            raise DfError(Code.BadRequest, "cache_id required")
+        meta = UrlMeta(tag=body.get("tag", ""),
+                       application=body.get("application", ""),
+                       digest=body.get("digest", ""))
+        return FileTaskRequest(url=f"dfcache://{cache_id}", output="", meta=meta)
 
     async def _delete_task(self, body, ctx: RpcContext):
         """Refuses while the task is running or its store is pinned by an
@@ -176,5 +217,5 @@ class DaemonRpcServer:
         if not (task_id and self.task_manager.is_task_running(task_id)):
             # Runs even when complete: the announce-only fast path re-reports
             # local pieces so the scheduler can hand this seed out as parent.
-            asyncio.ensure_future(self.task_manager.start_seed_task(spec))
+            aio.spawn(self.task_manager.start_seed_task(spec))
         return {"ok": True, "already_complete": already}

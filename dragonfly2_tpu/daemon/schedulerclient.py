@@ -57,6 +57,12 @@ class SchedulerClient:
             except DfError as e:
                 log.warning("announce host failed", addr=addr, error=e.message)
 
+    async def announce_task(self, body: dict) -> None:
+        """Advertise a locally-complete task (dfcache import) — reference
+        AnnounceTask, service_v1.go:331."""
+        await self._client_for(body.get("task_id", "")).call(
+            "Scheduler.AnnounceTask", body, timeout=10.0)
+
     async def leave_host(self, host_id: str) -> None:
         for addr in self._ring.members():
             try:

@@ -42,6 +42,18 @@ class Dynconfig:
         (reference dynconfig.go Register/Notify)."""
         self._observers.append(observer)
 
+    def cached(self) -> dict[str, Any]:
+        """Non-blocking view of the last-fetched data ({} before the first
+        refresh). Falls back to the on-disk cache file so consumers see
+        data immediately after a restart."""
+        if self._data is None and self._cache_file and os.path.exists(self._cache_file):
+            try:
+                with open(self._cache_file) as f:
+                    self._data = json.load(f)
+            except (OSError, ValueError):
+                pass
+        return self._data or {}
+
     async def get(self) -> dict[str, Any]:
         if self._data is None:
             await self.refresh()

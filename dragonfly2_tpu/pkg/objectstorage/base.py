@@ -1,0 +1,92 @@
+"""ObjectStorage interface + metadata types.
+
+Reference: pkg/objectstorage/objectstorage.go:40-132 — bucket CRUD, object
+get/put/delete/exists, metadata listing, signed URLs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import AsyncIterator
+
+
+class ObjectStorageError(Exception):
+    pass
+
+
+@dataclass
+class BucketMetadata:
+    name: str
+    created_at: float = 0.0
+
+
+@dataclass
+class ObjectMetadata:
+    key: str
+    content_length: int = -1
+    content_type: str = ""
+    etag: str = ""
+    digest: str = ""          # "algo:encoded" (stored as user metadata)
+    last_modified: float = 0.0
+    user_metadata: dict = field(default_factory=dict)
+
+
+class ObjectStorage:
+    """Async backend client. All methods raise ObjectStorageError on backend
+    failure; exists-style methods return False instead of raising."""
+
+    name = "base"
+
+    async def get_bucket_metadata(self, bucket: str) -> BucketMetadata:
+        raise NotImplementedError
+
+    async def create_bucket(self, bucket: str) -> None:
+        raise NotImplementedError
+
+    async def delete_bucket(self, bucket: str) -> None:
+        raise NotImplementedError
+
+    async def list_buckets(self) -> list[BucketMetadata]:
+        raise NotImplementedError
+
+    async def is_bucket_exist(self, bucket: str) -> bool:
+        try:
+            await self.get_bucket_metadata(bucket)
+            return True
+        except ObjectStorageError:
+            return False
+
+    async def get_object_metadata(self, bucket: str, key: str) -> ObjectMetadata:
+        raise NotImplementedError
+
+    async def get_object(self, bucket: str, key: str,
+                         range_start: int = -1, range_end: int = -1) -> AsyncIterator[bytes]:
+        raise NotImplementedError
+
+    async def put_object(self, bucket: str, key: str, data,
+                         *, digest: str = "", content_type: str = "") -> None:
+        """``data`` is bytes or a seekable binary file object (large bodies
+        stream through files; the daemon gateway spools uploads)."""
+        raise NotImplementedError
+
+    async def delete_object(self, bucket: str, key: str) -> None:
+        raise NotImplementedError
+
+    async def is_object_exist(self, bucket: str, key: str) -> bool:
+        try:
+            await self.get_object_metadata(bucket, key)
+            return True
+        except ObjectStorageError:
+            return False
+
+    async def list_object_metadatas(self, bucket: str, prefix: str = "",
+                                    marker: str = "", limit: int = 1000) -> list[ObjectMetadata]:
+        raise NotImplementedError
+
+    def object_url(self, bucket: str, key: str) -> str:
+        """Origin URL for P2P back-to-source of this object (the daemon
+        gateway hands this to the stream-task machinery)."""
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        pass

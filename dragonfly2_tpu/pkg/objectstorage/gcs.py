@@ -1,0 +1,205 @@
+"""GCS object-storage backend (JSON API over aiohttp).
+
+The reference has NO GCS backend (pkg/objectstorage ships only s3/oss/obs —
+SURVEY.md §2.4); GCS is the TPU target's primary store. Auth mirrors
+source/clients/gcs.py: GCE metadata-server token on GCP, DF_GCS_ANONYMOUS /
+DF_GCS_ENDPOINT for tests and public data. object_url returns a gs:// URL
+so P2P back-to-source rides the registered gs source client and task IDs
+dedupe across peers regardless of which daemon's gateway took the request.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import AsyncIterator
+from urllib.parse import quote
+
+import aiohttp
+
+from dragonfly2_tpu.pkg.objectstorage.base import (
+    BucketMetadata,
+    ObjectMetadata,
+    ObjectStorage,
+    ObjectStorageError,
+)
+from dragonfly2_tpu.source.clients.gcs import METADATA_TOKEN_URL
+
+
+def _iso_to_epoch(value: str) -> float:
+    try:
+        return time.mktime(time.strptime(value[:19], "%Y-%m-%dT%H:%M:%S"))
+    except (ValueError, TypeError):
+        return 0.0
+
+
+class GCSObjectStorage(ObjectStorage):
+    name = "gcs"
+
+    def __init__(self, *, endpoint: str = "https://storage.googleapis.com",
+                 project: str = ""):
+        self.endpoint = os.environ.get("DF_GCS_ENDPOINT", endpoint).rstrip("/")
+        self.project = project
+        self._session: aiohttp.ClientSession | None = None
+        self._token: str | None = None
+        self._token_expiry = 0.0
+
+    def _http(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def _auth(self) -> dict[str, str]:
+        # A custom endpoint (fake-gcs in CI, proxy) implies anonymous, the
+        # same signal the source client honors (source/clients/gcs.py:51) —
+        # off-GCP there is no metadata server to ask.
+        if os.environ.get("DF_GCS_ANONYMOUS") or os.environ.get("DF_GCS_ENDPOINT"):
+            return {}
+        now = time.monotonic()
+        if self._token is None or now >= self._token_expiry:
+            try:
+                async with self._http().get(
+                    METADATA_TOKEN_URL, headers={"Metadata-Flavor": "Google"},
+                    timeout=aiohttp.ClientTimeout(total=5),
+                ) as resp:
+                    if resp.status != 200:
+                        raise ObjectStorageError("gcs: metadata token fetch failed")
+                    tok = json.loads(await resp.text())
+                    self._token = tok["access_token"]
+                    self._token_expiry = now + max(60, tok.get("expires_in", 300) - 60)
+            except aiohttp.ClientError as e:
+                raise ObjectStorageError(f"gcs: no credentials: {e}")
+        return {"Authorization": f"Bearer {self._token}"}
+
+    async def _request(self, method: str, url: str, *, data=b"",
+                       headers: dict | None = None,
+                       ok=(200, 204)) -> aiohttp.ClientResponse:
+        hdrs = await self._auth()
+        hdrs.update(headers or {})
+        if not isinstance(data, (bytes, bytearray)):
+            body = data
+
+            async def gen(f=body):
+                while True:
+                    chunk = f.read(1 << 20)
+                    if not chunk:
+                        return
+                    yield chunk
+
+            data = gen()
+        try:
+            resp = await self._http().request(method, url, data=data or None,
+                                              headers=hdrs)
+        except aiohttp.ClientError as e:
+            raise ObjectStorageError(f"gcs {method} {url}: {e}")
+        if resp.status not in ok:
+            body = (await resp.text())[:300]
+            resp.release()
+            raise ObjectStorageError(f"gcs {method} {url}: HTTP {resp.status} {body}")
+        return resp
+
+    # -- buckets -----------------------------------------------------------
+
+    def _bucket_url(self, bucket: str) -> str:
+        return f"{self.endpoint}/storage/v1/b/{quote(bucket, safe='')}"
+
+    async def get_bucket_metadata(self, bucket: str) -> BucketMetadata:
+        resp = await self._request("GET", self._bucket_url(bucket))
+        meta = json.loads(await resp.text())
+        return BucketMetadata(name=bucket,
+                              created_at=_iso_to_epoch(meta.get("timeCreated", "")))
+
+    async def create_bucket(self, bucket: str) -> None:
+        url = f"{self.endpoint}/storage/v1/b"
+        if self.project:
+            url += f"?project={quote(self.project, safe='')}"
+        (await self._request(
+            "POST", url, data=json.dumps({"name": bucket}).encode(),
+            headers={"Content-Type": "application/json"})).release()
+
+    async def delete_bucket(self, bucket: str) -> None:
+        (await self._request("DELETE", self._bucket_url(bucket))).release()
+
+    async def list_buckets(self) -> list[BucketMetadata]:
+        url = f"{self.endpoint}/storage/v1/b"
+        if self.project:
+            url += f"?project={quote(self.project, safe='')}"
+        resp = await self._request("GET", url)
+        data = json.loads(await resp.text())
+        return [BucketMetadata(name=b["name"],
+                               created_at=_iso_to_epoch(b.get("timeCreated", "")))
+                for b in data.get("items", [])]
+
+    # -- objects -----------------------------------------------------------
+
+    def _object_base(self, bucket: str, key: str) -> str:
+        return f"{self._bucket_url(bucket)}/o/{quote(key, safe='')}"
+
+    async def get_object_metadata(self, bucket: str, key: str) -> ObjectMetadata:
+        resp = await self._request("GET", self._object_base(bucket, key))
+        meta = json.loads(await resp.text())
+        return ObjectMetadata(
+            key=key,
+            content_length=int(meta.get("size", -1)),
+            content_type=meta.get("contentType", ""),
+            etag=meta.get("etag", ""),
+            digest=(meta.get("metadata") or {}).get("digest", ""),
+            last_modified=_iso_to_epoch(meta.get("updated", "")),
+            user_metadata=meta.get("metadata") or {})
+
+    async def get_object(self, bucket: str, key: str,
+                         range_start: int = -1, range_end: int = -1) -> AsyncIterator[bytes]:
+        headers = {}
+        if range_start >= 0:
+            end = str(range_end) if range_end >= 0 else ""
+            headers["Range"] = f"bytes={range_start}-{end}"
+        resp = await self._request("GET", self._object_base(bucket, key) + "?alt=media",
+                                   headers=headers, ok=(200, 206))
+
+        async def chunks() -> AsyncIterator[bytes]:
+            try:
+                async for chunk in resp.content.iter_chunked(1 << 20):
+                    yield chunk
+            finally:
+                resp.release()
+
+        return chunks()
+
+    async def put_object(self, bucket: str, key: str, data,
+                         *, digest: str = "", content_type: str = "") -> None:
+        url = (f"{self.endpoint}/upload/storage/v1/b/{quote(bucket, safe='')}/o"
+               f"?uploadType=media&name={quote(key, safe='')}")
+        headers = {"Content-Type": content_type or "application/octet-stream"}
+        (await self._request("POST", url, data=data, headers=headers)).release()
+        if digest:
+            patch = json.dumps({"metadata": {"digest": digest}}).encode()
+            (await self._request("PATCH", self._object_base(bucket, key),
+                                 data=patch,
+                                 headers={"Content-Type": "application/json"})).release()
+
+    async def delete_object(self, bucket: str, key: str) -> None:
+        (await self._request("DELETE", self._object_base(bucket, key))).release()
+
+    async def list_object_metadatas(self, bucket: str, prefix: str = "",
+                                    marker: str = "", limit: int = 1000) -> list[ObjectMetadata]:
+        url = f"{self._bucket_url(bucket)}/o?maxResults={limit}"
+        if prefix:
+            url += f"&prefix={quote(prefix, safe='')}"
+        if marker:
+            url += f"&startOffset={quote(marker, safe='')}"
+        resp = await self._request("GET", url)
+        data = json.loads(await resp.text())
+        return [ObjectMetadata(
+            key=o["name"], content_length=int(o.get("size", -1)),
+            content_type=o.get("contentType", ""), etag=o.get("etag", ""),
+            digest=(o.get("metadata") or {}).get("digest", ""),
+            last_modified=_iso_to_epoch(o.get("updated", "")))
+            for o in data.get("items", [])]
+
+    def object_url(self, bucket: str, key: str) -> str:
+        return f"gs://{bucket}/{key}"
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()

@@ -41,6 +41,9 @@ class SchedulingConfig:
     retry_back_to_source_limit: int = RETRY_BACK_TO_SOURCE_LIMIT
     retry_interval: float = RETRY_INTERVAL
     back_to_source_count: int = TASK_BACK_TO_SOURCE_PEER_COUNT
+    # How long to hold a peer that refuses back-to-source (dfcache export)
+    # in the schedule loop waiting for a parent to appear.
+    no_source_patience: float = 30.0
     # Evaluator weights (reference evaluator_base.go:28-46); topology terms
     # replace IDC/location weighting when TPU topology metadata is present.
     weight_finished_pieces: float = 0.2

@@ -58,13 +58,17 @@ PIECE_COST_WINDOW = 64
 
 class Peer:
     def __init__(self, peer_id: str, task: Task, host: Host, *,
-                 is_seed: bool = False, priority: int = 3, range_header: str = ""):
+                 is_seed: bool = False, priority: int = 3, range_header: str = "",
+                 disable_back_source: bool = False):
         self.id = peer_id
         self.task = task
         self.host = host
         self.is_seed = is_seed
         self.priority = priority
         self.range_header = range_header
+        # Peer refuses origin fetches (dfcache export, --disable-back-source;
+        # reference v2 RegisterPeerRequest Download.disableBackToSource).
+        self.disable_back_source = disable_back_source
         self.fsm = FSM(PeerState.PENDING, _PEER_EVENTS)
         self.finished_pieces: set[int] = set()
         self.piece_costs: deque[int] = deque(maxlen=PIECE_COST_WINDOW)

@@ -39,6 +39,7 @@ class SchedulerServer:
         self.rpc.register_unary("Scheduler.AnnounceHost", s.announce_host)
         self.rpc.register_unary("Scheduler.LeaveHost", s.leave_host)
         self.rpc.register_unary("Scheduler.LeavePeer", s.leave_peer)
+        self.rpc.register_unary("Scheduler.AnnounceTask", s.announce_task)
         self.rpc.register_unary("Scheduler.StatTask", s.stat_task)
         self.rpc.register_unary("Scheduler.StatPeer", s.stat_peer)
         self.rpc.register_unary("Scheduler.ListHosts", s.list_hosts)

@@ -98,6 +98,7 @@ class SchedulerService:
                 is_seed=bool(open_body.get("is_seed")),
                 priority=open_body.get("priority", 3),
                 range_header=open_body.get("range", ""),
+                disable_back_source=bool(open_body.get("disable_back_source")),
             )
         )
         return host, task, peer
@@ -170,6 +171,13 @@ class SchedulerService:
         if task.fsm.current == TaskState.PENDING or not task.has_available_peer():
             seeding = await self._maybe_trigger_seed(task, peer)
             if not seeding:
+                if peer.disable_back_source:
+                    # The peer refuses origin; hold it in the schedule loop
+                    # waiting for a parent to appear instead of demoting it.
+                    await self._schedule_and_send(
+                        task, peer,
+                        patience=self.config.scheduling.no_source_patience)
+                    return
                 if task.can_back_to_source():
                     self._mark_task_running(task)
                     self._to_back_source(task, peer, "first peer, no seed")
@@ -202,7 +210,7 @@ class SchedulerService:
             hold = (asyncio.get_running_loop().time() < deadline
                     and (active or not seed_seen))
             result = await self.scheduling.schedule_candidate_parents(
-                peer, allow_back_source=not hold)
+                peer, allow_back_source=not hold and not peer.disable_back_source)
             if result.kind != ScheduleResult.FAILED or not hold:
                 break
         stream = peer.announce_stream
@@ -416,6 +424,29 @@ class SchedulerService:
         if peer.fsm.can("leave"):
             peer.fsm.event("leave")
         self.peers.delete(peer_id)
+        return {"ok": True}
+
+    async def announce_task(self, body: dict, ctx: RpcContext) -> dict:
+        """A daemon announces an already-complete local task (dfcache import,
+        persisted stores after restart) so it becomes a parent candidate —
+        reference service_v1.go:331 AnnounceTask."""
+        host, task, peer = self._resolve(body)
+        task.update_lengths(
+            body.get("content_length", task.content_length),
+            body.get("piece_size", task.piece_size),
+            body.get("total_piece_count", task.total_piece_count),
+        )
+        for num in body.get("piece_nums") or []:
+            peer.finished_pieces.add(int(num))
+        for event in ("register_normal", "download", "download_succeeded"):
+            if peer.fsm.can(event):
+                peer.fsm.event(event)
+        if task.fsm.can("download"):
+            task.fsm.event("download")
+        if task.fsm.can("download_succeeded"):
+            task.fsm.event("download_succeeded")
+        log.info("task announced", task=task.id[:16], host=host.id,
+                 pieces=len(peer.finished_pieces))
         return {"ok": True}
 
     async def stat_task(self, body: dict, ctx: RpcContext) -> dict:

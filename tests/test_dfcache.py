@@ -1,0 +1,79 @@
+"""dfcache: import on one daemon, export on another over P2P only.
+
+Reference: client/dfcache/dfcache.go Import/Export/Stat/Delete + scheduler
+AnnounceTask (service_v1.go:331) making the importer a parent candidate.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import pytest
+
+from dragonfly2_tpu.client import dfcache
+from dragonfly2_tpu.pkg.errors import DfError
+
+from tests.test_p2p_e2e import start_daemon, start_scheduler
+
+
+def test_import_export_across_daemons(run_async, tmp_path):
+    async def run():
+        sched = await start_scheduler()
+        d_a = await start_daemon(tmp_path, "peer-a", sched.port())
+        d_b = await start_daemon(tmp_path, "peer-b", sched.port())
+        try:
+            payload = os.urandom(2 * 1024 * 1024)
+            src = tmp_path / "model.bin"
+            src.write_bytes(payload)
+
+            cfg_a = dfcache.DfcacheConfig(
+                daemon_sock=d_a.config.unix_sock, cache_id="ckpt-v1", tag="t")
+            result = await dfcache.import_file(cfg_a, str(src))
+            assert result["content_length"] == len(payload)
+            assert result["pieces"] >= 1
+
+            # Importer stats it locally.
+            stat = await dfcache.stat(cfg_a)
+            assert stat["done"] and stat["content_length"] == len(payload)
+
+            # The scheduler now knows this task (AnnounceTask).
+            task = sched.service.tasks.load(dfcache.task_id_of(cfg_a))
+            assert task is not None and task.state == "succeeded"
+
+            # Export from the OTHER daemon: must come via P2P (no origin
+            # exists for dfcache:// URLs, so P2P is the only route).
+            cfg_b = dfcache.DfcacheConfig(
+                daemon_sock=d_b.config.unix_sock, cache_id="ckpt-v1", tag="t")
+            out = tmp_path / "exported.bin"
+            final = await dfcache.export_file(cfg_b, str(out))
+            assert final["state"] == "done"
+            assert hashlib.sha256(out.read_bytes()).hexdigest() == \
+                hashlib.sha256(payload).hexdigest()
+
+            # Delete on the importer.
+            await dfcache.delete(cfg_a)
+            with pytest.raises(DfError):
+                await dfcache.stat(cfg_a)
+        finally:
+            await d_a.stop()
+            await d_b.stop()
+            await sched.stop()
+
+    run_async(run())
+
+
+def test_export_missing_entry_fails_without_origin(run_async, tmp_path):
+    async def run():
+        sched = await start_scheduler()
+        d = await start_daemon(tmp_path, "peer-x", sched.port())
+        try:
+            cfg = dfcache.DfcacheConfig(
+                daemon_sock=d.config.unix_sock, cache_id="never-imported")
+            with pytest.raises(DfError):
+                await dfcache.export_file(cfg, str(tmp_path / "out.bin"))
+        finally:
+            await d.stop()
+            await sched.stop()
+
+    run_async(run())

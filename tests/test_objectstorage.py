@@ -1,0 +1,418 @@
+"""Object-storage stack: backends, daemon gateway, dfstore SDK, dfcache.
+
+Reference test model: the e2e suite drives dfstore/dfcache against a live
+daemon + minio (test/e2e/v2, hack/install-e2e-test.sh:42-60 installs
+minio); here the backends get hermetic fakes (fs is real, s3/gcs against
+in-process aiohttp servers) and the gateway runs on a real TaskManager so
+GETs genuinely ride the P2P stream-task machinery.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import os
+
+import pytest
+from aiohttp import web
+
+from dragonfly2_tpu.client.dfstore import Dfstore, DfstoreError
+from dragonfly2_tpu.daemon.objectstorage import ObjectStorageService
+from dragonfly2_tpu.daemon.peer.piece_manager import PieceManager, PieceManagerOption
+from dragonfly2_tpu.daemon.peer.task_manager import TaskManager
+from dragonfly2_tpu.daemon.transport import P2PTransport
+from dragonfly2_tpu.pkg.objectstorage import new_client
+from dragonfly2_tpu.pkg.objectstorage.fs import FSObjectStorage
+from dragonfly2_tpu.pkg.objectstorage.gcs import GCSObjectStorage
+from dragonfly2_tpu.pkg.objectstorage.s3 import S3ObjectStorage
+from dragonfly2_tpu.storage import StorageManager, StorageOption
+
+
+# -- fs backend -------------------------------------------------------------
+
+def test_fs_backend_roundtrip(run_async, tmp_path):
+    async def run():
+        be = FSObjectStorage(root=str(tmp_path / "buckets"))
+        await be.create_bucket("ckpt")
+        assert await be.is_bucket_exist("ckpt")
+        assert not await be.is_bucket_exist("nope")
+        await be.put_object("ckpt", "model/shard-0.safetensors", b"hello world",
+                            digest="sha256:x" * 0 or "", content_type="application/octet-stream")
+        meta = await be.get_object_metadata("ckpt", "model/shard-0.safetensors")
+        assert meta.content_length == 11
+        chunks = b"".join([c async for c in await be.get_object(
+            "ckpt", "model/shard-0.safetensors")])
+        assert chunks == b"hello world"
+        ranged = b"".join([c async for c in await be.get_object(
+            "ckpt", "model/shard-0.safetensors", 6, 10)])
+        assert ranged == b"world"
+        listing = await be.list_object_metadatas("ckpt", prefix="model/")
+        assert [m.key for m in listing] == ["model/shard-0.safetensors"]
+        assert (await be.object_url("ckpt", "model/shard-0.safetensors") if False
+                else be.object_url("ckpt", "model/shard-0.safetensors")).startswith("file://")
+        await be.delete_object("ckpt", "model/shard-0.safetensors")
+        assert not await be.is_object_exist("ckpt", "model/shard-0.safetensors")
+        names = [b.name for b in await be.list_buckets()]
+        assert names == ["ckpt"]
+        await be.delete_bucket("ckpt")
+        assert not await be.is_bucket_exist("ckpt")
+
+    run_async(run())
+
+
+def test_fs_backend_rejects_traversal(run_async, tmp_path):
+    async def run():
+        be = FSObjectStorage(root=str(tmp_path / "buckets"))
+        await be.create_bucket("b")
+        with pytest.raises(Exception):
+            await be.put_object("b", "../escape", b"x")
+        with pytest.raises(Exception):
+            be._bucket_dir("../b")
+
+    run_async(run())
+
+
+# -- fake S3 ---------------------------------------------------------------
+
+async def start_fake_s3():
+    objects: dict[tuple[str, str], bytes] = {}
+    buckets: set[str] = set()
+
+    async def handler(request: web.Request) -> web.Response:
+        parts = request.path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+        if request.method == "PUT" and not key:
+            buckets.add(bucket)
+            return web.Response()
+        if request.method == "HEAD" and not key:
+            return web.Response(status=200 if bucket in buckets else 404)
+        if request.method == "PUT":
+            objects[(bucket, key)] = await request.read()
+            return web.Response()
+        if request.method == "HEAD":
+            data = objects.get((bucket, key))
+            if data is None:
+                return web.Response(status=404)
+            return web.Response(headers={"Content-Length": str(len(data)),
+                                         "ETag": '"abc"'})
+        if request.method == "GET" and not key:
+            contents = "".join(
+                f"<Contents><Key>{k}</Key><Size>{len(v)}</Size></Contents>"
+                for (b, k), v in sorted(objects.items()) if b == bucket)
+            return web.Response(
+                text=f"<ListBucketResult>{contents}</ListBucketResult>",
+                content_type="application/xml")
+        if request.method == "GET":
+            data = objects.get((bucket, key))
+            if data is None:
+                return web.Response(status=404)
+            rng = request.headers.get("Range")
+            if rng:
+                spec = rng.split("=", 1)[1]
+                start_s, _, end_s = spec.partition("-")
+                start = int(start_s)
+                end = int(end_s) if end_s else len(data) - 1
+                return web.Response(status=206, body=data[start:end + 1])
+            return web.Response(body=data)
+        if request.method == "DELETE":
+            if key:
+                objects.pop((bucket, key), None)
+            else:
+                buckets.discard(bucket)
+            return web.Response(status=204)
+        return web.Response(status=400)
+
+    app = web.Application()
+    app.router.add_route("*", "/{tail:.*}", handler)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    return runner, site._server.sockets[0].getsockname()[1]
+
+
+def test_s3_backend_against_fake(run_async):
+    async def run():
+        runner, port = await start_fake_s3()
+        be = S3ObjectStorage(endpoint=f"http://127.0.0.1:{port}",
+                             access_key="ak", secret_key="sk")
+        try:
+            await be.create_bucket("b")
+            assert await be.is_bucket_exist("b")
+            await be.put_object("b", "k/obj", b"payload", digest="crc32c:1234abcd")
+            meta = await be.get_object_metadata("b", "k/obj")
+            assert meta.content_length == 7
+            got = b"".join([c async for c in await be.get_object("b", "k/obj")])
+            assert got == b"payload"
+            part = b"".join([c async for c in await be.get_object("b", "k/obj", 2, 4)])
+            assert part == b"ylo"
+            listing = await be.list_object_metadatas("b")
+            assert [m.key for m in listing] == ["k/obj"]
+            presigned = be.presign_url("b", "k/obj")
+            assert "X-Amz-Signature=" in presigned
+            await be.delete_object("b", "k/obj")
+            assert not await be.is_object_exist("b", "k/obj")
+        finally:
+            await be.close()
+            await runner.cleanup()
+
+    run_async(run())
+
+
+# -- fake GCS ---------------------------------------------------------------
+
+async def start_fake_gcs():
+    objects: dict[tuple[str, str], bytes] = {}
+    buckets: set[str] = set()
+
+    async def route(request: web.Request) -> web.Response:
+        import json as _json
+        from urllib.parse import unquote
+
+        path = request.path
+        if path == "/storage/v1/b" and request.method == "POST":
+            body = await request.json()
+            buckets.add(body["name"])
+            return web.json_response({"name": body["name"]})
+        if path == "/storage/v1/b" and request.method == "GET":
+            return web.json_response({"items": [{"name": b} for b in sorted(buckets)]})
+        if path.startswith("/upload/storage/v1/b/"):
+            bucket = path.split("/")[5]
+            name = unquote(request.query["name"])
+            objects[(bucket, name)] = await request.read()
+            return web.json_response({"name": name})
+        if path.startswith("/storage/v1/b/"):
+            parts = path.split("/")
+            bucket = unquote(parts[4])
+            if len(parts) == 5:   # bucket ops
+                if request.method == "GET":
+                    return (web.json_response({"name": bucket, "timeCreated": ""})
+                            if bucket in buckets else web.Response(status=404))
+                if request.method == "DELETE":
+                    buckets.discard(bucket)
+                    return web.Response(status=204)
+            if len(parts) == 6 and parts[5] == "o":  # list objects
+                items = [{"name": k, "size": str(len(v))}
+                         for (b, k), v in sorted(objects.items()) if b == bucket]
+                return web.json_response({"items": items})
+            if len(parts) >= 6 and parts[5] == "o":
+                key = unquote("/".join(parts[6:]))
+                data = objects.get((bucket, key))
+                if data is None:
+                    return web.Response(status=404)
+                if request.query.get("alt") == "media":
+                    rng = request.headers.get("Range")
+                    if rng:
+                        spec = rng.split("=", 1)[1]
+                        s, _, e = spec.partition("-")
+                        start = int(s)
+                        end = int(e) if e else len(data) - 1
+                        return web.Response(status=206, body=data[start:end + 1])
+                    return web.Response(body=data)
+                if request.method == "DELETE":
+                    objects.pop((bucket, key), None)
+                    return web.Response(status=204)
+                if request.method == "PATCH":
+                    return web.json_response({"name": key})
+                return web.json_response({"name": key, "size": str(len(data)),
+                                          "etag": "e1", "metadata": {}})
+        return web.Response(status=400)
+
+    app = web.Application()
+    app.router.add_route("*", "/{tail:.*}", route)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    return runner, site._server.sockets[0].getsockname()[1]
+
+
+def test_gcs_backend_against_fake(run_async, monkeypatch):
+    async def run():
+        runner, port = await start_fake_gcs()
+        be = GCSObjectStorage(endpoint=f"http://127.0.0.1:{port}")
+        try:
+            await be.create_bucket("tpu-ckpts")
+            assert await be.is_bucket_exist("tpu-ckpts")
+            await be.put_object("tpu-ckpts", "llama/shard-00.safetensors",
+                                b"weights", digest="sha256:aa")
+            meta = await be.get_object_metadata("tpu-ckpts", "llama/shard-00.safetensors")
+            assert meta.content_length == 7
+            got = b"".join([c async for c in await be.get_object(
+                "tpu-ckpts", "llama/shard-00.safetensors")])
+            assert got == b"weights"
+            part = b"".join([c async for c in await be.get_object(
+                "tpu-ckpts", "llama/shard-00.safetensors", 0, 2)])
+            assert part == b"wei"
+            listing = await be.list_object_metadatas("tpu-ckpts")
+            assert [m.key for m in listing] == ["llama/shard-00.safetensors"]
+            assert be.object_url("tpu-ckpts", "x") == "gs://tpu-ckpts/x"
+            await be.delete_object("tpu-ckpts", "llama/shard-00.safetensors")
+            assert not await be.is_object_exist("tpu-ckpts", "llama/shard-00.safetensors")
+        finally:
+            await be.close()
+            await runner.cleanup()
+
+    monkeypatch.setenv("DF_GCS_ANONYMOUS", "1")
+    run_async(run())
+
+
+def test_new_client_dispatch(tmp_path):
+    assert new_client("fs", root=str(tmp_path)).name == "fs"
+    assert new_client("s3", endpoint="http://x").name == "s3"
+    assert new_client("oss", endpoint="http://x").name == "s3"
+    with pytest.raises(Exception):
+        new_client("bogus")
+
+
+# -- daemon gateway + dfstore ------------------------------------------------
+
+def make_task_manager(tmp_path) -> TaskManager:
+    storage = StorageManager(StorageOption(data_dir=str(tmp_path / "p2p")))
+    pm = PieceManager(PieceManagerOption(concurrency=2))
+    return TaskManager(storage, pm)
+
+
+async def start_gateway(tmp_path, **kwargs):
+    backend = FSObjectStorage(root=str(tmp_path / "buckets"))
+    tm = make_task_manager(tmp_path)
+    svc = ObjectStorageService(backend, P2PTransport(tm), **kwargs)
+    port = await svc.serve("127.0.0.1", 0)
+    return svc, port, tm
+
+
+def test_gateway_put_get_via_p2p(run_async, tmp_path):
+    async def run():
+        svc, port, tm = await start_gateway(tmp_path)
+        store = Dfstore(f"http://127.0.0.1:{port}")
+        try:
+            await store.create_bucket("data")
+            payload = os.urandom(3 * 1024 * 1024)
+            digest = await store.put_object("data", "webds/shard-000.tar", payload,
+                                            mode="write_back")
+            assert digest == "sha256:" + hashlib.sha256(payload).hexdigest()
+            # GET rides a stream task over the file:// origin.
+            got = await store.get_object("data", "webds/shard-000.tar")
+            assert got == payload
+            # The bytes landed in the P2P piece store (cache hit next time).
+            assert any(s.metadata.done for s in tm.storage.tasks())
+            # Ranged GET.
+            part = await store.get_object("data", "webds/shard-000.tar",
+                                          range_header="bytes=100-199")
+            assert part == payload[100:200]
+            # Range at EOF -> 416.
+            with pytest.raises(DfstoreError) as exc:
+                await store.get_object("data", "webds/shard-000.tar",
+                                       range_header=f"bytes={len(payload)}-")
+            assert exc.value.status == 416
+            # Stat + list + delete.
+            info = await store.stat_object("data", "webds/shard-000.tar")
+            assert info.content_length == len(payload)
+            assert info.digest == digest
+            objs = await store.list_objects("data", prefix="webds/")
+            assert [o.key for o in objs] == ["webds/shard-000.tar"]
+            await store.delete_object("data", "webds/shard-000.tar")
+            assert not await store.is_object_exist("data", "webds/shard-000.tar")
+        finally:
+            await store.close()
+            await svc.close()
+
+    run_async(run())
+
+
+def test_gateway_replicates_to_seeds(run_async, tmp_path):
+    async def run():
+        triggered: list[tuple[dict, dict]] = []
+
+        async def trigger(seed, spec):
+            triggered.append((seed, spec))
+            return True
+
+        svc, port, _ = await start_gateway(
+            tmp_path,
+            get_seed_peers=lambda: [{"ip": "10.0.0.1", "port": 1},
+                                    {"ip": "10.0.0.2", "port": 2}],
+            trigger_seed=trigger)
+        store = Dfstore(f"http://127.0.0.1:{port}")
+        try:
+            await store.create_bucket("b")
+            await store.put_object("b", "obj", b"x" * 100, mode="write_back")
+            assert len(triggered) == 2
+            assert all(s["url"].startswith("file://") for _, s in triggered)
+            assert all(s["tag"] == "b" for _, s in triggered)
+        finally:
+            await store.close()
+            await svc.close()
+
+    run_async(run())
+
+
+def test_gateway_streaming_get(run_async, tmp_path):
+    async def run():
+        svc, port, _ = await start_gateway(tmp_path)
+        store = Dfstore(f"http://127.0.0.1:{port}")
+        try:
+            await store.create_bucket("w")
+            payload = os.urandom(1024 * 1024)
+            await store.put_object("w", "t.tar", payload)
+            got = b""
+            async for chunk in await store.stream_object("w", "t.tar"):
+                got += chunk
+            assert got == payload
+        finally:
+            await store.close()
+            await svc.close()
+
+    run_async(run())
+
+
+def test_replication_task_id_matches_gateway_get(run_async, tmp_path):
+    """Regression: replicated copies must live under the SAME task ID a
+    gateway GET produces, or seeds prefetch into a task no GET ever hits."""
+    from dragonfly2_tpu.daemon.peer.task_manager import StreamTaskRequest
+    from dragonfly2_tpu.proto.common import UrlMeta
+
+    async def run():
+        specs = []
+
+        async def trigger(seed, spec):
+            specs.append(spec)
+            return True
+
+        svc, port, _ = await start_gateway(
+            tmp_path, get_seed_peers=lambda: [{"ip": "h", "port": 1}],
+            trigger_seed=trigger)
+        store = Dfstore(f"http://127.0.0.1:{port}")
+        try:
+            await store.create_bucket("b")
+            await store.put_object("b", "obj", b"data", mode="write_back")
+            assert len(specs) == 1
+            get_task_id = StreamTaskRequest(
+                url=specs[0]["url"], meta=UrlMeta(tag="b")).task_id()
+            assert specs[0]["task_id"] == get_task_id
+        finally:
+            await store.close()
+            await svc.close()
+
+    run_async(run())
+
+
+def test_s3_backend_file_like_put(run_async, tmp_path):
+    async def run():
+        import io
+
+        runner, port = await start_fake_s3()
+        be = S3ObjectStorage(endpoint=f"http://127.0.0.1:{port}",
+                             access_key="ak", secret_key="sk")
+        try:
+            await be.create_bucket("b")
+            payload = os.urandom(256 * 1024)
+            await be.put_object("b", "big", io.BytesIO(payload))
+            got = b"".join([c async for c in await be.get_object("b", "big")])
+            assert got == payload
+        finally:
+            await be.close()
+            await runner.cleanup()
+
+    run_async(run())

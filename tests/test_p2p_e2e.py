@@ -55,6 +55,7 @@ async def start_scheduler() -> SchedulerServer:
     cfg = SchedulerConfig()
     cfg.server.port = 0
     cfg.scheduling.retry_interval = 0.05   # fast tests
+    cfg.scheduling.no_source_patience = 0.5
     cfg.gc.interval = 3600
     server = SchedulerServer(cfg)
     await server.start()
