@@ -141,6 +141,10 @@ def _add_daemon(sub: argparse._SubParsersAction) -> None:
                    help="fs | s3 | gcs | oss | obs")
     p.add_argument("--object-storage-option", action="append", default=[],
                    help="backend kwarg k=v (repeatable), e.g. root=/data/buckets")
+    p.add_argument("--pex-port", type=int, default=-1,
+                   help="enable gossip peer exchange on this UDP port (0 = ephemeral)")
+    p.add_argument("--pex-seed", action="append", default=[],
+                   help="PEX bootstrap host:port (repeatable)")
     p.set_defaults(func=_run_daemon)
 
 
@@ -179,6 +183,11 @@ def _run_daemon(args: argparse.Namespace) -> int:
 
             opts["root"] = os.path.join(cfg.work_home or ".", "buckets")
         cfg.object_storage.backend_options = opts
+    if args.pex_port >= 0 or args.pex_seed:
+        cfg.pex.enabled = True
+        if args.pex_port >= 0:
+            cfg.pex.port = args.pex_port
+        cfg.pex.seeds = args.pex_seed
 
     async def run() -> int:
         daemon = Daemon(cfg)

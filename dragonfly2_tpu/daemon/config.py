@@ -50,6 +50,7 @@ class SchedulerOption:
 @dataclass
 class DownloadOption:
     rate_limit: int = 0             # bytes/sec, 0 = unlimited
+    traffic_shaper: str = "plain"   # plain | sampling (reference trafficShaperType)
     piece_concurrency: int = 4      # origin range-group concurrency
     parent_concurrency: int = 4     # concurrent parent piece workers
     unix_sock: str = ""             # download gRPC analog (dfget attach)
@@ -95,6 +96,16 @@ class ObjectStorageOption:
 
 
 @dataclass
+class PexOption:
+    """Gossip peer exchange (reference client/daemon/pex,
+    peerExchange option peerhost.go:84)."""
+
+    enabled: bool = False
+    port: int = 0                   # UDP gossip port, 0 = ephemeral
+    seeds: list[str] = field(default_factory=list)  # "host:port" bootstrap
+
+
+@dataclass
 class TPUSinkOption:
     """--device=tpu sink: land verified pieces into TPU HBM (no reference
     analog; BASELINE.json north star)."""
@@ -113,6 +124,7 @@ class DaemonConfig:
     storage: StorageOpt = field(default_factory=StorageOpt)
     proxy: ProxyOption = field(default_factory=ProxyOption)
     object_storage: ObjectStorageOption = field(default_factory=ObjectStorageOption)
+    pex: PexOption = field(default_factory=PexOption)
     tpu_sink: TPUSinkOption = field(default_factory=TPUSinkOption)
     work_home: str = ""
     host_type: str = "normal"       # normal|super|strong|weak (seed tiers)

@@ -72,6 +72,7 @@ class Daemon:
             conductor_factory=self._make_conductor if self.scheduler_client else None,
             total_rate_limit=rate,
             host_wire=self._host_wire,
+            traffic_shaper=config.download.traffic_shaper,
         )
         self.rpc = DaemonRpcServer(self.task_manager)
         self.proxy = None
@@ -99,6 +100,7 @@ class Daemon:
                 trigger_seed=self._trigger_seed_peer)
         self.announcer: Announcer | None = None
         self.dynconfig = None  # manager-source scheduler resolution
+        self.pex = None        # gossip peer exchange (started in start())
         self._started = False
         self._peer_port = 0
         self.gc = GC(log)
@@ -138,7 +140,8 @@ class Daemon:
     # -- conductor factory (P2P path) --------------------------------------
 
     def _make_conductor(self, *, task_id: str, peer_id: str, request, store,
-                        on_piece, is_seed: bool = False) -> PeerTaskConductor:
+                        on_piece, is_seed: bool = False,
+                        limiter=None) -> PeerTaskConductor:
         disable_back_source = getattr(request, "disable_back_source", False)
         if self.announcer is None:
             raise RuntimeError("conductor requires a started daemon (announcer missing)")
@@ -165,7 +168,7 @@ class Daemon:
             meta=meta,
             is_seed=is_seed or self.config.seed_peer,
             piece_parallelism=self.config.download.parent_concurrency,
-            limiter=self.task_manager.limiter,
+            limiter=limiter if limiter is not None else self.task_manager.limiter,
             on_piece=on_piece,
             disable_back_source=disable_back_source,
         )
@@ -230,6 +233,7 @@ class Daemon:
         await asyncio.get_running_loop().run_in_executor(None, local_store._native)
         if self.config.manager_addr:
             await self._resolve_schedulers_from_manager()
+        self.task_manager.shaper.serve()
         await self.rpc.serve_download(NetAddr.unix(self.config.unix_sock))
         if self.config.download.peer_port >= 0:  # -1 disables the peer service
             await self.rpc.serve_peer(
@@ -240,6 +244,19 @@ class Daemon:
         if self.object_storage is not None:
             await self.object_storage.serve(self.config.host.ip,
                                             self.config.object_storage.port)
+        if self.config.pex.enabled:
+            from dragonfly2_tpu.daemon.pex import PeerExchange
+
+            self.pex = PeerExchange(
+                ip=self.config.host.ip,
+                peer_port=self.rpc.peer_server.port() if self.rpc.peer_server._servers else 0,
+                upload_port=self.upload.port)
+            await self.pex.start(self.config.pex.port, self.config.pex.seeds)
+            self.task_manager.pex = self.pex
+            # Gossip everything already complete on disk (restart recovery).
+            for store in self.storage.tasks():
+                if store.metadata.done and not store.metadata.invalid:
+                    self.pex.add_task(store.metadata.task_id)
         peer_port = self.rpc.peer_server.port() if self.rpc.peer_server._servers else 0
         self._peer_port = peer_port
         self._started = True
@@ -271,6 +288,9 @@ class Daemon:
 
     async def stop(self) -> None:
         self.gc.stop()
+        self.task_manager.shaper.stop()
+        if self.pex is not None:
+            await self.pex.stop()
         if self.dynconfig is not None:
             await self.dynconfig.stop()
         if self.announcer is not None:

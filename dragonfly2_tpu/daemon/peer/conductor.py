@@ -305,35 +305,24 @@ class PeerTaskConductor:
             await self._download_one(assignment)
 
     async def _download_one(self, assignment: PieceAssignment) -> None:
+        from dragonfly2_tpu.daemon.peer.piece_downloader import (
+            is_parent_gone,
+            pull_one_piece,
+        )
+
         p = assignment.parent
-        # Task geometry can arrive from parents (sync streams) before the
-        # scheduler's task record knows it; the store needs piece_size
-        # before the first write.
-        if self.store.metadata.piece_size <= 0 and self.dispatcher.piece_size > 0:
-            self.store.update_task(
-                piece_size=self.dispatcher.piece_size,
-                content_length=self.dispatcher.content_length
-                if self.dispatcher.content_length >= 0 else None,
-                total_piece_count=self.dispatcher.total_piece_count
-                if self.dispatcher.total_piece_count >= 0 else None,
-            )
         try:
-            await self.limiter.wait(max(assignment.expected_size, 1)
-                                    if assignment.expected_size > 0 else 1)
-            data, cost_ms = await self.downloader.download_piece(
-                p.ip, p.upload_port, self.task_id, assignment.piece_num,
-                src_peer_id=self.peer_id, expected_size=assignment.expected_size)
-            rec = self.store.write_piece(assignment.piece_num, data,
-                                         expected_digest=assignment.digest,
-                                         cost_ms=cost_ms)
-            self.dispatcher.report_success(assignment, cost_ms)
+            rec = await pull_one_piece(
+                self.downloader, self.store, self.dispatcher, assignment,
+                task_id=self.task_id, peer_id=self.peer_id, limiter=self.limiter)
+            self.dispatcher.report_success(assignment, rec.cost_ms)
             PIECE_DOWNLOAD_COUNT.labels("ok").inc()
             await self._report_piece(rec, parent_id=p.peer_id)
             if self.on_piece is not None:
                 await self.on_piece(self.store, rec)
         except DfError as e:
             PIECE_DOWNLOAD_COUNT.labels("fail").inc()
-            gone = e.code in (Code.ClientConnectionError, Code.ClientPieceRequestFail)
+            gone = is_parent_gone(e)
             self.dispatcher.report_failure(assignment, parent_gone=gone)
             await self._safe_send({
                 "type": "piece_failed",

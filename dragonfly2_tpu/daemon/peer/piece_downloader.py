@@ -67,3 +67,36 @@ class PieceDownloader:
     async def close(self) -> None:
         if self._session is not None and not self._session.closed:
             await self._session.close()
+
+
+def is_parent_gone(e: DfError) -> bool:
+    """Errors that mean the parent itself is unusable (vs a transient piece
+    failure) — shared classification for conductor and PEX pull paths."""
+    return e.code in (Code.ClientConnectionError, Code.ClientPieceRequestFail)
+
+
+async def pull_one_piece(downloader: PieceDownloader, store, dispatcher,
+                         assignment, *, task_id: str, peer_id: str,
+                         limiter) -> "object":
+    """The shared piece-pull step: backfill store geometry from the
+    dispatcher, rate-limit, fetch from the assigned parent, verify+write.
+    Returns the PieceRecord; raises DfError on failure WITHOUT reporting to
+    the dispatcher (callers own success/failure accounting since their
+    retry/reschedule policies differ)."""
+    if store.metadata.piece_size <= 0 and dispatcher.piece_size > 0:
+        store.update_task(
+            piece_size=dispatcher.piece_size,
+            content_length=dispatcher.content_length
+            if dispatcher.content_length >= 0 else None,
+            total_piece_count=dispatcher.total_piece_count
+            if dispatcher.total_piece_count >= 0 else None,
+        )
+    await limiter.wait(max(assignment.expected_size, 1)
+                       if assignment.expected_size > 0 else 1)
+    data, cost_ms = await downloader.download_piece(
+        assignment.parent.ip, assignment.parent.upload_port,
+        task_id, assignment.piece_num,
+        src_peer_id=peer_id, expected_size=assignment.expected_size)
+    return store.write_piece(assignment.piece_num, data,
+                             expected_digest=assignment.digest,
+                             cost_ms=cost_ms)

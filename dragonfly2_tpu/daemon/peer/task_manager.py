@@ -117,6 +117,8 @@ class TaskManager:
         conductor_factory=None,
         total_rate_limit: int = 0,
         host_wire=None,
+        traffic_shaper: str = "plain",
+        pex=None,
     ):
         self.storage = storage
         self.piece_manager = piece_manager
@@ -126,7 +128,16 @@ class TaskManager:
         # () -> AnnounceHost-shaped dict (or {} before the daemon starts);
         # used to advertise imported tasks under the daemon's one identity.
         self.host_wire = host_wire
-        self.limiter = Limiter(total_rate_limit if total_rate_limit > 0 else float("inf"))
+        # Gossip peer exchange (daemon/pex.py): schedulerless peer discovery
+        # + task-possession broadcast (reference client/daemon/pex/).
+        self.pex = pex
+        from dragonfly2_tpu.daemon.peer.traffic_shaper import TrafficShaper
+
+        self.shaper = TrafficShaper(
+            total_rate_limit if total_rate_limit > 0 else float("inf"),
+            algorithm=traffic_shaper)
+        # Shared bucket (plain algorithm / non-task transfers).
+        self.limiter = self.shaper._shared
         self.broker = PieceBroker()
         self._running: dict[str, _RunningTask] = {}
 
@@ -147,23 +158,111 @@ class TaskManager:
                 await progress_q.on_piece(st, rec)
 
         use_p2p = self.scheduler_client is not None and self.conductor_factory is not None
-        if use_p2p:
-            conductor = self.conductor_factory(
-                task_id=task_id, peer_id=peer_id, request=req, store=store,
-                on_piece=on_piece, is_seed=is_seed,
+        limiter = self.shaper.start_task(task_id)
+        try:
+            if use_p2p:
+                conductor = self.conductor_factory(
+                    task_id=task_id, peer_id=peer_id, request=req, store=store,
+                    on_piece=on_piece, is_seed=is_seed, limiter=limiter,
+                )
+                await conductor.run()
+                return conductor.from_p2p
+            if self.pex is not None:
+                # Schedulerless P2P: gossip told us who holds this task.
+                # A failed attempt (stale holders, mid-transfer stall) falls
+                # through to back-source rather than failing the task.
+                try:
+                    if await self._pex_download(task_id, peer_id, store,
+                                                on_piece, limiter):
+                        return True
+                except DfError as e:
+                    if req.disable_back_source:
+                        raise
+                    log.warning("pex download failed, falling back to source",
+                                task_id=task_id[:16], error=str(e))
+            if req.disable_back_source:
+                raise DfError(Code.ClientBackSourceError,
+                              "no scheduler and back-to-source disabled")
+            await self.piece_manager.download_source(
+                store, req.url, req.meta.header,
+                content_range=req.range,
+                on_piece=on_piece,
+                limiter=limiter,
             )
-            await conductor.run()
-            return conductor.from_p2p
-        if req.disable_back_source:
-            raise DfError(Code.ClientBackSourceError,
-                          "no scheduler and back-to-source disabled")
-        await self.piece_manager.download_source(
-            store, req.url, req.meta.header,
-            content_range=req.range,
-            on_piece=on_piece,
-            limiter=self.limiter,
+            return False
+        finally:
+            self.shaper.finish_task(task_id)
+
+    async def _pex_download(self, task_id: str, peer_id: str, store,
+                            on_piece, limiter) -> bool:
+        """Pull every piece from PEX-discovered holders (no scheduler in the
+        loop — reference pex/peer_exchange.go's scheduler-free path). Returns
+        False when gossip knows no live holder; raises only on mid-transfer
+        failure with no usable parent left."""
+        from dragonfly2_tpu.daemon.peer.piece_dispatcher import PieceDispatcher
+        from dragonfly2_tpu.daemon.peer.piece_downloader import (
+            PieceDownloader,
+            is_parent_gone,
+            pull_one_piece,
         )
-        return False
+        from dragonfly2_tpu.daemon.peer.synchronizer import PieceTaskSynchronizer
+
+        holders = self.pex.find_holders(task_id)
+        holders = [m for m in holders if m.peer_port and m.upload_port]
+        if not holders:
+            return False
+        dispatcher = PieceDispatcher()
+        synchronizer = PieceTaskSynchronizer(task_id, peer_id, dispatcher)
+        downloader = PieceDownloader()
+        dispatcher.mark_known_downloaded(store.metadata.pieces.keys())
+        synchronizer.sync_parents([
+            {"id": m.node_id,
+             "host": {"ip": m.ip, "port": m.peer_port,
+                      "upload_port": m.upload_port}}
+            for m in holders])
+        log.info("pex download", task_id=task_id[:16], holders=len(holders))
+
+        async def worker() -> None:
+            while not dispatcher.is_complete():
+                assignment = await dispatcher.get(timeout=15.0)
+                if assignment is None:
+                    if dispatcher.is_complete():
+                        return
+                    raise DfError(Code.ClientPieceDownloadFail,
+                                  "pex download stalled (no usable holders)")
+                try:
+                    rec = await pull_one_piece(
+                        downloader, store, dispatcher, assignment,
+                        task_id=task_id, peer_id=peer_id, limiter=limiter)
+                except DfError as e:
+                    dispatcher.report_failure(assignment,
+                                              parent_gone=is_parent_gone(e))
+                    continue
+                dispatcher.report_success(assignment, rec.cost_ms)
+                await on_piece(store, rec)
+
+        try:
+            workers = [asyncio.ensure_future(worker()) for _ in range(4)]
+            try:
+                await asyncio.gather(*workers)
+            except BaseException:
+                for w in workers:
+                    w.cancel()
+                await asyncio.gather(*workers, return_exceptions=True)
+                raise
+        finally:
+            await synchronizer.close()
+            await downloader.close()
+        if not dispatcher.is_complete():
+            raise DfError(Code.ClientPieceDownloadFail, "pex download incomplete")
+        if (store.metadata.content_length < 0
+                and dispatcher.content_length >= 0):
+            store.update_task(content_length=dispatcher.content_length)
+        return True
+
+    def _pex_announce(self, task_id: str) -> None:
+        if self.pex is not None:
+            self.pex.add_task(task_id)
 
     # -- import / export (dfcache — reference client/dfcache + ImportFile) --
 
@@ -184,6 +283,7 @@ class TaskManager:
                         store.validate_digest(req.meta.digest)
                         store.metadata.digest = req.meta.digest
                     store.mark_done()
+                    self._pex_announce(task_id)
                 except BaseException:
                     # A half-imported store must not be resumed by a retry:
                     # stale piece records would outlive a changed source file
@@ -278,6 +378,7 @@ class TaskManager:
                 store.validate_digest(req.meta.digest)
                 store.metadata.digest = req.meta.digest
             store.mark_done()
+            self._pex_announce(task_id)
             store.store_to(req.output)
         except DfError as e:
             store.mark_invalid()
@@ -345,6 +446,7 @@ class TaskManager:
         try:
             await self._run_download(task_id, peer_id, req, store, None, is_seed=True)
             store.mark_done()
+            self._pex_announce(task_id)
             self.broker.publish(task_id, PieceEvent(
                 [], store.metadata.total_piece_count, store.metadata.content_length,
                 store.metadata.piece_size, done=True))
@@ -470,6 +572,7 @@ class TaskManager:
                 store.validate_digest(req.meta.digest)
                 store.metadata.digest = req.meta.digest
             store.mark_done()
+            self._pex_announce(task_id)
             self.broker.publish(task_id, PieceEvent(
                 [], store.metadata.total_piece_count,
                 store.metadata.content_length, store.metadata.piece_size,

@@ -141,6 +141,8 @@ class DaemonRpcServer:
         if store is not None and store.pinned:
             return {"ok": False, "reason": "task store in use"}
         self.task_manager.storage.delete_task(task_id)
+        if self.task_manager.pex is not None:
+            self.task_manager.pex.remove_task(task_id)
         return {"ok": True}
 
     async def _health(self, body, ctx: RpcContext):

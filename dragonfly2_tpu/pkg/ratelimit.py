@@ -75,12 +75,15 @@ class Limiter:
             await self._resume.wait()
         if n > self._burst:
             # A single request larger than the bucket: pay for it across
-            # multiple bucket fills rather than deadlocking.
+            # multiple bucket fills rather than deadlocking. Non-virtual
+            # call — subclasses that override wait() for accounting (the
+            # traffic shaper's window counter) must see ONE request, not
+            # request + its chunks.
             waited = 0.0
             remaining = n
             while remaining > 0:
                 chunk = min(remaining, self._burst)
-                waited += await self.wait(chunk)
+                waited += await Limiter.wait(self, chunk)
                 remaining -= chunk
             return waited
         start = time.monotonic()

@@ -1,0 +1,195 @@
+"""PEX gossip: membership convergence, task possession, schedulerless P2P.
+
+Reference: client/daemon/pex/ — memberlist gossip + per-peer task
+possession broadcast so peers find each other without the scheduler
+(peer_exchange.go:114, peer_pool.go).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import random
+
+from aiohttp import web
+
+from dragonfly2_tpu.daemon.config import DaemonConfig
+from dragonfly2_tpu.daemon.daemon import Daemon
+from dragonfly2_tpu.daemon.pex import PeerExchange
+from dragonfly2_tpu.pkg.piece import Range
+
+from tests.test_p2p_e2e import daemon_config
+
+CONTENT = bytes(random.Random(41).randbytes(3 * 1024 * 1024))
+SHA = "sha256:" + hashlib.sha256(CONTENT).hexdigest()
+
+
+async def _wait(predicate, timeout: float = 10.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(0.05)
+    return False
+
+
+def test_membership_and_possession_gossip(run_async):
+    async def run():
+        a = PeerExchange(ip="127.0.0.1", peer_port=1, upload_port=2,
+                         gossip_interval=0.1)
+        b = PeerExchange(ip="127.0.0.1", peer_port=3, upload_port=4,
+                         gossip_interval=0.1)
+        c = PeerExchange(ip="127.0.0.1", peer_port=5, upload_port=6,
+                         gossip_interval=0.1)
+        try:
+            port_a = await a.start(0)
+            await b.start(0, seeds=[f"127.0.0.1:{port_a}"])
+            await c.start(0, seeds=[f"127.0.0.1:{port_a}"])
+            # b and c learn each other transitively through a.
+            assert await _wait(lambda: len(b.members) == 2 and len(c.members) == 2)
+
+            a.add_task("t-1")
+            b.add_task("t-2")
+            assert await _wait(
+                lambda: [m.node_id for m in c.find_holders("t-1")] == [a.node_id]
+                and [m.node_id for m in c.find_holders("t-2")] == [b.node_id])
+            # Possession removal gossips too (versioned, no regression).
+            a.remove_task("t-1")
+            assert await _wait(lambda: c.find_holders("t-1") == [])
+        finally:
+            await a.stop()
+            await b.stop()
+            await c.stop()
+
+    run_async(run())
+
+
+def test_dead_member_expires(run_async):
+    async def run():
+        import dragonfly2_tpu.daemon.pex as pexmod
+
+        a = PeerExchange(ip="127.0.0.1", gossip_interval=0.05)
+        b = PeerExchange(ip="127.0.0.1", gossip_interval=0.05)
+        old_dead = pexmod.DEAD_AFTER
+        pexmod.DEAD_AFTER = 0.5
+        try:
+            port_a = await a.start(0)
+            await b.start(0, seeds=[f"127.0.0.1:{port_a}"])
+            assert await _wait(lambda: len(a.members) == 1)
+            await b.stop()
+            assert await _wait(lambda: len(a.members) == 0, timeout=5.0)
+        finally:
+            pexmod.DEAD_AFTER = old_dead
+            await a.stop()
+
+    run_async(run())
+
+
+async def _start_origin():
+    hits = {"n": 0}
+
+    async def blob(request: web.Request) -> web.Response:
+        hits["n"] += 1
+        rng = request.headers.get("Range")
+        if rng:
+            r = Range.parse_http(rng, len(CONTENT))
+            return web.Response(status=206, body=CONTENT[r.start:r.start + r.length],
+                                headers={"Accept-Ranges": "bytes",
+                                         "Content-Range":
+                                         f"bytes {r.start}-{r.start + r.length - 1}/{len(CONTENT)}"})
+        return web.Response(body=CONTENT, headers={"Accept-Ranges": "bytes"})
+
+    app = web.Application()
+    app.router.add_get("/blob", blob)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    return runner, site._server.sockets[0].getsockname()[1], hits
+
+
+def _pex_daemon_config(tmp_path, name: str, seeds: list[str]) -> DaemonConfig:
+    cfg = daemon_config(tmp_path, name, scheduler_port=0)
+    cfg.scheduler.addrs = []            # NO scheduler: pure PEX mode
+    cfg.pex.enabled = True
+    cfg.pex.seeds = seeds
+    return cfg
+
+
+def test_schedulerless_p2p_download_via_pex(run_async, tmp_path):
+    """Daemon A fetches from origin; daemon B (no scheduler) gets the same
+    task from A via gossip — origin served exactly one copy."""
+
+    async def run():
+        from dragonfly2_tpu.daemon.peer.task_manager import FileTaskRequest
+        from dragonfly2_tpu.proto.common import UrlMeta
+
+        runner, port, hits = await _start_origin()
+        d_a = Daemon(_pex_daemon_config(tmp_path, "pex-a", []))
+        await d_a.start()
+        d_a.pex.gossip_interval = 0.1
+        d_b = Daemon(_pex_daemon_config(
+            tmp_path, "pex-b", [f"127.0.0.1:{d_a.pex.port}"]))
+        await d_b.start()
+        d_b.pex.gossip_interval = 0.1
+        try:
+            url = f"http://127.0.0.1:{port}/blob"
+            req = FileTaskRequest(url=url, output=str(tmp_path / "a.bin"),
+                                  meta=UrlMeta(digest=SHA))
+            async for _ in d_a.task_manager.start_file_task(req):
+                pass
+            hits_after_a = hits["n"]
+            assert hits_after_a >= 1
+            task_id = req.task_id()
+            # B hears about A's possession via gossip.
+            assert await _wait(lambda: d_b.pex.find_holders(task_id) != [])
+
+            req_b = FileTaskRequest(url=url, output=str(tmp_path / "b.bin"),
+                                    meta=UrlMeta(digest=SHA),
+                                    disable_back_source=True)
+            async for _ in d_b.task_manager.start_file_task(req_b):
+                pass
+            assert (tmp_path / "b.bin").read_bytes() == CONTENT
+            assert hits["n"] == hits_after_a  # no extra origin traffic
+            # B now gossips possession as well.
+            assert await _wait(
+                lambda: any(m.node_id == d_b.pex.node_id
+                            for m in d_a.pex.find_holders(task_id)))
+        finally:
+            await d_b.stop()
+            await d_a.stop()
+            await runner.cleanup()
+
+    run_async(run())
+
+
+def test_stale_holders_fall_back_to_source(run_async, tmp_path):
+    """Regression: gossip lists a dead holder -> the download must fall
+    back to origin instead of failing the task."""
+
+    async def run():
+        from dragonfly2_tpu.daemon.peer.task_manager import FileTaskRequest
+        from dragonfly2_tpu.daemon.pex import Member
+        from dragonfly2_tpu.proto.common import UrlMeta
+
+        runner, port, hits = await _start_origin()
+        d = Daemon(_pex_daemon_config(tmp_path, "pex-stale", []))
+        await d.start()
+        try:
+            url = f"http://127.0.0.1:{port}/blob"
+            req = FileTaskRequest(url=url, output=str(tmp_path / "o.bin"),
+                                  meta=UrlMeta(digest=SHA))
+            # Forge possession pointing at a dead address.
+            ghost = Member("ghost", "127.0.0.1", 1, peer_port=9,
+                           upload_port=9)
+            d.pex.members["ghost"] = ghost
+            d.pex._possession["ghost"] = (1, {req.task_id()})
+            async for _ in d.task_manager.start_file_task(req):
+                pass
+            assert (tmp_path / "o.bin").read_bytes() == CONTENT
+            assert hits["n"] >= 1
+        finally:
+            await d.stop()
+            await runner.cleanup()
+
+    run_async(run())

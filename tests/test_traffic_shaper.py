@@ -1,0 +1,106 @@
+"""Traffic shaper: plain shared bucket vs sampling reallocation.
+
+Reference: client/daemon/peer/traffic_shaper.go (:65-110 plain, :125+
+sampling reallocation by observed need).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from dragonfly2_tpu.daemon.peer.traffic_shaper import (
+    MIN_SHARE_FRACTION,
+    TrafficShaper,
+    TYPE_PLAIN,
+    TYPE_SAMPLING,
+)
+from dragonfly2_tpu.pkg.ratelimit import INF
+
+
+def test_plain_returns_shared_bucket():
+    shaper = TrafficShaper(1000, algorithm=TYPE_PLAIN)
+    a = shaper.start_task("a")
+    b = shaper.start_task("b")
+    assert a is b is shaper._shared
+
+
+def test_unlimited_total_short_circuits():
+    shaper = TrafficShaper(INF, algorithm=TYPE_SAMPLING)
+    assert shaper.start_task("a") is shaper._shared
+
+
+def test_sampling_even_split_on_start_and_finish():
+    shaper = TrafficShaper(1000, algorithm=TYPE_SAMPLING)
+    a = shaper.start_task("a")
+    assert a.limit == 1000
+    b = shaper.start_task("b")
+    assert a.limit == 500 and b.limit == 500
+    shaper.finish_task("a")
+    assert b.limit == 1000
+
+
+def test_sampling_reallocates_toward_need(run_async):
+    async def run():
+        shaper = TrafficShaper(1000, algorithm=TYPE_SAMPLING)
+        hot = shaper.start_task("hot")
+        cold = shaper.start_task("cold")
+        # Simulate a window: the hot task moved 9x the bytes.
+        await hot.wait(0)
+        hot.window_bytes = 9000
+        cold.window_bytes = 1000
+        shaper.reallocate()
+        floor = 1000 * MIN_SHARE_FRACTION / 2
+        assert hot.limit == pytest.approx(floor + (1000 - 2 * floor) * 0.9)
+        assert cold.limit == pytest.approx(floor + (1000 - 2 * floor) * 0.1)
+        # Idle window: falls back to an even split.
+        shaper.reallocate()
+        assert hot.limit == pytest.approx(500)
+        assert cold.limit == pytest.approx(500)
+
+    run_async(run())
+
+
+def test_sampling_floor_keeps_starved_task_alive(run_async):
+    async def run():
+        shaper = TrafficShaper(1000, algorithm=TYPE_SAMPLING)
+        busy = shaper.start_task("busy")
+        starved = shaper.start_task("starved")
+        busy.window_bytes = 10_000
+        starved.window_bytes = 0
+        shaper.reallocate()
+        assert starved.limit >= 1000 * MIN_SHARE_FRACTION / 2
+        assert busy.limit < 1000  # the floor is carved out of the total
+
+    run_async(run())
+
+
+def test_task_limiter_tracks_window(run_async):
+    async def run():
+        shaper = TrafficShaper(1_000_000, algorithm=TYPE_SAMPLING)
+        lim = shaper.start_task("t")
+        await lim.wait(100)
+        await lim.wait(50)
+        assert lim.take_window() == 150
+        assert lim.take_window() == 0
+
+    run_async(run())
+
+
+def test_bad_algorithm_rejected():
+    with pytest.raises(ValueError):
+        TrafficShaper(100, algorithm="bogus")
+
+
+def test_window_not_double_counted_for_oversize_requests(run_async):
+    """Regression: requests larger than the bucket burst chunk internally;
+    the window counter must see the request once, not request + chunks."""
+    async def run():
+        shaper = TrafficShaper(1000, algorithm=TYPE_SAMPLING)
+        lim = shaper.start_task("t")
+        lim.set_limit(1000, burst=100)
+        await lim.wait(250)   # 3 internal chunks
+        assert lim.take_window() == 250
+
+    run_async(run())
