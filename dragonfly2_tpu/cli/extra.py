@@ -30,6 +30,11 @@ def _add_dfcache(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--application", default="")
     p.add_argument("--work-home", default="")
     p.add_argument("--timeout", type=float, default=60.0)
+    p.add_argument("--persistent", action="store_true",
+                   help="scheduler-managed persistent cache task (import)")
+    p.add_argument("--replica-count", type=int, default=1)
+    p.add_argument("--ttl", type=float, default=0.0,
+                   help="persistent task TTL seconds (0 = forever)")
     p.set_defaults(func=_run_dfcache)
 
 
@@ -47,7 +52,9 @@ def _run_dfcache(args: argparse.Namespace) -> int:
             if not args.path:
                 print("--path required for import")
                 return 2
-            result = await dfcache.import_file(cfg, args.path)
+            result = await dfcache.import_file(
+                cfg, args.path, persistent=args.persistent,
+                replica_count=args.replica_count, ttl=args.ttl)
         elif args.op == "export":
             if not args.output:
                 print("--output required for export")

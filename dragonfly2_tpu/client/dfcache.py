@@ -35,13 +35,19 @@ def _body(cfg: DfcacheConfig) -> dict:
             "application": cfg.application}
 
 
-async def import_file(cfg: DfcacheConfig, path: str) -> dict:
-    """Import a local file as this host's copy of the cache entry."""
+async def import_file(cfg: DfcacheConfig, path: str, *,
+                      persistent: bool = False, replica_count: int = 1,
+                      ttl: float = 0.0) -> dict:
+    """Import a local file as this host's copy of the cache entry. With
+    ``persistent`` the scheduler keeps it replicated to ``replica_count``
+    hosts (reference persistent cache tasks, service_v2.go:1726)."""
     cli = Client(NetAddr.unix(cfg.daemon_sock))
     try:
-        return await cli.call("Daemon.ImportTask",
-                              {**_body(cfg), "path": path},
-                              timeout=cfg.timeout)
+        return await cli.call(
+            "Daemon.ImportTask",
+            {**_body(cfg), "path": path, "persistent": persistent,
+             "replica_count": replica_count, "ttl": ttl},
+            timeout=cfg.timeout)
     finally:
         await cli.close()
 

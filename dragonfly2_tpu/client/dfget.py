@@ -11,7 +11,7 @@ import os
 from dataclasses import dataclass, field
 from typing import AsyncIterator, Callable
 
-from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg import dflog, tracing
 from dragonfly2_tpu.pkg.errors import Code, DfError
 from dragonfly2_tpu.pkg.types import NetAddr
 from dragonfly2_tpu.proto.common import UrlMeta
@@ -35,15 +35,18 @@ class DfgetConfig:
 
 async def download(cfg: DfgetConfig, on_progress: Callable[[dict], None] | None = None) -> dict:
     """Single download via the daemon; returns the final progress frame."""
-    if cfg.recursive:
-        return await _download_recursive(cfg, on_progress)
-    try:
-        return await _daemon_download(cfg, on_progress)
-    except DfError as e:
-        if e.code == Code.ClientConnectionError and cfg.allow_source_fallback:
-            log.warning("daemon unreachable; falling back to direct source download")
-            return await _download_from_source(cfg)
-        raise
+    with tracing.span("dfget.download", url=cfg.url) as sp:
+        if cfg.recursive:
+            return await _download_recursive(cfg, on_progress)
+        try:
+            result = await _daemon_download(cfg, on_progress)
+            sp.set_attr("task_id", result.get("task_id", ""))
+            return result
+        except DfError as e:
+            if e.code == Code.ClientConnectionError and cfg.allow_source_fallback:
+                log.warning("daemon unreachable; falling back to direct source download")
+                return await _download_from_source(cfg)
+            raise
 
 
 async def _daemon_download(cfg: DfgetConfig, on_progress) -> dict:

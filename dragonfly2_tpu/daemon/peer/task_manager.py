@@ -266,11 +266,59 @@ class TaskManager:
 
     # -- import / export (dfcache — reference client/dfcache + ImportFile) --
 
-    async def import_task(self, path: str, req: "FileTaskRequest") -> dict:
+    async def import_task(self, path: str, req: "FileTaskRequest", *,
+                          persistent: bool = False, replica_count: int = 1,
+                          ttl: float = 0.0) -> dict:
         """Import a local file as a completed P2P task (reference
-        piece_manager.go:662 ImportFile + dfcache Import)."""
+        piece_manager.go:662 ImportFile + dfcache Import). With
+        ``persistent``, the scheduler records it as a persistent cache task
+        and replicates it to ``replica_count`` hosts (reference
+        UploadPersistentCacheTask* family, service_v2.go:1726-1895)."""
         task_id = req.task_id()
         peer_id = req.peer_id or idgen.peer_id_v1(self.host_ip)
+        if persistent:
+            await self._persistent_call(
+                "Scheduler.UploadPersistentCacheTaskStarted", task_id, peer_id,
+                {"url": req.url, "tag": req.meta.tag,
+                 "application": req.meta.application,
+                 "replica_count": replica_count, "ttl": ttl,
+                 "digest": req.meta.digest})
+        try:
+            result = await self._import_local(path, req, task_id, peer_id)
+        except BaseException:
+            if persistent:
+                try:
+                    # Best-effort: a scheduler/network error here must not
+                    # mask the real import failure.
+                    await self._persistent_call(
+                        "Scheduler.UploadPersistentCacheTaskFailed",
+                        task_id, peer_id, {})
+                except Exception as notify_err:
+                    log.warning("persistent-failed notify failed",
+                                error=str(notify_err))
+            raise
+        if persistent:
+            await self._persistent_call(
+                "Scheduler.UploadPersistentCacheTaskFinished", task_id, peer_id,
+                {"content_length": result["content_length"],
+                 "piece_size": result.get("piece_size", 0),
+                 "total_piece_count": result.get("total_piece_count", -1)})
+        return result
+
+    async def _persistent_call(self, method: str, task_id: str, peer_id: str,
+                               extra: dict) -> None:
+        if self.scheduler_client is None:
+            raise DfError(Code.BadRequest,
+                          "persistent import needs a scheduler connection")
+        host_info = self.host_wire() if self.host_wire is not None else {}
+        host_info.pop("telemetry", None)
+        await self.scheduler_client.unary(
+            task_id, method,
+            {"task_id": task_id, "peer_id": peer_id,
+             "host": host_info, **extra})
+
+    async def _import_local(self, path: str, req: "FileTaskRequest",
+                            task_id: str, peer_id: str) -> dict:
         existing = self.storage.find_completed_task(task_id)
         if existing is None:
             store = self.storage.register_task(TaskStoreMetadata(
@@ -295,6 +343,8 @@ class TaskManager:
         await self._announce_local_task(store, task_id, peer_id)
         return {"task_id": task_id, "peer_id": peer_id,
                 "pieces": len(store.metadata.pieces),
+                "piece_size": store.metadata.piece_size,
+                "total_piece_count": store.metadata.total_piece_count,
                 "content_length": store.metadata.content_length}
 
     async def _announce_local_task(self, store, task_id: str, peer_id: str) -> None:
@@ -430,11 +480,18 @@ class TaskManager:
             header=spec.get("header") or {},
             filter="&".join(spec.get("filters") or []),
         )
-        req = FileTaskRequest(url=spec.get("url", ""), output="", meta=meta)
+        # seed=False: run as a normal peer (persistent-cache replication —
+        # the scheduler wants this host to PULL from peers, not re-seed from
+        # origin; dfcache:// tasks have no origin at all).
+        is_seed = spec.get("seed", True)
+        req = FileTaskRequest(url=spec.get("url", ""), output="", meta=meta,
+                              disable_back_source=bool(
+                                  spec.get("disable_back_source")))
         task_id = spec.get("task_id") or req.task_id()
         if task_id in self._running:
             return  # already seeding
-        peer_id = idgen.seed_peer_id_v1(self.host_ip)
+        peer_id = (idgen.seed_peer_id_v1(self.host_ip) if is_seed
+                   else idgen.peer_id_v1(self.host_ip))
 
         store = self.storage.register_task(
             TaskStoreMetadata(task_id=task_id, peer_id=peer_id, url=req.url,
@@ -444,7 +501,8 @@ class TaskManager:
         self._running[task_id] = run
         store.pin()
         try:
-            await self._run_download(task_id, peer_id, req, store, None, is_seed=True)
+            await self._run_download(task_id, peer_id, req, store, None,
+                                     is_seed=is_seed)
             store.mark_done()
             self._pex_announce(task_id)
             self.broker.publish(task_id, PieceEvent(

@@ -99,7 +99,11 @@ class DaemonRpcServer:
         if not path:
             raise DfError(Code.BadRequest, "path required")
         req = self._cache_request(body)
-        return await self.task_manager.import_task(path, req)
+        return await self.task_manager.import_task(
+            path, req,
+            persistent=bool(body.get("persistent")),
+            replica_count=int(body.get("replica_count", 1)),
+            ttl=float(body.get("ttl", 0)))
 
     async def _export_task(self, stream: ServerStream, ctx: RpcContext) -> None:
         """dfcache Export: land a cached task at an output path, pulling

@@ -57,6 +57,13 @@ class SchedulerClient:
             except DfError as e:
                 log.warning("announce host failed", addr=addr, error=e.message)
 
+    async def unary(self, task_id: str, method: str, body: dict,
+                    timeout: float = 10.0):
+        """Unary call routed by task id through the consistent-hash ring
+        (public surface for call families without a dedicated wrapper,
+        e.g. the persistent cache RPCs)."""
+        return await self._client_for(task_id).call(method, body, timeout=timeout)
+
     async def announce_task(self, body: dict) -> None:
         """Advertise a locally-complete task (dfcache import) — reference
         AnnounceTask, service_v1.go:331."""

@@ -32,8 +32,9 @@ CONCURRENT_UPLOADS = metrics.gauge("upload_concurrency", "In-flight piece upload
 
 class UploadManager:
     def __init__(self, storage: StorageManager, *, rate_limit: int = 0,
-                 concurrent_limit: int = 0):
+                 concurrent_limit: int = 0, ssl_context=None):
         self.storage = storage
+        self._ssl = ssl_context   # optional (m)TLS — reference WithTLS/certify
         self.limiter = Limiter(rate_limit if rate_limit > 0 else float("inf"))
         self.concurrent_limit = concurrent_limit
         self.concurrent = 0
@@ -47,10 +48,10 @@ class UploadManager:
         app.router.add_get("/metrics", self._metrics)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, host, port)
+        site = web.TCPSite(self._runner, host, port, ssl_context=self._ssl)
         await site.start()
         self._port = site._server.sockets[0].getsockname()[1]
-        log.info("upload server up", port=self._port)
+        log.info("upload server up", port=self._port, tls=self._ssl is not None)
         return self._port
 
     @property

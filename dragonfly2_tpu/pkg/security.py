@@ -1,0 +1,43 @@
+"""TLS credentials for drpc and the HTTP piece/upload surfaces.
+
+Reference: pkg/rpc/credential.go — mTLS gRPC transport credentials loading
+cert/key/CA per binary, and certify-issued upload-server certs
+(client/daemon/upload/upload_manager.go WithTLS). stdlib ssl here: a
+server context (optionally requiring client certs = mTLS) and a client
+context (optionally presenting a cert, verifying the fabric CA).
+"""
+
+from __future__ import annotations
+
+import ssl
+
+
+def server_ssl_context(cert_file: str, key_file: str, *, ca_file: str = "",
+                       require_client_cert: bool = False) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_file, key_file)
+    if ca_file:
+        ctx.load_verify_locations(ca_file)
+    if require_client_cert:
+        ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx
+
+
+def client_ssl_context(*, cert_file: str = "", key_file: str = "",
+                       ca_file: str = "",
+                       verify: bool = True) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    if ca_file:
+        ctx.load_verify_locations(ca_file)
+        ctx.check_hostname = False       # fabric certs are per-host, not DNS
+    elif not verify:
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+    else:
+        # No explicit CA but verification on: anchor to the system store
+        # (a bare PROTOCOL_TLS_CLIENT context trusts NOTHING and would fail
+        # every handshake).
+        ctx.load_default_certs()
+    if cert_file and key_file:
+        ctx.load_cert_chain(cert_file, key_file)
+    return ctx

@@ -1,0 +1,154 @@
+"""Distributed tracing: spans, context propagation, JSONL export.
+
+Reference: OpenTelemetry with a Jaeger exporter wired per binary
+(cmd/dependency/dependency.go:263-271, --jaeger flag :73) and gRPC/gin
+auto-instrumentation (otelgrpc stats handlers, scheduler/scheduler.go:95).
+This is the dependency-free analog: W3C-traceparent-style context that
+rides drpc frame metadata (daemon → scheduler → seed peer), contextvar
+scoping, and a JSONL exporter (DF_TRACE_FILE) any trace UI can ingest.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import secrets
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+_current: contextvars.ContextVar["SpanContext | None"] = contextvars.ContextVar(
+    "df_trace_ctx", default=None)
+
+TRACEPARENT = "traceparent"
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    trace_id: str     # 32 hex
+    span_id: str      # 16 hex
+
+    def to_traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    @classmethod
+    def from_traceparent(cls, value: str) -> "SpanContext | None":
+        parts = value.split("-")
+        if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+            return None
+        return cls(trace_id=parts[1], span_id=parts[2])
+
+
+@dataclass
+class Span:
+    name: str
+    context: SpanContext
+    parent_id: str = ""
+    start: float = field(default_factory=time.time)
+    end: float = 0.0
+    attrs: dict = field(default_factory=dict)
+    status: str = "ok"
+
+    def set_attr(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+    def finish(self, status: str = "") -> None:
+        self.end = time.time()
+        if status:
+            self.status = status
+        _EXPORTER.export(self)
+
+    def to_json(self) -> dict:
+        return {"name": self.name, "trace_id": self.context.trace_id,
+                "span_id": self.context.span_id, "parent_id": self.parent_id,
+                "start": self.start, "end": self.end,
+                "duration_ms": round((self.end - self.start) * 1000, 3),
+                "attrs": self.attrs, "status": self.status}
+
+
+class Exporter:
+    """Ring buffer + optional JSONL file (DF_TRACE_FILE or set_file())."""
+
+    def __init__(self, capacity: int = 2048):
+        self.capacity = capacity
+        self.spans: list[Span] = []
+        self._path = os.environ.get("DF_TRACE_FILE", "")
+
+    def set_file(self, path: str) -> None:
+        self._path = path
+
+    def export(self, span: Span) -> None:
+        self.spans.append(span)
+        if len(self.spans) > self.capacity:
+            del self.spans[: len(self.spans) - self.capacity]
+        path = self._path or os.environ.get("DF_TRACE_FILE", "")
+        if path:
+            try:
+                with open(path, "a") as f:
+                    f.write(json.dumps(span.to_json()) + "\n")
+            except OSError:
+                pass
+
+    def find(self, name: str = "", trace_id: str = "") -> list[Span]:
+        return [s for s in self.spans
+                if (not name or s.name == name)
+                and (not trace_id or s.context.trace_id == trace_id)]
+
+    def clear(self) -> None:
+        self.spans.clear()
+
+
+_EXPORTER = Exporter()
+
+
+def exporter() -> Exporter:
+    return _EXPORTER
+
+
+def current() -> SpanContext | None:
+    return _current.get()
+
+
+@contextmanager
+def span(name: str, **attrs):
+    """Start a child of the current context (or a new root), scoped to the
+    block. The span exports on exit; exceptions mark status=error."""
+    parent = _current.get()
+    ctx = SpanContext(
+        trace_id=parent.trace_id if parent else secrets.token_hex(16),
+        span_id=secrets.token_hex(8))
+    sp = Span(name=name, context=ctx,
+              parent_id=parent.span_id if parent else "", attrs=dict(attrs))
+    token = _current.set(ctx)
+    try:
+        yield sp
+    except BaseException:
+        sp.finish("error")
+        raise
+    else:
+        sp.finish()
+    finally:
+        _current.reset(token)
+
+
+def inject() -> dict:
+    """Outgoing metadata for the current context ({} when not tracing)."""
+    ctx = _current.get()
+    return {TRACEPARENT: ctx.to_traceparent()} if ctx else {}
+
+
+@contextmanager
+def extract(metadata: dict | None, name: str, **attrs):
+    """Server side: adopt the caller's context from frame metadata and run
+    the handler inside a span (otelgrpc stats-handler analog)."""
+    ctx = None
+    if metadata and TRACEPARENT in metadata:
+        ctx = SpanContext.from_traceparent(metadata[TRACEPARENT])
+    token = _current.set(ctx) if ctx is not None else None
+    try:
+        with span(name, **attrs) as sp:
+            yield sp
+    finally:
+        if token is not None:
+            _current.reset(token)

@@ -10,7 +10,7 @@ import asyncio
 import itertools
 from typing import Any
 
-from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg import dflog, tracing
 from dragonfly2_tpu.pkg.errors import Code, DfError, error_from_wire
 from dragonfly2_tpu.pkg.types import NetAddr
 from dragonfly2_tpu.rpc.framing import (
@@ -87,9 +87,11 @@ class ClientStream:
 
 
 class Client:
-    def __init__(self, addr: NetAddr, connect_timeout: float = 5.0):
+    def __init__(self, addr: NetAddr, connect_timeout: float = 5.0,
+                 *, ssl_context=None):
         self.addr = addr
         self._connect_timeout = connect_timeout
+        self._ssl = ssl_context    # pkg/security.client_ssl_context for mTLS
         self._ids = itertools.count(1)
         self._fw: FrameWriter | None = None
         self._reader_task: asyncio.Task | None = None
@@ -105,7 +107,8 @@ class Client:
                 if self.addr.type == "tcp":
                     host, port = self.addr.host_port()
                     reader, writer = await asyncio.wait_for(
-                        asyncio.open_connection(host, port), self._connect_timeout
+                        asyncio.open_connection(host, port, ssl=self._ssl),
+                        self._connect_timeout
                     )
                 else:
                     reader, writer = await asyncio.wait_for(
@@ -179,7 +182,8 @@ class Client:
         call_id = next(self._ids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[call_id] = fut
-        await self._write(Frame(CALL, call_id, method=method, body=body), fw)
+        await self._write(Frame(CALL, call_id, method=method, body=body,
+                                md=tracing.inject() or None), fw)
         try:
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
@@ -193,7 +197,8 @@ class Client:
         call_id = next(self._ids)
         stream = ClientStream(call_id, fw)
         self._streams[call_id] = stream
-        await self._write(Frame(SOPEN, call_id, method=method, body=body), fw)
+        await self._write(Frame(SOPEN, call_id, method=method, body=body,
+                                md=tracing.inject() or None), fw)
         return stream
 
     async def ping(self, timeout: float = 3.0) -> bool:

@@ -43,6 +43,8 @@ class Frame:
     method: str = ""
     body: Any = None
     error: dict | None = None
+    # Call metadata (trace context, auth) — otel's gRPC metadata analog.
+    md: dict | None = None
 
     def pack(self) -> bytes:
         m: dict[str, Any] = {"t": self.type, "id": self.call_id}
@@ -52,6 +54,8 @@ class Frame:
             m["b"] = self.body
         if self.error is not None:
             m["e"] = self.error
+        if self.md:
+            m["md"] = self.md
         payload = msgpack.packb(m, use_bin_type=True)
         return struct.pack(">I", len(payload)) + payload
 
@@ -64,6 +68,7 @@ class Frame:
             method=m.get("m", ""),
             body=m.get("b"),
             error=m.get("e"),
+            md=m.get("md"),
         )
 
 

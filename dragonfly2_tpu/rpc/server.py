@@ -12,7 +12,7 @@ import os
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable
 
-from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg import dflog, tracing
 from dragonfly2_tpu.pkg.errors import Code, DfError
 from dragonfly2_tpu.pkg.types import NetAddr
 from dragonfly2_tpu.rpc.framing import (
@@ -50,6 +50,8 @@ class ServerStream:
     def __init__(self, call_id: int, writer: FrameWriter, open_body: Any):
         self.call_id = call_id
         self.open_body = open_body
+        self.md: dict | None = None      # open-frame metadata (trace ctx)
+        self.method = ""
         self._w = writer
         self._inbox: asyncio.Queue[Any] = asyncio.Queue()
         self._closed_by_peer = asyncio.Event()
@@ -96,10 +98,14 @@ class Server:
     def register_stream(self, method: str, handler: StreamHandler) -> None:
         self._stream[method] = handler
 
-    async def serve(self, addr: NetAddr) -> None:
+    async def serve(self, addr: NetAddr, *, ssl_context=None) -> None:
+        """``ssl_context`` (pkg/security.server_ssl_context) enables TLS on
+        TCP listeners; require_client_cert=True there makes it mTLS
+        (reference pkg/rpc/credential.go)."""
         if addr.type == "tcp":
             host, port = addr.host_port()
-            srv = await asyncio.start_server(self._on_conn, host, port)
+            srv = await asyncio.start_server(self._on_conn, host, port,
+                                             ssl=ssl_context)
         elif addr.type == "unix":
             sock_dir = os.path.dirname(addr.addr)
             if sock_dir:
@@ -164,6 +170,8 @@ class Server:
                         )
                         continue
                     stream = ServerStream(frame.call_id, fw, frame.body)
+                    stream.md = frame.md
+                    stream.method = frame.method
                     streams[frame.call_id] = stream
                     t = asyncio.ensure_future(
                         self._run_stream(handler, stream, RpcContext(peer_addr, conn_state), streams)
@@ -199,7 +207,9 @@ class Server:
             )
             return
         try:
-            result = await handler(frame.body, ctx)
+            with tracing.extract(frame.md, f"rpc.{frame.method}",
+                                 peer=ctx.peer_addr):
+                result = await handler(frame.body, ctx)
             await fw.write(Frame(RESULT, frame.call_id, body=result))
         except DfError as e:
             await fw.write(Frame(ERR, frame.call_id, error=e.to_wire()))
@@ -219,7 +229,9 @@ class Server:
         streams: dict[int, ServerStream],
     ) -> None:
         try:
-            await handler(stream, ctx)
+            with tracing.extract(stream.md, f"rpc.{stream.method or 'stream'}",
+                                 peer=ctx.peer_addr):
+                await handler(stream, ctx)
             await stream.close()
         except DfError as e:
             try:

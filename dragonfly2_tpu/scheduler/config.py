@@ -69,6 +69,9 @@ class SchedulerConfig:
     gc: GCConfig = field(default_factory=GCConfig)
     manager_addr: str = ""                 # manager drpc for registration
     cluster_id: int = 1
+    # Durable persistent-cache state (reference: Redis-backed
+    # scheduler/resource/persistentcache); ":memory:" = tests/dev.
+    persistent_cache_db: str = ":memory:"
     metrics_port: int = 0
     seed_peer_enabled: bool = True
 

@@ -41,6 +41,19 @@ class SchedulerServer:
         self.rpc.register_unary("Scheduler.LeavePeer", s.leave_peer)
         self.rpc.register_unary("Scheduler.AnnounceTask", s.announce_task)
         self.rpc.register_unary("Scheduler.StatTask", s.stat_task)
+        # Persistent cache family (reference scheduler_server_v2.go).
+        self.rpc.register_unary("Scheduler.UploadPersistentCacheTaskStarted",
+                                s.upload_persistent_cache_task_started)
+        self.rpc.register_unary("Scheduler.UploadPersistentCacheTaskFinished",
+                                s.upload_persistent_cache_task_finished)
+        self.rpc.register_unary("Scheduler.UploadPersistentCacheTaskFailed",
+                                s.upload_persistent_cache_task_failed)
+        self.rpc.register_unary("Scheduler.StatPersistentCacheTask",
+                                s.stat_persistent_cache_task)
+        self.rpc.register_unary("Scheduler.ListPersistentCacheTasks",
+                                s.list_persistent_cache_tasks)
+        self.rpc.register_unary("Scheduler.DeletePersistentCacheTask",
+                                s.delete_persistent_cache_task)
         self.rpc.register_unary("Scheduler.StatPeer", s.stat_peer)
         self.rpc.register_unary("Scheduler.ListHosts", s.list_hosts)
 

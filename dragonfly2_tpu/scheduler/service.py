@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import asyncio
 
-from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg import aio, dflog
 from dragonfly2_tpu.pkg.errors import Code, DfError
 from dragonfly2_tpu.pkg.fsm import TransitionError
 from dragonfly2_tpu.pkg.piece import PieceInfo
@@ -53,6 +53,11 @@ class SchedulerService:
         self.peers = PeerManager(ttl=gc.peer_ttl)
         self.scheduling = Scheduling(self.config.scheduling)
         self.seed_clients = SeedPeerClientPool()
+        from dragonfly2_tpu.scheduler.resource.persistentcache import (
+            PersistentCacheResource,
+        )
+
+        self.persistent = PersistentCacheResource(self.config.persistent_cache_db)
 
     # ------------------------------------------------------------------ #
     # resource resolution (reference handleResource :1457)
@@ -349,6 +354,19 @@ class SchedulerService:
         if task.fsm.can("download_succeeded"):
             task.fsm.event("download_succeeded")
         log.info("peer finished", peer=peer.id[:24], task=task.id[:16])
+        # Persistent-cache replica bookkeeping: a replication download that
+        # finished becomes a durable replica row (reference service_v2.go
+        # persistent cache peer state handling).
+        if self.persistent.get_task(task.id) is not None:
+            from dragonfly2_tpu.scheduler.resource.persistentcache import (
+                STATE_SUCCEEDED,
+            )
+
+            self.persistent.upsert_peer(peer.id, task.id, peer.host.id,
+                                        state=STATE_SUCCEEDED)
+            self.persistent.upsert_host(
+                peer.host.id, hostname=peer.host.hostname, ip=peer.host.ip,
+                port=peer.host.port, upload_port=peer.host.upload_port)
 
     def _handle_download_failed(self, msg: dict, task: Task, peer: Peer) -> None:
         self._fail_peer(peer)
@@ -414,6 +432,13 @@ class SchedulerService:
                     peer.fsm.event("leave")
                 self.peers.delete(pid)
         self.hosts.delete(host_id)
+        # A departing host takes its persistent replicas with it; restore
+        # the replica count elsewhere (reference: persistentcache host GC
+        # + reschedule).
+        affected = self.persistent.delete_peers_of_host(host_id)
+        self.persistent.delete_host(host_id)
+        for task_id in affected:
+            aio.spawn(self._ensure_replicas(task_id))
         return {"ok": True}
 
     async def leave_peer(self, body: dict, ctx: RpcContext) -> dict:
@@ -425,6 +450,149 @@ class SchedulerService:
             peer.fsm.event("leave")
         self.peers.delete(peer_id)
         return {"ok": True}
+
+    # ------------------------------------------------------------------ #
+    # persistent cache task family (reference service_v2.go:1580-1895)
+    # ------------------------------------------------------------------ #
+
+    async def upload_persistent_cache_task_started(self, body: dict,
+                                                   ctx: RpcContext) -> dict:
+        """An uploader begins importing a persistent cache task
+        (reference :1726 UploadPersistentCacheTaskStarted)."""
+        from dragonfly2_tpu.scheduler.resource import persistentcache as pc
+
+        task_id = body.get("task_id", "")
+        if not task_id:
+            raise DfError(Code.BadRequest, "task_id required")
+        h = body.get("host") or {}
+        host_id = h.get("id") or h.get("hostname", "unknown")
+        self.persistent.upsert_host(
+            host_id, hostname=h.get("hostname", ""), ip=h.get("ip", ""),
+            port=h.get("port", 0), upload_port=h.get("upload_port", 0))
+        self.persistent.upsert_task(
+            task_id, url=body.get("url", ""), tag=body.get("tag", ""),
+            application=body.get("application", ""),
+            piece_size=body.get("piece_size", 0),
+            content_length=body.get("content_length", -1),
+            total_piece_count=body.get("total_piece_count", -1),
+            replica_count=max(1, int(body.get("replica_count", 1))),
+            ttl=float(body.get("ttl", 0)),
+            digest=body.get("digest", ""),
+            state=pc.STATE_UPLOADING)
+        self.persistent.upsert_peer(body.get("peer_id", ""), task_id, host_id,
+                                    state=pc.STATE_UPLOADING)
+        return {"ok": True}
+
+    async def upload_persistent_cache_task_finished(self, body: dict,
+                                                    ctx: RpcContext) -> dict:
+        """Uploader finished; record the first replica and fan replication
+        triggers until replica_count is met (reference :1791 Finished +
+        the replica scheduling the Redis resource drives)."""
+        from dragonfly2_tpu.scheduler.resource import persistentcache as pc
+
+        task_id = body.get("task_id", "")
+        task = self.persistent.get_task(task_id)
+        if task is None:
+            raise DfError(Code.PeerTaskNotFound, f"persistent task {task_id} unknown")
+        self.persistent.upsert_task(
+            task_id, state=pc.STATE_SUCCEEDED,
+            content_length=body.get("content_length", task["content_length"]),
+            piece_size=body.get("piece_size", task["piece_size"]),
+            total_piece_count=body.get("total_piece_count",
+                                       task["total_piece_count"]))
+        h = body.get("host") or {}
+        host_id = h.get("id") or h.get("hostname", "unknown")
+        self.persistent.upsert_peer(body.get("peer_id", ""), task_id, host_id,
+                                    state=pc.STATE_SUCCEEDED)
+        # Replication runs in the background: N trigger RPCs (10s timeout
+        # each, possibly against dead hosts) must not stall — or fail — the
+        # uploader's Finished ack.
+        aio.spawn(self._ensure_replicas(task_id))
+        return {"ok": True}
+
+    async def upload_persistent_cache_task_failed(self, body: dict,
+                                                  ctx: RpcContext) -> dict:
+        """Upload failed: drop the half-registered task (reference :1855) —
+        but a failed RE-import of a task with live replicas must not erase
+        the healthy replica bookkeeping."""
+        from dragonfly2_tpu.scheduler.resource import persistentcache as pc
+
+        task_id = body.get("task_id", "")
+        if self.persistent.replica_count(task_id) > 0:
+            self.persistent.upsert_task(task_id, state=pc.STATE_SUCCEEDED)
+            self.persistent.delete_peer_if_not_succeeded(
+                body.get("peer_id", ""))
+        else:
+            self.persistent.delete_task(task_id)
+        return {"ok": True}
+
+    async def stat_persistent_cache_task(self, body: dict,
+                                         ctx: RpcContext) -> dict:
+        wire = self.persistent.task_wire((body or {}).get("task_id", ""))
+        if wire is None:
+            raise DfError(Code.PeerTaskNotFound, "persistent task not found")
+        return wire
+
+    async def list_persistent_cache_tasks(self, body: dict,
+                                          ctx: RpcContext) -> dict:
+        return {"tasks": [self.persistent.task_wire(t["task_id"])
+                          for t in self.persistent.list_tasks()]}
+
+    async def delete_persistent_cache_task(self, body: dict,
+                                           ctx: RpcContext) -> dict:
+        """Remove the task everywhere: fan Peer.DeleteTask to every holder,
+        then drop the rows (reference DeletePersistentCacheTask)."""
+        task_id = (body or {}).get("task_id", "")
+        deleted, failed = [], []
+        for p in self.persistent.peers_of(task_id):
+            host = self._persistent_host(p["host_id"])
+            if host is None:
+                continue
+            ok = await self.seed_clients.delete_task(host, task_id)
+            (deleted if ok else failed).append(p["host_id"])
+        self.persistent.delete_task(task_id)
+        self.tasks.delete(task_id)
+        return {"ok": not failed, "deleted": deleted, "failed": failed}
+
+    def _persistent_host(self, host_id: str):
+        """Address a persistent host via the live resource if announced,
+        else the durable snapshot (scheduler restarted since)."""
+        host = self.hosts.load(host_id)
+        if host is not None and host.port > 0:
+            return host
+        row = self.persistent.get_host(host_id)
+        if row is None or not row["port"]:
+            return None
+        return Host(row["host_id"], hostname=row["hostname"], ip=row["ip"],
+                    port=row["port"], upload_port=row["upload_port"])
+
+    async def _ensure_replicas(self, task_id: str) -> int:
+        """Fan download triggers to hosts without a replica until the
+        desired count is met. Returns the number of triggers fired."""
+        task = self.persistent.get_task(task_id)
+        if task is None or task["state"] != "succeeded":
+            return 0
+        have = {p["host_id"] for p in self.persistent.peers_of(task_id)}
+        want = task["replica_count"] - len(have)
+        if want <= 0:
+            return 0
+        candidates = [h for h in self.hosts.all()
+                      if h.port > 0 and h.id not in have]
+        candidates.sort(key=lambda h: len(h.peer_ids))
+        spec = {
+            "task_id": task_id, "url": task["url"], "tag": task["tag"],
+            "application": task["application"],
+            "digest": task["digest"],     # end-to-end verify on replicas
+            # Replicas PULL from peers; dfcache:// has no origin.
+            "seed": False, "disable_back_source": True,
+        }
+        fired = 0
+        for host in candidates[:want]:
+            if await self.seed_clients.trigger_download_task(host, spec):
+                fired += 1
+                log.info("replication triggered", task=task_id[:16],
+                         host=host.id)
+        return fired
 
     async def announce_task(self, body: dict, ctx: RpcContext) -> dict:
         """A daemon announces an already-complete local task (dfcache import,
@@ -469,8 +637,13 @@ class SchedulerService:
     # ------------------------------------------------------------------ #
 
     def gc(self) -> dict:
+        expired = self.persistent.expired_tasks()
+        for task in expired:
+            aio.spawn(self.delete_persistent_cache_task(
+                {"task_id": task["task_id"]}, None))
         return {
             "peers": len(self.peers.gc()),
             "tasks": len(self.tasks.gc()),
             "hosts": len(self.hosts.gc()),
+            "persistent_tasks": len(expired),
         }

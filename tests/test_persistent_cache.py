@@ -1,0 +1,180 @@
+"""Persistent cache tasks: durable records, replica management, RPC family.
+
+Reference: scheduler/resource/persistentcache (Redis-backed durability) +
+service_v2.go:1580-1895 (UploadPersistentCacheTask* family). Durability here
+is sqlite: records survive a scheduler restart, replicas are re-established
+when hosts leave, TTL-expired tasks are deleted everywhere.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+import pytest
+
+from dragonfly2_tpu.client import dfcache
+from dragonfly2_tpu.scheduler.config import SchedulerConfig
+from dragonfly2_tpu.scheduler.resource.persistentcache import (
+    PersistentCacheResource,
+    STATE_SUCCEEDED,
+)
+from dragonfly2_tpu.scheduler.server import SchedulerServer
+
+from tests.test_p2p_e2e import start_daemon
+
+
+async def _wait(predicate, timeout: float = 15.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(0.05)
+    return False
+
+
+def _sched_config(tmp_path) -> SchedulerConfig:
+    cfg = SchedulerConfig()
+    cfg.server.port = 0
+    cfg.scheduling.retry_interval = 0.05
+    cfg.scheduling.no_source_patience = 0.5
+    cfg.gc.interval = 3600
+    cfg.persistent_cache_db = str(tmp_path / "pc.sqlite")
+    return cfg
+
+
+# -- resource unit ----------------------------------------------------------
+
+def test_resource_survives_reopen(tmp_path):
+    path = str(tmp_path / "pc.sqlite")
+    r = PersistentCacheResource(path)
+    r.upsert_task("t1", url="dfcache://x", replica_count=3, state="succeeded")
+    r.upsert_peer("p1", "t1", "h1", state=STATE_SUCCEEDED)
+    r.upsert_host("h1", hostname="a", ip="1.2.3.4", port=9)
+    r.close()
+
+    r2 = PersistentCacheResource(path)
+    task = r2.get_task("t1")
+    assert task["replica_count"] == 3 and task["url"] == "dfcache://x"
+    assert r2.replica_count("t1") == 1
+    assert r2.get_host("h1")["ip"] == "1.2.3.4"
+    r2.close()
+
+
+def test_resource_host_departure_and_ttl(tmp_path):
+    r = PersistentCacheResource(":memory:")
+    r.upsert_task("t1", replica_count=2, ttl=0.001)
+    r.upsert_peer("p1", "t1", "h1", state=STATE_SUCCEEDED)
+    r.upsert_peer("p2", "t1", "h2", state=STATE_SUCCEEDED)
+    assert r.replica_count("t1") == 2
+    assert r.delete_peers_of_host("h1") == ["t1"]
+    assert r.replica_count("t1") == 1
+    import time
+
+    time.sleep(0.01)
+    assert [t["task_id"] for t in r.expired_tasks()] == ["t1"]
+    r.close()
+
+
+# -- end-to-end: import → auto-replication → restart → delete ---------------
+
+def test_persistent_import_replicates_and_survives_restart(run_async, tmp_path):
+    async def run():
+        cfg = _sched_config(tmp_path)
+        sched = SchedulerServer(cfg)
+        await sched.start()
+        d_a = await start_daemon(tmp_path, "pc-a", sched.port())
+        d_b = await start_daemon(tmp_path, "pc-b", sched.port())
+        sched2 = None
+        try:
+            payload = os.urandom(1024 * 1024)
+            src = tmp_path / "data.bin"
+            src.write_bytes(payload)
+            # Both daemons must be announced before replication fans out.
+            assert await _wait(lambda: len(sched.service.hosts.all()) >= 2)
+
+            cfg_a = dfcache.DfcacheConfig(
+                daemon_sock=d_a.config.unix_sock, cache_id="pc-entry")
+            result = await dfcache.import_file(
+                cfg_a, str(src), persistent=True, replica_count=2)
+            task_id = result["task_id"]
+
+            # The scheduler recorded the task and fired replication at B.
+            wire = sched.service.persistent.task_wire(task_id)
+            assert wire is not None and wire["replica_count"] == 2
+            assert await _wait(
+                lambda: sched.service.persistent.replica_count(task_id) >= 2)
+            # B actually holds the bytes now.
+            store_b = d_b.task_manager.storage.try_get(task_id)
+            assert store_b is not None and store_b.metadata.done
+
+            # Restart the scheduler with the same sqlite: state survives.
+            await sched.stop()
+            sched2 = SchedulerServer(cfg)
+            await sched2.start()
+            wire2 = sched2.service.persistent.task_wire(task_id)
+            assert wire2 is not None
+            assert wire2["current_replicas"] == 2
+
+            # Delete fans Peer.DeleteTask to the recorded holders.
+            resp = await sched2.service.delete_persistent_cache_task(
+                {"task_id": task_id}, None)
+            assert resp["ok"], resp
+            assert await _wait(
+                lambda: d_b.task_manager.storage.try_get(task_id) is None)
+            assert d_a.task_manager.storage.try_get(task_id) is None
+            assert sched2.service.persistent.get_task(task_id) is None
+        finally:
+            await d_a.stop()
+            await d_b.stop()
+            if sched2 is not None:
+                await sched2.stop()
+            else:
+                await sched.stop()
+
+    run_async(run())
+
+
+def test_replicas_restored_when_host_leaves(run_async, tmp_path):
+    async def run():
+        cfg = _sched_config(tmp_path)
+        sched = SchedulerServer(cfg)
+        await sched.start()
+        d_a = await start_daemon(tmp_path, "rep-a", sched.port())
+        d_b = await start_daemon(tmp_path, "rep-b", sched.port())
+        d_c = await start_daemon(tmp_path, "rep-c", sched.port())
+        try:
+            payload = os.urandom(512 * 1024)
+            src = tmp_path / "d.bin"
+            src.write_bytes(payload)
+            assert await _wait(lambda: len(sched.service.hosts.all()) >= 3)
+
+            cfg_a = dfcache.DfcacheConfig(
+                daemon_sock=d_a.config.unix_sock, cache_id="rep-entry")
+            result = await dfcache.import_file(
+                cfg_a, str(src), persistent=True, replica_count=2)
+            task_id = result["task_id"]
+            assert await _wait(
+                lambda: sched.service.persistent.replica_count(task_id) >= 2)
+            holders = {p["host_id"] for p in
+                       sched.service.persistent.peers_of(task_id)}
+            # Kill a replica host (not the uploader): leave_host must
+            # re-replicate onto the remaining free host.
+            victim = next(h for h in holders
+                          if h != sched.service.persistent.peers_of(
+                              task_id)[0]["host_id"])
+            replica_daemon = {d.config.host.hostname: d
+                             for d in (d_a, d_b, d_c)}
+            await sched.service.leave_host({"id": victim}, None)
+            assert await _wait(
+                lambda: sched.service.persistent.replica_count(task_id) >= 2)
+            new_holders = {p["host_id"] for p in
+                           sched.service.persistent.peers_of(task_id)}
+            assert victim not in new_holders
+        finally:
+            await d_a.stop()
+            await d_b.stop()
+            await d_c.stop()
+            await sched.stop()
+
+    run_async(run())

@@ -1,0 +1,89 @@
+"""Tracing: span scoping, cross-RPC propagation, JSONL export.
+
+Reference: otel wiring per binary (cmd/dependency/dependency.go:263-271)
+with gRPC auto-instrumentation — here the drpc frame metadata carries the
+traceparent and servers wrap handlers in child spans.
+"""
+
+from __future__ import annotations
+
+import json
+
+from dragonfly2_tpu.pkg import tracing
+from dragonfly2_tpu.pkg.types import NetAddr
+from dragonfly2_tpu.rpc import Client, Server
+
+
+def test_span_nesting_and_attrs():
+    tracing.exporter().clear()
+    with tracing.span("outer", kind="test") as outer:
+        assert tracing.current() is not None
+        trace_id = tracing.current().trace_id
+        with tracing.span("inner") as inner:
+            assert tracing.current().trace_id == trace_id
+            assert inner.parent_id == outer.context.span_id
+    assert tracing.current() is None
+    spans = tracing.exporter().find(trace_id=trace_id)
+    assert {s.name for s in spans} == {"outer", "inner"}
+    assert all(s.end >= s.start for s in spans)
+
+
+def test_error_status():
+    tracing.exporter().clear()
+    try:
+        with tracing.span("boom"):
+            raise ValueError("x")
+    except ValueError:
+        pass
+    assert tracing.exporter().find(name="boom")[0].status == "error"
+
+
+def test_traceparent_roundtrip():
+    ctx = tracing.SpanContext(trace_id="a" * 32, span_id="b" * 16)
+    back = tracing.SpanContext.from_traceparent(ctx.to_traceparent())
+    assert back == ctx
+    assert tracing.SpanContext.from_traceparent("garbage") is None
+
+
+def test_rpc_propagation(run_async):
+    async def run():
+        tracing.exporter().clear()
+        server = Server("traced")
+
+        async def handler(body, ctx):
+            cur = tracing.current()
+            return {"trace_id": cur.trace_id if cur else ""}
+
+        server.register_unary("T.Echo", handler)
+        await server.serve(NetAddr.tcp("127.0.0.1", 0))
+        cli = Client(NetAddr.tcp("127.0.0.1", server.port()))
+        try:
+            with tracing.span("client.op") as sp:
+                resp = await cli.call("T.Echo", {})
+            # The server handler ran inside OUR trace.
+            assert resp["trace_id"] == sp.context.trace_id
+            server_spans = tracing.exporter().find(name="rpc.T.Echo")
+            assert server_spans and \
+                server_spans[0].context.trace_id == sp.context.trace_id
+            # Untraced calls still work (no metadata).
+            resp2 = await cli.call("T.Echo", {})
+            assert resp2["trace_id"]  # server starts its own root
+        finally:
+            await cli.close()
+            await server.close()
+
+    run_async(run())
+
+
+def test_jsonl_export(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    tracing.exporter().set_file(path)
+    try:
+        with tracing.span("exported", x=1):
+            pass
+        rows = [json.loads(line) for line in open(path)]
+        assert rows[-1]["name"] == "exported"
+        assert rows[-1]["attrs"] == {"x": 1}
+        assert rows[-1]["duration_ms"] >= 0
+    finally:
+        tracing.exporter().set_file("")
