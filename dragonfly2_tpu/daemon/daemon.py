@@ -101,6 +101,7 @@ class Daemon:
         self.announcer: Announcer | None = None
         self.dynconfig = None  # manager-source scheduler resolution
         self.pex = None        # gossip peer exchange (started in start())
+        self.metrics = None    # Prometheus + /debug endpoint
         self._started = False
         self._peer_port = 0
         self.gc = GC(log)
@@ -234,6 +235,13 @@ class Daemon:
         if self.config.manager_addr:
             await self._resolve_schedulers_from_manager()
         self.task_manager.shaper.serve()
+        if self.config.metrics_port >= 0:
+            from dragonfly2_tpu.pkg.metrics_server import MetricsServer
+
+            # Loopback by default: /debug exposes live stacks; operators
+            # who want network scraping front it deliberately.
+            self.metrics = MetricsServer()
+            await self.metrics.serve("127.0.0.1", self.config.metrics_port)
         await self.rpc.serve_download(NetAddr.unix(self.config.unix_sock))
         if self.config.download.peer_port >= 0:  # -1 disables the peer service
             await self.rpc.serve_peer(
@@ -291,6 +299,8 @@ class Daemon:
         self.task_manager.shaper.stop()
         if self.pex is not None:
             await self.pex.stop()
+        if self.metrics is not None:
+            await self.metrics.close()
         if self.dynconfig is not None:
             await self.dynconfig.stop()
         if self.announcer is not None:

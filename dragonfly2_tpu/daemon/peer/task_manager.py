@@ -15,7 +15,7 @@ from typing import AsyncIterator
 from dragonfly2_tpu.daemon.peer.broker import PieceBroker, PieceEvent
 from dragonfly2_tpu.daemon.peer.piece_manager import PieceManager
 from dragonfly2_tpu.pkg import aio, dflog, idgen
-from dragonfly2_tpu.pkg.errors import Code, DfError
+from dragonfly2_tpu.pkg.errors import Code, DfError, describe
 from dragonfly2_tpu.pkg.piece import Range
 from dragonfly2_tpu.pkg.ratelimit import Limiter
 from dragonfly2_tpu.proto.common import UrlMeta
@@ -440,7 +440,7 @@ class TaskManager:
         except Exception as e:  # pragma: no cover - defensive
             log.error("file task crashed", exc_info=True)
             store.mark_invalid()
-            run.error = DfError(Code.UnknownError, str(e))
+            run.error = DfError(Code.UnknownError, describe(e))
             self.broker.publish(task_id, PieceEvent([], failed=True))
             yield FileTaskProgress(state="failed", task_id=task_id, peer_id=peer_id,
                                    error=run.error.to_wire())
@@ -511,9 +511,9 @@ class TaskManager:
             log.info("seed task complete", task_id=task_id[:16],
                      pieces=len(store.metadata.pieces))
         except Exception as e:
-            log.error("seed task failed", error=str(e))
+            log.error("seed task failed", error=describe(e))
             store.mark_invalid()
-            run.error = e if isinstance(e, DfError) else DfError(Code.UnknownError, str(e))
+            run.error = e if isinstance(e, DfError) else DfError(Code.UnknownError, describe(e))
             self.broker.publish(task_id, PieceEvent([], failed=True))
         finally:
             store.unpin()
@@ -642,7 +642,7 @@ class TaskManager:
         except Exception as e:  # pragma: no cover - defensive
             log.error("stream download crashed", exc_info=True)
             store.mark_invalid()
-            run.error = DfError(Code.UnknownError, str(e))
+            run.error = DfError(Code.UnknownError, describe(e))
             self.broker.publish(task_id, PieceEvent([], failed=True))
         finally:
             store.unpin()

@@ -68,6 +68,12 @@ class Code(enum.IntEnum):
     SourceRangeUnsupported = 9416
 
 
+def describe(e: BaseException) -> str:
+    """Never-empty error text: bare TimeoutError/CancelledError stringify
+    to '' which makes logs and wire errors useless."""
+    return str(e) or type(e).__name__
+
+
 class DfError(Exception):
     """Base coded error. Serializable across drpc.
 

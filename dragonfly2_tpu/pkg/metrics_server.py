@@ -1,0 +1,89 @@
+"""Standalone metrics + debug HTTP endpoint for any binary.
+
+Reference: Prometheus served per binary (scheduler/scheduler.go:219,
+manager/metrics, client daemon metrics) and the --pprof-port runtime
+dashboards (cmd/dependency/dependency.go:95-114). The /debug surface is
+the Python analog of pprof: live thread stacks and asyncio task dumps.
+
+Routes: GET /metrics (Prometheus text), GET /healthy,
+        GET /debug/stacks (all thread stacks), GET /debug/tasks (asyncio).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import sys
+import traceback
+
+from aiohttp import web
+
+from dragonfly2_tpu.pkg import dflog, metrics
+
+log = dflog.get("metrics_server")
+
+
+def _thread_stacks() -> str:
+    out = io.StringIO()
+    for thread_id, frame in sys._current_frames().items():
+        out.write(f"--- thread {thread_id} ---\n")
+        traceback.print_stack(frame, file=out)
+        out.write("\n")
+    return out.getvalue()
+
+
+def _task_dump() -> str:
+    out = io.StringIO()
+    try:
+        tasks = asyncio.all_tasks()
+    except RuntimeError:
+        return "no running loop\n"
+    for task in tasks:
+        out.write(f"--- {task.get_name()} "
+                  f"{'cancelled' if task.cancelled() else 'pending'} ---\n")
+        task.print_stack(file=out)
+        out.write("\n")
+    return out.getvalue()
+
+
+class MetricsServer:
+    def __init__(self):
+        self._runner: web.AppRunner | None = None
+        self._port = 0
+
+    async def serve(self, host: str, port: int) -> int:
+        app = web.Application()
+        app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/healthy", self._healthy)
+        app.router.add_get("/debug/stacks", self._stacks)
+        app.router.add_get("/debug/tasks", self._tasks)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self._port = site._server.sockets[0].getsockname()[1]
+        log.info("metrics server up", port=self._port)
+        return self._port
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        body, content_type = metrics.render()
+        # content_type carries params (version/charset); aiohttp's
+        # content_type kwarg rejects those — set the raw header.
+        return web.Response(body=body, headers={"Content-Type": content_type})
+
+    async def _healthy(self, request: web.Request) -> web.Response:
+        return web.json_response({"ok": True})
+
+    async def _stacks(self, request: web.Request) -> web.Response:
+        return web.Response(text=_thread_stacks())
+
+    async def _tasks(self, request: web.Request) -> web.Response:
+        return web.Response(text=_task_dump())

@@ -67,10 +67,11 @@ class TaskType(enum.IntEnum):
 
 @dataclass(frozen=True)
 class NetAddr:
-    """tcp/unix network address (reference pkg/dfnet/dfnet.go)."""
+    """tcp/unix/vsock network address (reference pkg/dfnet/dfnet.go;
+    vsock listener pkg/rpc/vsock.go for VM-guest daemons)."""
 
-    type: str  # "tcp" | "unix"
-    addr: str  # "host:port" or socket path
+    type: str  # "tcp" | "unix" | "vsock"
+    addr: str  # "host:port", socket path, or "cid:port"
 
     @classmethod
     def tcp(cls, host: str, port: int) -> "NetAddr":
@@ -80,11 +81,21 @@ class NetAddr:
     def unix(cls, path: str) -> "NetAddr":
         return cls("unix", path)
 
+    @classmethod
+    def vsock(cls, cid: int, port: int) -> "NetAddr":
+        return cls("vsock", f"{cid}:{port}")
+
     def host_port(self) -> tuple[str, int]:
         if self.type != "tcp":
             raise ValueError(f"{self} is not tcp")
         host, _, port = self.addr.rpartition(":")
         return host, int(port)
+
+    def cid_port(self) -> tuple[int, int]:
+        if self.type != "vsock":
+            raise ValueError(f"{self} is not vsock")
+        cid, _, port = self.addr.partition(":")
+        return int(cid), int(port)
 
     def __str__(self) -> str:
         return f"{self.type}://{self.addr}"

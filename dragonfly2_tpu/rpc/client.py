@@ -110,6 +110,28 @@ class Client:
                         asyncio.open_connection(host, port, ssl=self._ssl),
                         self._connect_timeout
                     )
+                elif self.addr.type == "vsock":
+                    import socket as pysocket
+
+                    if not hasattr(pysocket, "AF_VSOCK"):
+                        raise OSError("AF_VSOCK unsupported on this platform")
+                    if self._ssl is not None:
+                        # Silently downgrading a configured mTLS transport
+                        # to plaintext would be worse than failing.
+                        raise OSError("TLS over vsock is not supported")
+                    cid, port = self.addr.cid_port()
+                    sock = pysocket.socket(pysocket.AF_VSOCK,
+                                           pysocket.SOCK_STREAM)
+                    sock.setblocking(False)
+                    try:
+                        loop = asyncio.get_running_loop()
+                        await asyncio.wait_for(
+                            loop.sock_connect(sock, (cid, port)),
+                            self._connect_timeout)
+                        reader, writer = await asyncio.open_connection(sock=sock)
+                    except BaseException:
+                        sock.close()   # reconnect loops must not leak fds
+                        raise
                 else:
                     reader, writer = await asyncio.wait_for(
                         asyncio.open_unix_connection(self.addr.addr), self._connect_timeout

@@ -113,6 +113,18 @@ class Server:
             if os.path.exists(addr.addr):
                 os.unlink(addr.addr)
             srv = await asyncio.start_unix_server(self._on_conn, addr.addr)
+        elif addr.type == "vsock":
+            # VM-guest transport (reference pkg/rpc/vsock.go); AF_VSOCK is
+            # Linux-only and absent on some kernels — fail with a clear error.
+            import socket as pysocket
+
+            if not hasattr(pysocket, "AF_VSOCK"):
+                raise ValueError("AF_VSOCK unsupported on this platform")
+            cid, port = addr.cid_port()
+            sock = pysocket.socket(pysocket.AF_VSOCK, pysocket.SOCK_STREAM)
+            sock.bind((cid, port))
+            sock.setblocking(False)
+            srv = await asyncio.start_server(self._on_conn, sock=sock)
         else:
             raise ValueError(f"unsupported addr type {addr.type}")
         self._servers.append(srv)

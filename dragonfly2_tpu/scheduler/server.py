@@ -30,6 +30,7 @@ class SchedulerServer:
         self.announcer = None       # manager registration (set in start)
         self.dynconfig = None       # manager-fed cluster config + seed peers
         self.job_worker = None      # manager job-queue consumer (preheat etc.)
+        self.metrics = None         # Prometheus + /debug endpoint
         self._manager_retry: asyncio.Task | None = None
         self._stopped = asyncio.Event()
 
@@ -70,6 +71,12 @@ class SchedulerServer:
     async def start(self) -> None:
         """Non-blocking variant for embedding in tests."""
         await self.rpc.serve(NetAddr.tcp(self.config.server.host, self.config.server.port))
+        if self.config.metrics_port >= 0:
+            from dragonfly2_tpu.pkg.metrics_server import MetricsServer
+
+            # Loopback by default — /debug exposes live stacks.
+            self.metrics = MetricsServer()
+            await self.metrics.serve("127.0.0.1", self.config.metrics_port)
         self.gc.serve()
         if self.config.manager_addr:
             try:
@@ -149,5 +156,7 @@ class SchedulerServer:
         if self.announcer is not None:
             await self.announcer.stop()
         await self.service.seed_clients.close()
+        if self.metrics is not None:
+            await self.metrics.close()
         await self.rpc.close()
         self._stopped.set()

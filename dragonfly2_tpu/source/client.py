@@ -178,3 +178,22 @@ def _ensure_builtin_clients() -> None:
             _default.register("gs", GCSSourceClient())
     except Exception:
         pass
+    try:
+        from dragonfly2_tpu.source.clients.s3 import S3SourceClient
+
+        if S3SourceClient.available() and "s3" not in _default._clients:
+            _default.register("s3", S3SourceClient())
+    except Exception:
+        pass
+    if "hdfs" not in _default._clients:
+        from dragonfly2_tpu.source.clients.hdfs import HDFSSourceClient
+
+        _default.register("hdfs", HDFSSourceClient())
+    if "oras" not in _default._clients:
+        from dragonfly2_tpu.source.clients.oras import OrasSourceClient
+
+        import os
+
+        _default.register("oras", OrasSourceClient(
+            plain_http=os.environ.get("DF_ORAS_PLAIN_HTTP", "").lower()
+            in ("1", "true", "yes")))

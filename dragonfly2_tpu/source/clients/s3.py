@@ -1,0 +1,97 @@
+"""s3:// origin client.
+
+Reference: pkg/source/clients/s3protocol/s3.go (295 LoC over aws-sdk-go).
+Rides the SigV4 object-storage client (pkg/objectstorage/s3.py) so signing
+lives in one place. Endpoint/credentials from env:
+  DF_S3_ENDPOINT | AWS_ENDPOINT_URL (default https://s3.amazonaws.com)
+  AWS_ACCESS_KEY_ID / AWS_SECRET_ACCESS_KEY / AWS_REGION
+"""
+
+from __future__ import annotations
+
+import os
+from typing import AsyncIterator
+from urllib.parse import urlsplit
+
+from dragonfly2_tpu.pkg.errors import Code, SourceError
+from dragonfly2_tpu.pkg.objectstorage.s3 import S3ObjectStorage
+from dragonfly2_tpu.pkg.objectstorage.base import ObjectStorageError
+from dragonfly2_tpu.pkg.piece import Range
+from dragonfly2_tpu.source.client import (
+    ListEntry,
+    Request,
+    ResourceClient,
+    Response,
+)
+
+
+def _parse(url: str) -> tuple[str, str]:
+    parts = urlsplit(url)
+    if parts.scheme != "s3":
+        raise SourceError(f"not an s3 url: {url}", Code.UnsupportedProtocol)
+    return parts.netloc, parts.path.lstrip("/")
+
+
+class S3SourceClient(ResourceClient):
+    def __init__(self, backend: S3ObjectStorage | None = None):
+        self._backend = backend or S3ObjectStorage(
+            endpoint=os.environ.get("DF_S3_ENDPOINT")
+            or os.environ.get("AWS_ENDPOINT_URL", "https://s3.amazonaws.com"),
+            access_key=os.environ.get("AWS_ACCESS_KEY_ID", ""),
+            secret_key=os.environ.get("AWS_SECRET_ACCESS_KEY", ""),
+            region=os.environ.get("AWS_REGION", "us-east-1"))
+
+    @staticmethod
+    def available() -> bool:
+        """Explicit endpoint or credentials — otherwise the scheme stays
+        unregistered (same gating as the GCS client)."""
+        return bool(os.environ.get("DF_S3_ENDPOINT")
+                    or os.environ.get("AWS_ENDPOINT_URL")
+                    or os.environ.get("AWS_ACCESS_KEY_ID"))
+
+    async def download(self, request: Request) -> Response:
+        bucket, key = _parse(request.url)
+        start, end = -1, -1
+        content_length = -1
+        rng_header = request.header.get("Range", "")
+        try:
+            meta = await self._backend.get_object_metadata(bucket, key)
+        except ObjectStorageError as e:
+            raise SourceError(f"s3 stat {request.url}: {e}", Code.SourceNotFound)
+        if rng_header:
+            r = Range.parse_http(rng_header, meta.content_length)
+            start, end = r.start, r.start + r.length - 1
+            content_length = r.length
+        else:
+            content_length = meta.content_length
+        try:
+            chunks = await self._backend.get_object(bucket, key, start, end)
+        except ObjectStorageError as e:
+            raise SourceError(f"s3 get {request.url}: {e}",
+                              Code.BackToSourceAborted, temporary=True)
+        return Response(chunks, status=206 if rng_header else 200,
+                        content_length=content_length, support_range=True)
+
+    async def get_content_length(self, request: Request) -> int:
+        bucket, key = _parse(request.url)
+        try:
+            return (await self._backend.get_object_metadata(bucket, key)).content_length
+        except ObjectStorageError as e:
+            raise SourceError(f"s3 stat {request.url}: {e}", Code.SourceNotFound)
+
+    async def is_support_range(self, request: Request) -> bool:
+        return True
+
+    async def list_metadata(self, request: Request) -> list[ListEntry]:
+        bucket, prefix = _parse(request.url)
+        try:
+            metas = await self._backend.list_object_metadatas(
+                bucket, prefix=prefix.rstrip("/") + "/" if prefix else "")
+        except ObjectStorageError as e:
+            raise SourceError(f"s3 list {request.url}: {e}", Code.SourceNotFound)
+        return [ListEntry(url=f"s3://{bucket}/{m.key}", name=m.key,
+                          is_dir=False, content_length=m.content_length)
+                for m in metas]
+
+    async def close(self) -> None:
+        await self._backend.close()

@@ -1,0 +1,184 @@
+"""Hermetic multi-process E2E: real CLI processes on localhost.
+
+SURVEY §4's kind-replacement harness: one scheduler process, one seed
+daemon, N peer daemons — all spawned as ``python -m dragonfly2_tpu.cli.main``
+subprocesses against an in-test origin. Verification mirrors
+test/e2e/v2/dfget_test.go: sha256 of every output AND of the piece store on
+the client + seed by task ID.
+
+Marked ``slow``-ish (process spawns); kept to one scenario battery so the
+suite stays CI-friendly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import glob
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+from aiohttp import web
+
+from dragonfly2_tpu.pkg.piece import Range
+
+CONTENT = bytes(random.Random(77).randbytes(24 * 1024 * 1024))
+SHA = hashlib.sha256(CONTENT).hexdigest()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def _start_origin():
+    stats = {"streams": 0, "bytes": 0}
+
+    async def blob(request: web.Request) -> web.Response:
+        stats["streams"] += 1
+        rng = request.headers.get("Range")
+        if rng:
+            r = Range.parse_http(rng, len(CONTENT))
+            data = CONTENT[r.start:r.start + r.length]
+            stats["bytes"] += len(data)
+            return web.Response(status=206, body=data, headers={
+                "Accept-Ranges": "bytes",
+                "Content-Range":
+                    f"bytes {r.start}-{r.start + r.length - 1}/{len(CONTENT)}"})
+        stats["bytes"] += len(CONTENT)
+        return web.Response(body=CONTENT, headers={"Accept-Ranges": "bytes"})
+
+    app = web.Application()
+    app.router.add_get("/model.bin", blob)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    return runner, site._server.sockets[0].getsockname()[1], stats
+
+
+def _spawn(args: list[str], log_path: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # Child processes must not inherit the test's virtual-device JAX setup.
+    logf = open(log_path, "w")
+    return subprocess.Popen(
+        [sys.executable, "-m", "dragonfly2_tpu.cli.main", *args],
+        stdout=logf, stderr=subprocess.STDOUT, env=env)
+
+
+def _wait_sock(path: str, timeout: float = 20.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def _store_sha_by_task(work_home: str, task_id: str) -> str | None:
+    """sha256 of the piece store's data file for a task (e2e/v2
+    util/task.go CalculateSha256ByTaskID analog)."""
+    for meta_path in glob.glob(f"{work_home}/**/metadata.json", recursive=True):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        if meta["task_id"] == task_id and meta.get("done"):
+            data = os.path.join(os.path.dirname(meta_path), "data")
+            h = hashlib.sha256()
+            with open(data, "rb") as df:
+                while True:
+                    chunk = df.read(1 << 20)
+                    if not chunk:
+                        break
+                    h.update(chunk)
+            return h.hexdigest()
+    return None
+
+
+def test_multiprocess_fanout(run_async, tmp_path):
+    """scheduler + seed + 2 peer daemon PROCESSES; dfget from both peers:
+    outputs sha-verify, stores sha-verify on every node, origin served ~one
+    copy through the seed."""
+
+    async def run():
+        runner, origin_port, stats = await _start_origin()
+        sched_port = _free_port()
+        procs: list[subprocess.Popen] = []
+        homes = {name: str(tmp_path / name) for name in ("seed", "p1", "p2")}
+        try:
+            procs.append(_spawn(
+                ["scheduler", "--host", "127.0.0.1", "--port", str(sched_port)],
+                str(tmp_path / "sched.log")))
+            await asyncio.sleep(0)
+            procs.append(_spawn(
+                ["daemon", "--work-home", homes["seed"], "--seed-peer",
+                 "--scheduler", f"127.0.0.1:{sched_port}"],
+                str(tmp_path / "seed.log")))
+            procs.append(_spawn(
+                ["daemon", "--work-home", homes["p1"],
+                 "--scheduler", f"127.0.0.1:{sched_port}"],
+                str(tmp_path / "p1.log")))
+            procs.append(_spawn(
+                ["daemon", "--work-home", homes["p2"],
+                 "--scheduler", f"127.0.0.1:{sched_port}"],
+                str(tmp_path / "p2.log")))
+            for name in homes:
+                ok = await asyncio.to_thread(
+                    _wait_sock, f"{homes[name]}/run/dfdaemon.sock")
+                assert ok, open(tmp_path / f"{name}.log").read()[-2000:]
+
+            url = f"http://127.0.0.1:{origin_port}/model.bin"
+
+            def dfget(home: str, out: str) -> subprocess.Popen:
+                return _spawn(
+                    ["dfget", url, "-O", out, "--work-home", home,
+                     "--no-daemon", "--digest", f"sha256:{SHA}"],
+                    out + ".log")
+
+            outs = [str(tmp_path / "out1.bin"), str(tmp_path / "out2.bin")]
+            downloads = [dfget(homes["p1"], outs[0]),
+                         dfget(homes["p2"], outs[1])]
+            # Wait OFF the event loop: the origin server lives in this test
+            # process, so a blocking Popen.wait would starve it.
+            for p, out in zip(downloads, outs):
+                rc = await asyncio.to_thread(p.wait, 120)
+                assert rc == 0, open(out + ".log").read()[-2000:]
+
+            # Output integrity on both clients (dfget_test.go:26-76 style).
+            for out in outs:
+                with open(out, "rb") as f:
+                    assert hashlib.sha256(f.read()).hexdigest() == SHA
+
+            # Store integrity by task id on every node incl. the seed.
+            task_id = None
+            for meta_path in glob.glob(f"{homes['p1']}/**/metadata.json",
+                                       recursive=True):
+                task_id = json.load(open(meta_path))["task_id"]
+            assert task_id
+            for name, home in homes.items():
+                assert _store_sha_by_task(home, task_id) == SHA, name
+
+            # Origin bandwidth: the seed's fetch only (≲1.5 copies allows
+            # ranged back-source groups).
+            assert stats["bytes"] <= int(len(CONTENT) * 1.5), stats
+        finally:
+            for p in procs:
+                p.send_signal(signal.SIGTERM)
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            await runner.cleanup()
+
+    run_async(run())

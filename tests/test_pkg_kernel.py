@@ -323,3 +323,33 @@ def test_dflog_late_configure_adds_file_handler(tmp_path):
     dflog.get("late-test").info("after configure")
     root = logging.getLogger("df")
     assert any(isinstance(h, logging.handlers.RotatingFileHandler) for h in root.handlers)
+
+
+def test_metrics_server_endpoints(run_async):
+    """Prometheus + /debug surfaces (reference: per-binary metrics servers
+    scheduler.go:219 + pprof dashboards dependency.go:95-114)."""
+    import aiohttp
+
+    from dragonfly2_tpu.pkg import metrics
+    from dragonfly2_tpu.pkg.metrics_server import MetricsServer
+
+    async def run():
+        c = metrics.counter("test_metrics_server_hits", "test counter")
+        c.inc(3)
+        srv = MetricsServer()
+        port = await srv.serve("127.0.0.1", 0)
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(f"http://127.0.0.1:{port}/metrics") as r:
+                    text = await r.text()
+                    assert "test_metrics_server_hits_total 3" in text
+                async with sess.get(f"http://127.0.0.1:{port}/debug/stacks") as r:
+                    assert "thread" in await r.text()
+                async with sess.get(f"http://127.0.0.1:{port}/debug/tasks") as r:
+                    assert r.status == 200
+                async with sess.get(f"http://127.0.0.1:{port}/healthy") as r:
+                    assert (await r.json())["ok"]
+        finally:
+            await srv.close()
+
+    run_async(run())

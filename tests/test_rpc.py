@@ -189,3 +189,19 @@ def test_concurrent_calls(run_async):
         await srv.close()
 
     run_async(body())
+
+
+def test_vsock_netaddr_parsing():
+    """vsock addr plumbing (reference pkg/rpc/vsock.go); actual AF_VSOCK
+    IO needs a VM host-guest pair, so only the address surface is tested."""
+    from dragonfly2_tpu.pkg.types import NetAddr
+
+    a = NetAddr.vsock(3, 1024)
+    assert a.type == "vsock" and a.cid_port() == (3, 1024)
+    assert str(a) == "vsock://3:1024"
+    try:
+        a.host_port()
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("host_port must reject vsock")
