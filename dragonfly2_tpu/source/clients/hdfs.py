@@ -76,8 +76,16 @@ class HDFSSourceClient(ResourceClient):
         content_length = -1
         rng_header = request.header.get("Range", "")
         if rng_header:
-            status = await self._status(request)
-            r = Range.parse_http(rng_header, status["length"])
+            # Explicit 'bytes=a-b' parses without the file length; only
+            # suffix/open-ended forms cost the namenode a GETFILESTATUS
+            # (piece groups always send explicit ranges — no extra RTT).
+            try:
+                r = Range.parse_http(rng_header)
+            except ValueError:
+                r = None
+            if r is None or r.length < 0:
+                status = await self._status(request)
+                r = Range.parse_http(rng_header, status["length"])
             url += f"&offset={r.start}&length={r.length}"
             content_length = r.length
         sess = await self._sess()

@@ -47,6 +47,10 @@ class OrasSourceClient(ResourceClient):
         self._session: aiohttp.ClientSession | None = None
         self._session_loop = None
         self._tokens: dict[str, str] = {}   # registry/repo → bearer token
+        # url → (registry, repo, layer descriptor): ranged piece groups must
+        # not re-resolve the manifest per piece (tags are mutable, but one
+        # resolution per client per artifact matches the reference's pull).
+        self._layers: dict[str, tuple[str, str, dict]] = {}
 
     async def _sess(self) -> aiohttp.ClientSession:
         import asyncio
@@ -62,8 +66,9 @@ class OrasSourceClient(ResourceClient):
             await self._session.close()
 
     def _base(self, registry: str) -> str:
-        scheme = "http" if (self._plain_http or ":" in registry
-                            and not registry.endswith(":443")) else "https"
+        # Only the explicit flag selects cleartext — inferring it from a
+        # custom port would silently leak bearer tokens to a MITM.
+        scheme = "http" if self._plain_http else "https"
         return f"{scheme}://{registry}/v2"
 
     async def _auth_header(self, registry: str, repo: str) -> dict[str, str]:
@@ -121,7 +126,10 @@ class OrasSourceClient(ResourceClient):
 
     async def _resolve_layer(self, request: Request) -> tuple[str, str, dict]:
         """(registry, repo, layer_descriptor) for the artifact's first layer
-        (reference oras.go fetches the single file layer)."""
+        (reference oras.go fetches the single file layer); cached per URL."""
+        cached = self._layers.get(request.url)
+        if cached is not None:
+            return cached
         registry, repo, tag = _parse(request.url)
         resp = await self._get(registry, repo, f"manifests/{tag}",
                                {"Accept": _MANIFEST_ACCEPT}, timeout=30.0)
@@ -140,7 +148,9 @@ class OrasSourceClient(ResourceClient):
         if not layers:
             raise SourceError(f"oras artifact has no layers: {request.url}",
                               Code.SourceNotFound)
-        return registry, repo, layers[0]
+        resolved = (registry, repo, layers[0])
+        self._layers[request.url] = resolved
+        return resolved
 
     async def download(self, request: Request) -> Response:
         registry, repo, layer = await self._resolve_layer(request)

@@ -70,7 +70,10 @@ async def _start_origin():
 def _spawn(args: list[str], log_path: str) -> subprocess.Popen:
     env = dict(os.environ)
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    # Child processes must not inherit the test's virtual-device JAX setup.
+    # Child processes must not inherit the test's virtual-device JAX setup
+    # (8 CPU devices per daemon = needless threads/memory in an E2E).
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
     logf = open(log_path, "w")
     return subprocess.Popen(
         [sys.executable, "-m", "dragonfly2_tpu.cli.main", *args],
