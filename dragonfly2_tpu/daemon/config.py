@@ -103,6 +103,10 @@ class PexOption:
     enabled: bool = False
     port: int = 0                   # UDP gossip port, 0 = ephemeral
     seeds: list[str] = field(default_factory=list)  # "host:port" bootstrap
+    # Shared cluster secret: when set, every gossip datagram carries an
+    # HMAC and unauthenticated packets are dropped (the role memberlist's
+    # cluster encryption key plays in the reference).
+    secret: str = ""
 
 
 @dataclass

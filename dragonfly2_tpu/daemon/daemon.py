@@ -258,7 +258,8 @@ class Daemon:
             self.pex = PeerExchange(
                 ip=self.config.host.ip,
                 peer_port=self.rpc.peer_server.port() if self.rpc.peer_server._servers else 0,
-                upload_port=self.upload.port)
+                upload_port=self.upload.port,
+                secret=self.config.pex.secret)
             await self.pex.start(self.config.pex.port, self.config.pex.seeds)
             self.task_manager.pex = self.pex
             # Gossip everything already complete on disk (restart recovery).

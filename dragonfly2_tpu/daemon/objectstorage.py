@@ -164,7 +164,14 @@ class ObjectStorageService:
             raise web.HTTPBadGateway(text=f"p2p fetch failed: {e}")
         rng = attrs.get("range")
         total = attrs.get("content_length", -1)
-        if rng is not None:
+        if rng is not None and total < 0:
+            # Ranged GET against an unknown-length origin (chunked source):
+            # the range resolved, so the slice is satisfiable — stream it
+            # with an unknown-total Content-Range rather than a bogus 416.
+            resp = web.StreamResponse(status=206, headers={
+                "Content-Range":
+                    f"bytes {rng.start}-{rng.start + rng.length - 1}/*"})
+        elif rng is not None:
             resp_len = min(rng.length, max(total - rng.start, 0))
             if resp_len <= 0:
                 await body_iter.aclose()

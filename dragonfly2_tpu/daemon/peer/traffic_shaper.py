@@ -47,7 +47,11 @@ class TrafficShaper:
                  algorithm: str = TYPE_PLAIN,
                  sampling_interval: float = DEFAULT_SAMPLING_INTERVAL):
         if algorithm not in (TYPE_PLAIN, TYPE_SAMPLING):
-            raise ValueError(f"unknown traffic shaper algorithm {algorithm!r}")
+            # A config typo must not stop the daemon: fall back to the plain
+            # shaper like the reference (traffic_shaper.go:59).
+            log.warning("unknown traffic shaper algorithm, using plain",
+                        algorithm=algorithm)
+            algorithm = TYPE_PLAIN
         self.algorithm = algorithm
         self.total_rate = total_rate
         self.sampling_interval = sampling_interval

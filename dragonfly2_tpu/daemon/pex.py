@@ -19,6 +19,8 @@ Wire (msgpack over UDP):
 from __future__ import annotations
 
 import asyncio
+import hashlib
+import hmac as hmac_mod
 import random
 import time
 import uuid
@@ -72,6 +74,9 @@ class _Proto(asyncio.DatagramProtocol):
         self.pex = pex
 
     def datagram_received(self, data: bytes, addr) -> None:
+        data = self.pex._authenticate(data)
+        if data is None:
+            return
         try:
             msg = msgpack.unpackb(data, raw=False)
         except Exception:
@@ -83,12 +88,15 @@ class PeerExchange:
     """One gossip endpoint per daemon."""
 
     def __init__(self, *, ip: str, peer_port: int = 0, upload_port: int = 0,
-                 node_id: str = "", gossip_interval: float = GOSSIP_INTERVAL):
+                 node_id: str = "", gossip_interval: float = GOSSIP_INTERVAL,
+                 secret: str | bytes = ""):
         self.node_id = node_id or uuid.uuid4().hex[:16]
         self.ip = ip
         self.peer_port = peer_port
         self.upload_port = upload_port
         self.gossip_interval = gossip_interval
+        self.secret = (secret.encode() if isinstance(secret, str) else
+                       bytes(secret))
         self.incarnation = int(time.time())
         self.heartbeat = 0
         self._seeds: list[tuple[str, int]] = []
@@ -192,6 +200,30 @@ class PeerExchange:
                 + [me.to_wire()],
                 "tasks": tasks}
 
+    # Gossip authentication: with a shared secret configured, every
+    # datagram is MAC'd (sha256 HMAC, 16-byte tag) and unauthenticated or
+    # forged packets are dropped on receipt — membership and possession
+    # state can then only be injected by secret holders.
+    _MAC_LEN = 16
+
+    def _seal(self, data: bytes) -> bytes:
+        if not self.secret:
+            return data
+        mac = hmac_mod.new(self.secret, data, hashlib.sha256).digest()
+        return mac[: self._MAC_LEN] + data
+
+    def _authenticate(self, data: bytes) -> bytes | None:
+        if not self.secret:
+            return data
+        if len(data) <= self._MAC_LEN:
+            return None
+        mac, payload = data[: self._MAC_LEN], data[self._MAC_LEN:]
+        want = hmac_mod.new(self.secret, payload,
+                            hashlib.sha256).digest()[: self._MAC_LEN]
+        if not hmac_mod.compare_digest(mac, want):
+            return None
+        return payload
+
     def _send(self, msg: dict, addr: tuple[str, int]) -> None:
         if self._transport is None:
             return
@@ -206,7 +238,7 @@ class PeerExchange:
             slim["members"] = members[:200]
             data = msgpack.packb(slim, use_bin_type=True)
         try:
-            self._transport.sendto(data, addr)
+            self._transport.sendto(self._seal(data), addr)
         except OSError:
             pass
 

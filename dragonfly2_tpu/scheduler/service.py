@@ -641,6 +641,16 @@ class SchedulerService:
         for task in expired:
             aio.spawn(self.delete_persistent_cache_task(
                 {"task_id": task["task_id"]}, None))
+        # Replication repair: a trigger whose download later failed never
+        # created a peer row, so re-check every succeeded task each GC pass
+        # and top up under-replicated ones (_ensure_replicas no-ops at
+        # quota).
+        expired_ids = {t["task_id"] for t in expired}
+        for task in self.persistent.list_tasks(state="succeeded"):
+            if (task["task_id"] not in expired_ids
+                    and self.persistent.replica_count(task["task_id"])
+                    < task["replica_count"]):
+                aio.spawn(self._ensure_replicas(task["task_id"]))
         return {
             "peers": len(self.peers.gc()),
             "tasks": len(self.tasks.gc()),

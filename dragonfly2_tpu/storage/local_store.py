@@ -345,22 +345,34 @@ class LocalTaskStore:
                     if r.digest.startswith(pkgdigest.ALGORITHM_CRC32C + ":")]
         bad: list[int] = []
         native = _native()
+        checked: set[int] = set()
         if native is not None and crc_recs:
             fd = self._ensure_fd()
-            crcs = native.hash_pieces_crc(
-                fd, [r.offset for r in crc_recs], [r.size for r in crc_recs],
-                threads=threads)
-            for r, crc in zip(crc_recs, crcs):
-                if f"{pkgdigest.ALGORITHM_CRC32C}:{crc:08x}" != r.digest:
-                    bad.append(r.num)
-            checked = {r.num for r in crc_recs}
-        else:
-            checked = set()
+            try:
+                crcs = native.hash_pieces_crc(
+                    fd, [r.offset for r in crc_recs],
+                    [r.size for r in crc_recs], threads=threads)
+            except OSError:
+                # Truncated/unreadable data file: the native batch hasher
+                # fails whole; fall through to the per-piece Python path,
+                # which reports short reads as bad pieces instead of
+                # crashing the sweep.
+                pass
+            else:
+                for r, crc in zip(crc_recs, crcs):
+                    if f"{pkgdigest.ALGORITHM_CRC32C}:{crc:08x}" != r.digest:
+                        bad.append(r.num)
+                checked = {r.num for r in crc_recs}
         for r in recs:
             if r.num in checked or not r.digest:
                 continue
             d = pkgdigest.parse(r.digest)
-            actual = pkgdigest.hash_bytes(d.algorithm, self.read_piece(r.num))
+            try:
+                data = self.read_piece(r.num)
+            except (StorageError, OSError):
+                bad.append(r.num)  # short read / unreadable = bad piece
+                continue
+            actual = pkgdigest.hash_bytes(d.algorithm, data)
             if actual.encoded != d.encoded:
                 bad.append(r.num)
         return sorted(bad)

@@ -135,3 +135,17 @@ def test_store_recorded_piece_never_corrupted_by_bad_rewrite(tmp_path):
         store.write_piece(0, corrupt, expected_digest=str(d))
     assert store.read_piece(0) == good
     assert store.reverify_pieces() == []
+
+
+def test_store_reverify_survives_truncated_file(tmp_path):
+    """A truncated data file must be reported as bad pieces, not crash the
+    sweep with the native batch hasher's -EIO (ADVICE round 1)."""
+    store = _make_store(tmp_path)
+    blobs = [os.urandom(4096) for _ in range(4)]
+    for i, b in enumerate(blobs):
+        d = pkgdigest.hash_bytes(pkgdigest.ALGORITHM_CRC32C, b)
+        store.write_piece(i, b, expected_digest=str(d))
+    path = os.path.join(store.dir, "data")
+    with open(path, "r+b") as f:
+        f.truncate(2 * 4096 + 100)  # piece 2 short, piece 3 gone
+    assert store.reverify_pieces(threads=2) == [2, 3]

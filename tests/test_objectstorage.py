@@ -416,3 +416,42 @@ def test_s3_backend_file_like_put(run_async, tmp_path):
             await runner.cleanup()
 
     run_async(run())
+
+
+def test_gateway_ranged_get_unknown_length_origin(run_async, tmp_path):
+    """Ranged GET whose origin never reported a total length (chunked
+    source): the resolved slice must stream as 206 with an unknown-total
+    Content-Range, not a spurious 416 (ADVICE round 1)."""
+    import aiohttp
+
+    from dragonfly2_tpu.pkg.piece import Range
+
+    payload = os.urandom(1024)
+
+    class ChunkedTransport:
+        async def fetch(self, url, headers):
+            rng = Range.parse_http(headers["Range"], -1)
+
+            async def body():
+                yield payload[rng.start:rng.start + rng.length]
+
+            return {"range": rng, "content_length": -1}, body()
+
+    async def run():
+        backend = FSObjectStorage(root=str(tmp_path / "buckets"))
+        await backend.create_bucket("data")
+        await backend.put_object("data", "blob", payload)
+        svc = ObjectStorageService(backend, ChunkedTransport())
+        port = await svc.serve("127.0.0.1", 0)
+        try:
+            async with aiohttp.ClientSession() as http:
+                async with http.get(
+                        f"http://127.0.0.1:{port}/buckets/data/objects/blob",
+                        headers={"Range": "bytes=100-199"}) as resp:
+                    assert resp.status == 206
+                    assert resp.headers["Content-Range"] == "bytes 100-199/*"
+                    assert await resp.read() == payload[100:200]
+        finally:
+            await svc.close()
+
+    run_async(run())

@@ -178,3 +178,39 @@ def test_replicas_restored_when_host_leaves(run_async, tmp_path):
             await sched.stop()
 
     run_async(run())
+
+
+def test_gc_repairs_under_replication(run_async):
+    """A replication trigger whose download failed leaves the task under-
+    replicated with no retry scheduled; the GC pass must re-check succeeded
+    tasks and top them up (ADVICE round 1, service.py _ensure_replicas)."""
+    from dragonfly2_tpu.scheduler.service import SchedulerService
+    from dragonfly2_tpu.scheduler.resource import Host
+
+    async def run():
+        svc = SchedulerService()
+        svc.persistent.upsert_task(
+            "t-under", url="dfcache://x", replica_count=2, state="succeeded",
+            tag="", application="", digest="")
+        svc.persistent.upsert_peer("p1", "t-under", "h1", state="succeeded")
+        svc.hosts.store(Host("h2", ip="10.0.0.2", port=8000, upload_port=9000))
+
+        fired = []
+
+        async def fake_trigger(host, spec):
+            fired.append((host.id, spec["task_id"]))
+            return True
+
+        svc.seed_clients.trigger_download_task = fake_trigger
+        svc.gc()
+        await asyncio.sleep(0.1)  # let the spawned repair run
+        assert fired == [("h2", "t-under")]
+
+        # At quota: no repair fires.
+        svc.persistent.upsert_peer("p2", "t-under", "h2", state="succeeded")
+        fired.clear()
+        svc.gc()
+        await asyncio.sleep(0.1)
+        assert fired == []
+
+    run_async(run())

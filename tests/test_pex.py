@@ -193,3 +193,35 @@ def test_stale_holders_fall_back_to_source(run_async, tmp_path):
             await runner.cleanup()
 
     run_async(run())
+
+
+def test_gossip_hmac_auth(run_async):
+    """With a shared secret set, authenticated nodes converge while forged
+    (secretless) datagrams are dropped — ADVICE round 1: unauthenticated
+    UDP let any sender inject membership/possession state."""
+    async def run():
+        a = PeerExchange(ip="127.0.0.1", peer_port=1, gossip_interval=0.1,
+                         secret="cluster-key")
+        b = PeerExchange(ip="127.0.0.1", peer_port=2, gossip_interval=0.1,
+                         secret="cluster-key")
+        intruder = PeerExchange(ip="127.0.0.1", peer_port=3,
+                                gossip_interval=0.1)  # no secret
+        try:
+            port_a = await a.start(0)
+            await b.start(0, seeds=[f"127.0.0.1:{port_a}"])
+            assert await _wait(lambda: len(a.members) == 1 and len(b.members) == 1)
+
+            await intruder.start(0, seeds=[f"127.0.0.1:{port_a}"])
+            intruder.add_task("forged-task")
+            await asyncio.sleep(0.5)
+            # Unauthenticated joins/pings never entered the cluster view.
+            assert len(a.members) == 1 and len(b.members) == 1
+            assert a.find_holders("forged-task") == []
+            # And the intruder learned nothing either (acks are MAC'd).
+            assert len(intruder.members) == 0
+        finally:
+            await a.stop()
+            await b.stop()
+            await intruder.stop()
+
+    run_async(run())

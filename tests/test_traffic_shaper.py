@@ -88,9 +88,10 @@ def test_task_limiter_tracks_window(run_async):
     run_async(run())
 
 
-def test_bad_algorithm_rejected():
-    with pytest.raises(ValueError):
-        TrafficShaper(100, algorithm="bogus")
+def test_bad_algorithm_falls_back_to_plain():
+    # A typo'd algorithm warns and degrades to plain instead of failing
+    # daemon startup (reference traffic_shaper.go:59).
+    assert TrafficShaper(100, algorithm="bogus").algorithm == "plain"
 
 
 def test_window_not_double_counted_for_oversize_requests(run_async):
