@@ -82,6 +82,17 @@ class ProxyOption:
     rules: list[dict] = field(default_factory=list)  # {regex, use_dragonfly, direct}
     white_list_ports: list[int] = field(default_factory=lambda: [443, 80])
     max_concurrency: int = 0
+    # HTTPS interception (reference proxy.go:471 handleHTTPS +
+    # proxy_sni.go): terminate CONNECT tunnels with CA-forged leaf certs
+    # so HTTPS registry pulls ride P2P. With empty cert paths a CA is
+    # generated and persisted under the daemon work home ("ca/").
+    hijack_https: bool = False
+    ca_cert: str = ""               # PEM path of operator-supplied CA cert
+    ca_key: str = ""                # PEM path of its private key
+    hijack_hosts: list[str] = field(default_factory=list)  # regexes, [] = all
+    sni_enabled: bool = False       # direct-TLS SNI listener
+    sni_port: int = 0
+    sni_hijack: bool = False        # terminate+serve instead of splice
 
 
 @dataclass

@@ -8,6 +8,7 @@ Serve (:400-710) starts them; Stop (:711) tears down.
 from __future__ import annotations
 
 import asyncio
+import os
 
 from dragonfly2_tpu.daemon.announcer import Announcer
 from dragonfly2_tpu.daemon.config import DaemonConfig
@@ -81,11 +82,20 @@ class Daemon:
             from dragonfly2_tpu.daemon.transport import P2PTransport, rules_from_config
 
             rules = rules_from_config(config.proxy.rules)
+            ca = None
+            if config.proxy.hijack_https or config.proxy.sni_hijack:
+                from dragonfly2_tpu.pkg.certify import CertAuthority
+
+                ca = CertAuthority.load_or_generate(
+                    config.proxy.ca_cert, config.proxy.ca_key,
+                    persist_dir=os.path.join(config.work_home or ".", "ca"))
             self.proxy = Proxy(
                 P2PTransport(self.task_manager, rules=rules),
                 registry_mirror=config.proxy.registry_mirror,
                 max_concurrency=config.proxy.max_concurrency,
-                white_list_ports=config.proxy.white_list_ports)
+                white_list_ports=config.proxy.white_list_ports,
+                cert_authority=ca,
+                hijack_hosts=config.proxy.hijack_hosts)
         self.object_storage = None
         if config.object_storage.enabled:
             from dragonfly2_tpu.daemon.objectstorage import ObjectStorageService
@@ -249,6 +259,10 @@ class Daemon:
         await self.upload.serve(self.config.host.ip, self.config.upload.port)
         if self.proxy is not None:
             await self.proxy.serve(self.config.host.ip, self.config.proxy.port)
+            if self.config.proxy.sni_enabled:
+                await self.proxy.serve_sni(
+                    self.config.host.ip, self.config.proxy.sni_port,
+                    hijack=self.config.proxy.sni_hijack)
         if self.object_storage is not None:
             await self.object_storage.serve(self.config.host.ip,
                                             self.config.object_storage.port)

@@ -1,20 +1,32 @@
-"""HTTP forward proxy + registry mirror over the P2P fabric.
+"""HTTP(S) forward proxy + registry mirror over the P2P fabric.
 
 Reference: client/daemon/proxy/proxy.go — ServeHTTP (:301), CONNECT tunnel
-(:471 handleHTTPS; SNI/cert-hijack collapses to a plain relay here — TLS
-interception needs a CA which the TPU deployment doesn't ship),
+with TLS hijack (:471 handleHTTPS: terminate TLS with a CA-forged leaf
+cert so HTTPS registry pulls ride P2P), SNI proxy (proxy_sni.go),
 mirrorRegistry (:585), shouldUseDragonfly rules (:662-699), basic auth
 (:294), max-concurrency gate (:195) and white-listed ports.
 
 Implementation is a raw asyncio server (not aiohttp) because CONNECT
 tunnelling needs the bare socket. GETs that match the rules are served from
 stream peer tasks via the transport; everything else passes through.
+
+HTTPS interception: with a ``CertAuthority`` configured, CONNECT tunnels to
+matching hosts are answered 200 and the client side is upgraded to TLS
+using a leaf certificate forged for the target host; the decrypted requests
+then run through the same rule engine, so container-image blob pulls hit
+the P2P fabric instead of tunnelling blindly to origin. Hosts outside
+``hijack_hosts`` keep the blind relay. A separate SNI listener
+(``serve_sni``) accepts direct TLS connections (no CONNECT), routing by
+ClientHello SNI — terminate-and-serve when hijacking, peek-and-splice
+passthrough otherwise.
 """
 
 from __future__ import annotations
 
 import asyncio
 import base64
+import re
+import ssl as ssl_mod
 from urllib.parse import urljoin, urlsplit
 
 import aiohttp
@@ -32,6 +44,35 @@ _HOP_HEADERS = {"connection", "proxy-connection", "keep-alive", "te", "trailer",
                 "transfer-encoding", "upgrade", "proxy-authorization"}
 
 
+def parse_sni(record: bytes) -> str | None:
+    """Extract the server_name from a raw TLS ClientHello record
+    (RFC 8446 §4.1.2 + RFC 6066 §3). Returns None on anything malformed —
+    the caller treats that as 'no SNI'."""
+    try:
+        if record[0] != 0x16 or record[5] != 0x01:  # handshake / ClientHello
+            return None
+        i = 9                      # record(5) + handshake type/len(4)
+        i += 2 + 32                # client version + random
+        i += 1 + record[i]         # session id
+        cs_len = int.from_bytes(record[i:i + 2], "big")
+        i += 2 + cs_len            # cipher suites
+        i += 1 + record[i]         # compression methods
+        ext_end = i + 2 + int.from_bytes(record[i:i + 2], "big")
+        i += 2
+        while i + 4 <= ext_end:
+            ext_type = int.from_bytes(record[i:i + 2], "big")
+            ext_len = int.from_bytes(record[i + 2:i + 4], "big")
+            i += 4
+            if ext_type == 0:      # server_name
+                # list len(2) + type(1) + name len(2) + name
+                name_len = int.from_bytes(record[i + 3:i + 5], "big")
+                return record[i + 5:i + 5 + name_len].decode("idna")
+            i += ext_len
+    except (IndexError, UnicodeError):
+        pass
+    return None
+
+
 def _hget(headers: dict[str, str], name: str, default: str = "") -> str:
     """Case-insensitive header lookup (HTTP/2 hops lowercase names)."""
     lname = name.lower()
@@ -45,16 +86,24 @@ class Proxy:
     def __init__(self, transport: P2PTransport, *, registry_mirror: str = "",
                  basic_auth: tuple[str, str] | None = None,
                  max_concurrency: int = 0,
-                 white_list_ports: list[int] | None = None):
+                 white_list_ports: list[int] | None = None,
+                 cert_authority=None,
+                 hijack_hosts: list[str] | None = None):
         self.transport = transport
         self.registry_mirror = registry_mirror.rstrip("/")
         self.basic_auth = basic_auth
         self.max_concurrency = max_concurrency
         self.white_list_ports = white_list_ports or []
+        # TLS interception: a pkg.certify.CertAuthority. None = blind
+        # relay for every CONNECT (round-1 behavior).
+        self.ca = cert_authority
+        self.hijack_hosts = [re.compile(p) for p in hijack_hosts or []]
         self._inflight = 0
         self._server: asyncio.AbstractServer | None = None
+        self._sni_server: asyncio.AbstractServer | None = None
         self._session: aiohttp.ClientSession | None = None
         self._port = 0
+        self._sni_port = 0
 
     def _http(self) -> aiohttp.ClientSession:
         """One shared upstream session: connection reuse across proxied
@@ -74,44 +123,40 @@ class Proxy:
     def port(self) -> int:
         return self._port
 
+    async def serve_sni(self, host: str = "127.0.0.1", port: int = 0,
+                        *, hijack: bool = False) -> int:
+        """SNI listener (reference proxy_sni.go): accepts raw TLS
+        connections (no CONNECT) and routes by ClientHello server name.
+        hijack=True terminates TLS with a forged cert and serves through
+        the rule engine; otherwise the ClientHello is peeked and spliced
+        to <sni-host>:443 untouched."""
+        if hijack and self.ca is None:
+            raise ValueError("SNI hijack requires a cert_authority")
+
+        async def handle(reader, writer):
+            await self._handle_sni_conn(reader, writer, hijack)
+
+        self._sni_server = await asyncio.start_server(handle, host, port)
+        self._sni_port = self._sni_server.sockets[0].getsockname()[1]
+        log.info("sni proxy up", port=self._sni_port, hijack=hijack)
+        return self._sni_port
+
     async def close(self) -> None:
         if self._session is not None and not self._session.closed:
             await self._session.close()
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
+        for server in (self._server, self._sni_server):
+            if server is not None:
+                server.close()
+                await server.wait_closed()
+        self._server = self._sni_server = None
 
     # -- connection handling ----------------------------------------------
 
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter) -> None:
         try:
-            while True:
-                request = await self._read_request(reader)
-                if request is None:
-                    break
-                method, target, version, headers = request
-                if self.basic_auth and not self._check_auth(headers):
-                    await self._respond(writer, 407, b"proxy auth required",
-                                        extra="Proxy-Authenticate: Basic realm=\"dragonfly\"\r\n")
-                    break
-                if self.max_concurrency and self._inflight >= self.max_concurrency:
-                    # Unread request bodies would desync the keep-alive
-                    # stream; shed load by closing the connection.
-                    await self._respond(writer, 503, b"proxy at max concurrency",
-                                        extra="Connection: close\r\n")
-                    break
-                self._inflight += 1
-                try:
-                    if method == "CONNECT":
-                        await self._handle_connect(target, reader, writer)
-                        return  # tunnel consumed the connection
-                    keep_alive = await self._handle_http(
-                        method, target, headers, reader, writer)
-                    if not keep_alive:
-                        break
-                finally:
-                    self._inflight -= 1
+            await self._request_loop(reader, writer, scheme="http",
+                                     tunnel_host="")
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
         except Exception:
@@ -122,6 +167,45 @@ class Proxy:
                 await writer.wait_closed()
             except Exception:
                 pass
+
+    async def _request_loop(self, reader: asyncio.StreamReader,
+                            writer: asyncio.StreamWriter, *,
+                            scheme: str, tunnel_host: str) -> None:
+        """Keep-alive request loop. scheme/tunnel_host carry the hijacked-
+        tunnel context: inside a TLS-intercepted CONNECT the client speaks
+        origin-form requests that resolve against https://tunnel_host."""
+        while True:
+            request = await self._read_request(reader)
+            if request is None:
+                break
+            method, target, version, headers = request
+            if (self.basic_auth and scheme == "http"
+                    and not self._check_auth(headers)):
+                # Proxy auth rides the outer hop only: inside a hijacked
+                # tunnel the client believes it talks to the origin.
+                await self._respond(writer, 407, b"proxy auth required",
+                                    extra="Proxy-Authenticate: Basic realm=\"dragonfly\"\r\n")
+                break
+            if self.max_concurrency and self._inflight >= self.max_concurrency:
+                # Unread request bodies would desync the keep-alive
+                # stream; shed load by closing the connection.
+                await self._respond(writer, 503, b"proxy at max concurrency",
+                                    extra="Connection: close\r\n")
+                break
+            self._inflight += 1
+            try:
+                if method == "CONNECT" and scheme == "http":
+                    await self._handle_connect(target, reader, writer)
+                    return  # tunnel consumed the connection
+                keep_alive = await self._handle_http(
+                    method, target, headers, reader, writer,
+                    scheme=scheme, tunnel_host=tunnel_host)
+                if not keep_alive:
+                    break
+                if _hget(headers, "Connection").lower() == "close":
+                    break  # client asked for single-shot; don't hold EOF
+            finally:
+                self._inflight -= 1
 
     @staticmethod
     async def _read_request(reader: asyncio.StreamReader):
@@ -156,12 +240,22 @@ class Proxy:
 
     # -- CONNECT tunnel (reference handleHTTPS :471) -----------------------
 
+    def _should_hijack(self, host: str) -> bool:
+        if self.ca is None:
+            return False
+        if not self.hijack_hosts:
+            return True
+        return any(p.search(host) for p in self.hijack_hosts)
+
     async def _handle_connect(self, target: str, reader: asyncio.StreamReader,
                               writer: asyncio.StreamWriter) -> None:
         host, _, port_s = target.partition(":")
         port = int(port_s or 443)
         if self.white_list_ports and port not in self.white_list_ports:
             await self._respond(writer, 403, b"port not allowed")
+            return
+        if self._should_hijack(host):
+            await self._handle_connect_hijack(host, reader, writer)
             return
         try:
             up_reader, up_writer = await asyncio.open_connection(host, port)
@@ -190,23 +284,153 @@ class Proxy:
 
         await asyncio.gather(relay(reader, up_writer), relay(up_reader, writer))
 
+    async def _handle_connect_hijack(self, host: str,
+                                     reader: asyncio.StreamReader,
+                                     writer: asyncio.StreamWriter) -> None:
+        """TLS interception (reference proxy.go:471 handleHTTPS): answer
+        the CONNECT, upgrade the client leg to TLS with a cert forged for
+        ``host``, then serve the decrypted requests through the normal
+        rule engine — registry blob GETs ride P2P."""
+        writer.write(b"HTTP/1.1 200 Connection established\r\n\r\n")
+        await writer.drain()
+        try:
+            await writer.start_tls(self.ca.server_context(host))
+        except (ssl_mod.SSLError, ConnectionError, OSError) as e:
+            # Client refused our cert (CA not installed) or handshake
+            # failure: nothing to salvage, the tunnel is gone.
+            log.warning("tls hijack handshake failed", host=host,
+                        error=str(e))
+            return
+        PROXY_REQUESTS.labels("hijack").inc()
+        await self._request_loop(reader, writer, scheme="https",
+                                 tunnel_host=host)
+
+    # -- SNI proxy (reference proxy_sni.go) --------------------------------
+
+    async def _handle_sni_conn(self, reader: asyncio.StreamReader,
+                               writer: asyncio.StreamWriter,
+                               hijack: bool) -> None:
+        try:
+            if hijack:
+                # Terminate TLS directly; the right forged cert is picked
+                # during the handshake via the SNI callback.
+                holder: dict[str, str] = {}
+                await writer.start_tls(self._sni_hijack_context(holder))
+                host = holder.get("host", "")
+                if not host:
+                    return
+                PROXY_REQUESTS.labels("hijack").inc()
+                await self._request_loop(reader, writer, scheme="https",
+                                         tunnel_host=host)
+                return
+            # Passthrough: peek the ClientHello for the server name, then
+            # splice the bytes to <sni>:443 untouched.
+            hello = await self._read_tls_record(reader)
+            host = parse_sni(hello) if hello else None
+            if not host:
+                return
+            try:
+                up_reader, up_writer = await asyncio.open_connection(host, 443)
+            except OSError as e:
+                log.warning("sni upstream connect failed", host=host,
+                            error=str(e))
+                return
+            up_writer.write(hello)
+            await up_writer.drain()
+            PROXY_REQUESTS.labels("sni").inc()
+
+            async def relay(src, dst):
+                try:
+                    while True:
+                        data = await src.read(64 << 10)
+                        if not data:
+                            break
+                        dst.write(data)
+                        await dst.drain()
+                except (ConnectionError, asyncio.CancelledError):
+                    pass
+                finally:
+                    try:
+                        dst.close()
+                    except Exception:
+                        pass
+
+            await asyncio.gather(relay(reader, up_writer),
+                                 relay(up_reader, writer))
+        except (ConnectionError, asyncio.IncompleteReadError,
+                ssl_mod.SSLError):
+            pass
+        except Exception:
+            log.error("sni connection error", exc_info=True)
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    def _sni_hijack_context(self, holder: dict[str, str]):
+        """Server context whose cert is chosen during the handshake from
+        the ClientHello SNI (we don't know the target host beforehand)."""
+        # Fresh (uncached) cert-bearing context per connection: the
+        # sni_callback writes into this connection's holder, so it must
+        # not be shared. SNI-less clients get the localhost cert (they'll
+        # fail hostname checks anyway).
+        base = self.ca.fresh_server_context("localhost")
+
+        def on_sni(sock, server_name, _ctx):
+            if server_name:
+                holder["host"] = server_name
+                sock.context = self.ca.server_context(server_name)
+            return None
+
+        base.sni_callback = on_sni
+        return base
+
+    @staticmethod
+    async def _read_tls_record(reader: asyncio.StreamReader) -> bytes | None:
+        """Read exactly one TLS record (the ClientHello) off the wire."""
+        try:
+            header = await reader.readexactly(5)
+        except asyncio.IncompleteReadError:
+            return None
+        if header[0] != 0x16:  # not a TLS handshake record
+            return None
+        length = int.from_bytes(header[3:5], "big")
+        if length > 1 << 16:
+            return None
+        try:
+            body = await reader.readexactly(length)
+        except asyncio.IncompleteReadError:
+            return None
+        return header + body
+
     # -- plain HTTP --------------------------------------------------------
 
-    def _resolve_url(self, target: str, headers: dict[str, str]) -> str:
+    def _resolve_url(self, target: str, headers: dict[str, str],
+                     scheme: str = "http", tunnel_host: str = "") -> str:
         if target.startswith("http://") or target.startswith("https://"):
             return target                      # classic forward proxy
+        if tunnel_host:
+            # Inside a hijacked CONNECT/SNI tunnel: origin-form requests
+            # resolve against the tunnelled host (the Host header should
+            # match, but the CONNECT target is what the client asked for).
+            host = _hget(headers, "Host", tunnel_host)
+            return f"{scheme}://{host}{target}"
         if self.registry_mirror:
             # Mirror mode (reference mirrorRegistry :585): we ARE the
             # registry host; rebase the origin-form path onto the remote.
             return urljoin(self.registry_mirror + "/", target.lstrip("/"))
         host = _hget(headers, "Host")
-        return f"http://{host}{target}"
+        return f"{scheme}://{host}{target}"
 
     async def _handle_http(self, method: str, target: str,
                            headers: dict[str, str],
                            reader: asyncio.StreamReader,
-                           writer: asyncio.StreamWriter) -> bool:
-        url = self._resolve_url(target, headers)
+                           writer: asyncio.StreamWriter, *,
+                           scheme: str = "http",
+                           tunnel_host: str = "") -> bool:
+        url = self._resolve_url(target, headers, scheme, tunnel_host)
         fwd_headers = {k: v for k, v in headers.items()
                        if k.lower() not in _HOP_HEADERS and k.lower() != "host"}
         body = b""

@@ -1,0 +1,180 @@
+"""Certificate authority for TLS-intercepting proxy and fabric mTLS.
+
+Reference: client/daemon/proxy/proxy.go:471 handleHTTPS — the proxy
+hijacks CONNECT tunnels by terminating TLS with a leaf certificate forged
+on the fly for the requested host, signed by a configured CA the cluster's
+clients trust. Here the CA can be loaded from PEM files or self-generated
+(the reference leans on an operator-supplied cert; a generated CA plus a
+trust-bundle export covers the TPU-pod deployment where we control every
+client).
+
+Leaf certs are minted per hostname and cached; each carries the hostname
+as both CN and SAN (DNS or IP as appropriate) so stock TLS clients accept
+it once the CA is trusted.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+import ssl
+import threading
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import rsa
+from cryptography.x509.oid import NameOID
+
+_ONE_DAY = datetime.timedelta(days=1)
+
+
+def _new_key() -> rsa.RSAPrivateKey:
+    return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+
+def _pem_key(key) -> bytes:
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.TraditionalOpenSSL,
+        serialization.NoEncryption())
+
+
+def _pem_cert(cert: x509.Certificate) -> bytes:
+    return cert.public_bytes(serialization.Encoding.PEM)
+
+
+class CertAuthority:
+    """A CA that forges leaf certificates for arbitrary hosts."""
+
+    def __init__(self, ca_cert_pem: bytes, ca_key_pem: bytes):
+        self.ca_cert_pem = ca_cert_pem
+        self.ca_key_pem = ca_key_pem
+        self.ca_cert = x509.load_pem_x509_certificate(ca_cert_pem)
+        self.ca_key = serialization.load_pem_private_key(ca_key_pem, None)
+        self._contexts: dict[str, ssl.SSLContext] = {}
+        self._lock = threading.Lock()
+        # One leaf key shared across forged certs: keygen is the expensive
+        # part and the key is as trusted as the in-memory CA key anyway.
+        self._leaf_key = _new_key()
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def generate(cls, common_name: str = "dragonfly2-tpu-proxy-ca",
+                 valid_days: int = 3650) -> "CertAuthority":
+        key = _new_key()
+        name = x509.Name([
+            x509.NameAttribute(NameOID.COMMON_NAME, common_name),
+            x509.NameAttribute(NameOID.ORGANIZATION_NAME, "dragonfly2-tpu"),
+        ])
+        now = datetime.datetime.now(datetime.timezone.utc)
+        cert = (x509.CertificateBuilder()
+                .subject_name(name).issuer_name(name)
+                .public_key(key.public_key())
+                .serial_number(x509.random_serial_number())
+                .not_valid_before(now - _ONE_DAY)
+                .not_valid_after(now + datetime.timedelta(days=valid_days))
+                .add_extension(x509.BasicConstraints(ca=True, path_length=0),
+                               critical=True)
+                .add_extension(x509.KeyUsage(
+                    digital_signature=True, key_cert_sign=True, crl_sign=True,
+                    content_commitment=False, key_encipherment=False,
+                    data_encipherment=False, key_agreement=False,
+                    encipher_only=False, decipher_only=False), critical=True)
+                .sign(key, hashes.SHA256()))
+        return cls(_pem_cert(cert), _pem_key(key))
+
+    @classmethod
+    def load(cls, cert_path: str, key_path: str) -> "CertAuthority":
+        with open(cert_path, "rb") as f:
+            cert_pem = f.read()
+        with open(key_path, "rb") as f:
+            key_pem = f.read()
+        return cls(cert_pem, key_pem)
+
+    @classmethod
+    def load_or_generate(cls, cert_path: str = "", key_path: str = "",
+                         persist_dir: str = "") -> "CertAuthority":
+        """Operator-supplied CA when paths are given; otherwise generate,
+        persisting into ``persist_dir`` so restarts keep the same root of
+        trust (clients only need to install the CA once)."""
+        if cert_path and key_path:
+            return cls.load(cert_path, key_path)
+        if persist_dir:
+            cert_p = os.path.join(persist_dir, "proxy-ca.crt")
+            key_p = os.path.join(persist_dir, "proxy-ca.key")
+            if os.path.exists(cert_p) and os.path.exists(key_p):
+                return cls.load(cert_p, key_p)
+            ca = cls.generate()
+            os.makedirs(persist_dir, exist_ok=True)
+            with open(cert_p, "wb") as f:
+                f.write(ca.ca_cert_pem)
+            fd = os.open(key_p, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+            with os.fdopen(fd, "wb") as f:
+                f.write(ca.ca_key_pem)
+            return ca
+        return cls.generate()
+
+    # -- leaf forging ------------------------------------------------------
+
+    def forge_leaf(self, hostname: str) -> tuple[bytes, bytes]:
+        """Mint (cert_pem, key_pem) for ``hostname``, CA-signed."""
+        try:
+            san: x509.GeneralName = x509.IPAddress(
+                ipaddress.ip_address(hostname))
+        except ValueError:
+            san = x509.DNSName(hostname)
+        now = datetime.datetime.now(datetime.timezone.utc)
+        cert = (x509.CertificateBuilder()
+                .subject_name(x509.Name([
+                    x509.NameAttribute(NameOID.COMMON_NAME, hostname[:64])]))
+                .issuer_name(self.ca_cert.subject)
+                .public_key(self._leaf_key.public_key())
+                .serial_number(x509.random_serial_number())
+                .not_valid_before(now - _ONE_DAY)
+                .not_valid_after(now + datetime.timedelta(days=397))
+                .add_extension(x509.SubjectAlternativeName([san]),
+                               critical=False)
+                .add_extension(x509.ExtendedKeyUsage(
+                    [x509.oid.ExtendedKeyUsageOID.SERVER_AUTH]),
+                    critical=False)
+                .sign(self.ca_key, hashes.SHA256()))
+        return _pem_cert(cert), _pem_key(self._leaf_key)
+
+    def server_context(self, hostname: str) -> ssl.SSLContext:
+        """Server-side SSLContext presenting a forged cert for ``hostname``
+        (chained with the CA cert). Cached per host."""
+        with self._lock:
+            ctx = self._contexts.get(hostname)
+        if ctx is not None:
+            return ctx
+        ctx = self.fresh_server_context(hostname)
+        with self._lock:
+            self._contexts[hostname] = ctx
+        return ctx
+
+    def fresh_server_context(self, hostname: str) -> ssl.SSLContext:
+        """Uncached variant for callers that mutate the context (e.g. a
+        per-connection sni_callback) — the cached ones are shared."""
+        cert_pem, key_pem = self.forge_leaf(hostname)
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        # Serve leaf + CA chain so clients can build the path even when
+        # only the root is in their trust store via a bundle file.
+        import tempfile
+
+        with tempfile.NamedTemporaryFile(suffix=".pem") as cf, \
+                tempfile.NamedTemporaryFile(suffix=".pem") as kf:
+            cf.write(cert_pem + self.ca_cert_pem)
+            cf.flush()
+            kf.write(key_pem)
+            kf.flush()
+            ctx.load_cert_chain(cf.name, kf.name)
+        return ctx
+
+    def trust_context(self) -> ssl.SSLContext:
+        """Client-side context trusting (only) this CA — what cluster
+        clients install to talk through the intercepting proxy."""
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.load_verify_locations(cadata=self.ca_cert_pem.decode())
+        return ctx

@@ -39,6 +39,24 @@ class HTTPSourceClient(ResourceClient):
         self._session = session
         self._session_loop = None
 
+    @staticmethod
+    def _ssl_config():
+        """Origin TLS trust: DRAGONFLY_SSL_CA_FILE adds a private CA (e.g.
+        an internal registry's root), DRAGONFLY_SSL_INSECURE=1 disables
+        verification. Default: system trust store."""
+        import os
+        import ssl
+
+        ca_file = os.environ.get("DRAGONFLY_SSL_CA_FILE") or None
+        insecure = os.environ.get("DRAGONFLY_SSL_INSECURE") == "1"
+        if not ca_file and not insecure:
+            return None
+        ctx = ssl.create_default_context(cafile=ca_file)
+        if insecure:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        return ctx
+
     async def _sess(self) -> aiohttp.ClientSession:
         import asyncio
 
@@ -46,7 +64,11 @@ class HTTPSourceClient(ResourceClient):
         # Sessions are bound to an event loop; a registry-cached client must
         # rebuild when called from a fresh loop (daemon restarts, tests).
         if self._session is None or self._session.closed or self._session_loop is not loop:
+            ssl_ctx = self._ssl_config()
+            connector = (aiohttp.TCPConnector(ssl=ssl_ctx)
+                         if ssl_ctx is not None else None)
             self._session = aiohttp.ClientSession(
+                connector=connector,
                 timeout=aiohttp.ClientTimeout(total=None, sock_connect=10, sock_read=60)
             )
             self._session_loop = loop

@@ -1,0 +1,269 @@
+"""HTTPS interception: CA forging, CONNECT hijack, SNI proxy.
+
+Reference: client/daemon/proxy/proxy.go:471 handleHTTPS (TLS hijack with
+forged leaf certs so HTTPS registry pulls ride P2P) and proxy_sni.go (SNI
+routing for direct-TLS clients). The round-1 CONNECT handler was a blind
+byte relay, which meant every real containerd pull (BASELINE config #3)
+bypassed the fabric entirely.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import os
+import random
+import ssl
+
+import aiohttp
+from aiohttp import web
+
+from dragonfly2_tpu.daemon.proxy import Proxy, parse_sni
+from dragonfly2_tpu.daemon.transport import P2PTransport, ProxyRule
+from dragonfly2_tpu.pkg.certify import CertAuthority
+from dragonfly2_tpu.pkg.piece import Range
+
+from tests.test_stream_proxy import make_task_manager
+
+BLOB = bytes(random.Random(13).randbytes(4 * 1024 * 1024))
+BLOB_SHA = hashlib.sha256(BLOB).hexdigest()
+
+_CA = None
+
+
+def shared_ca() -> CertAuthority:
+    """One CA per test session — RSA keygen is the slow part."""
+    global _CA
+    if _CA is None:
+        _CA = CertAuthority.generate()
+    return _CA
+
+
+async def start_tls_registry(ca: CertAuthority):
+    """Fake HTTPS OCI registry with Range support and hit counting."""
+    stats = {"blob_gets": 0}
+
+    async def blob(request: web.Request) -> web.Response:
+        stats["blob_gets"] += 1
+        rng = request.headers.get("Range")
+        if rng:
+            r = Range.parse_http(rng, len(BLOB))
+            return web.Response(
+                status=206, body=BLOB[r.start:r.start + r.length],
+                headers={"Accept-Ranges": "bytes",
+                         "Content-Range":
+                             f"bytes {r.start}-{r.start + r.length - 1}/{len(BLOB)}"})
+        return web.Response(body=BLOB, headers={"Accept-Ranges": "bytes"})
+
+    app = web.Application()
+    app.router.add_get(f"/v2/library/app/blobs/sha256:{BLOB_SHA}", blob)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0,
+                       ssl_context=ca.server_context("127.0.0.1"))
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, port, stats
+
+
+def _trust_ca_for_source_clients(ca: CertAuthority, tmp_path) -> None:
+    """Point the default SSL trust store at the test CA so the daemon's
+    back-to-source client accepts the fake registry's forged cert (real
+    deployments set DRAGONFLY_SSL_CA_FILE (or the system trust store) the
+    same way)."""
+    bundle = tmp_path / "ca-bundle.pem"
+    bundle.write_bytes(ca.ca_cert_pem)
+    os.environ["DRAGONFLY_SSL_CA_FILE"] = str(bundle)
+
+
+# -- certify ----------------------------------------------------------------
+
+def test_forged_leaf_passes_hostname_verification(run_async, tmp_path):
+    ca = shared_ca()
+
+    async def run():
+        async def hello(request):
+            return web.Response(text="hi")
+
+        app = web.Application()
+        app.router.add_get("/", hello)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0,
+                           ssl_context=ca.server_context("localhost"))
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        try:
+            # Full hostname verification against the forged cert: connect
+            # to 127.0.0.1 but verify as "localhost" (the SAN we forged).
+            conn = aiohttp.TCPConnector(ssl=ca.trust_context(),
+                                        resolver=None)
+            async with aiohttp.ClientSession(connector=conn) as sess:
+                async with sess.get(f"https://localhost:{port}/") as resp:
+                    assert resp.status == 200
+                    assert await resp.text() == "hi"
+        finally:
+            await runner.cleanup()
+
+    run_async(run())
+
+
+def test_ca_persistence_roundtrip(tmp_path):
+    d = str(tmp_path / "ca")
+    ca1 = CertAuthority.load_or_generate(persist_dir=d)
+    ca2 = CertAuthority.load_or_generate(persist_dir=d)
+    assert ca1.ca_cert_pem == ca2.ca_cert_pem  # same root across restarts
+    assert (os.stat(os.path.join(d, "proxy-ca.key")).st_mode & 0o777) == 0o600
+
+
+def test_parse_sni_from_real_clienthello():
+    """parse_sni must decode the SNI from a ClientHello produced by the
+    real ssl stack (MemoryBIO handshake, no sockets)."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    inbio, outbio = ssl.MemoryBIO(), ssl.MemoryBIO()
+    obj = ctx.wrap_bio(inbio, outbio, server_hostname="registry.example.com")
+    try:
+        obj.do_handshake()
+    except ssl.SSLWantReadError:
+        pass
+    hello = outbio.read()
+    assert parse_sni(hello) == "registry.example.com"
+    assert parse_sni(b"\x17\x03\x03\x00\x05hello") is None
+    assert parse_sni(b"") is None
+
+
+# -- CONNECT hijack ---------------------------------------------------------
+
+def test_connect_hijack_blob_rides_p2p(run_async, tmp_path):
+    """An HTTPS blob pull through the proxy's CONNECT tunnel must be
+    TLS-terminated and served from the P2P cache: the second pull may not
+    touch the origin (a blind relay would hit it every time)."""
+    ca = shared_ca()
+    _trust_ca_for_source_clients(ca, tmp_path)
+
+    async def run():
+        runner, origin_port, stats = await start_tls_registry(ca)
+        tm = make_task_manager(tmp_path)
+        url = f"https://127.0.0.1:{origin_port}/v2/library/app/blobs/sha256:{BLOB_SHA}"
+        proxy = Proxy(
+            P2PTransport(tm, rules=[ProxyRule(regex=r"blobs/sha256.*")]),
+            cert_authority=ca,
+            white_list_ports=[],   # origin rides an ephemeral port
+        )
+        proxy_port = await proxy.serve("127.0.0.1", 0)
+        try:
+            async with aiohttp.ClientSession() as sess:
+                for expect_origin_hits in (True, False):
+                    before = stats["blob_gets"]
+                    async with sess.get(
+                            url, proxy=f"http://127.0.0.1:{proxy_port}",
+                            ssl=ca.trust_context()) as resp:
+                        assert resp.status == 200
+                        body = await resp.read()
+                    assert body == BLOB
+                    if expect_origin_hits:
+                        assert stats["blob_gets"] > before
+                    else:
+                        # Cache hit: hijacked + served from the piece store.
+                        assert stats["blob_gets"] == before
+        finally:
+            await proxy.close()
+            tm.storage.close()
+            await runner.cleanup()
+
+    run_async(run())
+
+
+def test_connect_hijack_host_filter(run_async, tmp_path):
+    """Hosts outside hijack_hosts keep the blind relay (end-to-end TLS to
+    the origin, origin hit every time)."""
+    ca = shared_ca()
+    _trust_ca_for_source_clients(ca, tmp_path)
+
+    async def run():
+        runner, origin_port, stats = await start_tls_registry(ca)
+        tm = make_task_manager(tmp_path)
+        url = f"https://127.0.0.1:{origin_port}/v2/library/app/blobs/sha256:{BLOB_SHA}"
+        proxy = Proxy(
+            P2PTransport(tm, rules=[ProxyRule(regex=r"blobs/sha256.*")]),
+            cert_authority=ca,
+            hijack_hosts=[r"registry\.internal"],   # 127.0.0.1 not matched
+            white_list_ports=[],
+        )
+        proxy_port = await proxy.serve("127.0.0.1", 0)
+        try:
+            async with aiohttp.ClientSession() as sess:
+                for _ in range(2):
+                    before = stats["blob_gets"]
+                    async with sess.get(
+                            url, proxy=f"http://127.0.0.1:{proxy_port}",
+                            ssl=ca.trust_context()) as resp:
+                        assert resp.status == 200
+                        assert await resp.read() == BLOB
+                    assert stats["blob_gets"] > before  # straight to origin
+        finally:
+            await proxy.close()
+            tm.storage.close()
+            await runner.cleanup()
+
+    run_async(run())
+
+
+# -- SNI listener -----------------------------------------------------------
+
+def test_sni_hijack_serves_p2p(run_async, tmp_path):
+    """Direct-TLS client (no CONNECT) against the SNI listener: TLS is
+    terminated with a cert forged for the SNI name and the request rides
+    the rule engine / P2P cache."""
+    ca = shared_ca()
+    _trust_ca_for_source_clients(ca, tmp_path)
+
+    async def run():
+        runner, origin_port, stats = await start_tls_registry(ca)
+        tm = make_task_manager(tmp_path)
+        path = f"/v2/library/app/blobs/sha256:{BLOB_SHA}"
+        proxy = Proxy(
+            P2PTransport(tm, rules=[ProxyRule(regex=r"blobs/sha256.*")]),
+            cert_authority=ca,
+        )
+        sni_port = await proxy.serve_sni("127.0.0.1", 0, hijack=True)
+
+        async def fetch_once() -> bytes:
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", sni_port, ssl=ca.trust_context(),
+                server_hostname="localhost")
+            # Host points at the real origin (the SNI listener stands in
+            # for the registry vhost).
+            writer.write((f"GET {path} HTTP/1.1\r\n"
+                          f"Host: 127.0.0.1:{origin_port}\r\n"
+                          "Connection: close\r\n\r\n").encode())
+            await writer.drain()
+            raw = await reader.read(-1)
+            writer.close()
+            head, _, body = raw.partition(b"\r\n\r\n")
+            assert b" 200 " in head.split(b"\r\n", 1)[0]
+            if b"chunked" in head.lower():
+                out = bytearray()
+                while body:
+                    size_s, _, body = body.partition(b"\r\n")
+                    size = int(size_s, 16)
+                    if size == 0:
+                        break
+                    out += body[:size]
+                    body = body[size + 2:]
+                return bytes(out)
+            return body
+
+        try:
+            assert await fetch_once() == BLOB
+            before = stats["blob_gets"]
+            assert await fetch_once() == BLOB
+            assert stats["blob_gets"] == before   # second pull: cache
+        finally:
+            await proxy.close()
+            tm.storage.close()
+            await runner.cleanup()
+
+    run_async(run())
