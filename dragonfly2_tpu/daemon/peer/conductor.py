@@ -26,7 +26,7 @@ from dragonfly2_tpu.daemon.peer.piece_manager import PieceManager
 from dragonfly2_tpu.daemon.peer.synchronizer import PieceTaskSynchronizer
 from dragonfly2_tpu.pkg import dflog, metrics
 from dragonfly2_tpu.pkg.errors import Code, DfError
-from dragonfly2_tpu.pkg.piece import compute_piece_count
+from dragonfly2_tpu.pkg.piece import PieceInfo, compute_piece_count
 from dragonfly2_tpu.pkg.ratelimit import Limiter
 from dragonfly2_tpu.storage.local_store import LocalTaskStore
 
@@ -107,22 +107,30 @@ class PeerTaskConductor:
             msg = await self._stream.recv(timeout=60.0)
             if msg is None:
                 raise DfError(Code.SchedError, "scheduler closed stream at register")
-            kind = msg.get("type")
-            if kind == "empty_task":
-                await self._finish_empty()
-            elif kind == "need_back_source":
-                await self._back_source()
-            elif kind == "normal_task":
-                await self._pull_pieces_p2p(msg)
-            elif kind == "schedule_failed":
-                raise DfError(Code.SchedError, msg.get("reason", "schedule failed"))
-            else:
-                raise DfError(Code.SchedError, f"unexpected scheduler response {kind}")
+            await self._dispatch_schedule(msg)
         except BaseException:
             await self._safe_send({"type": "download_failed"})
             raise
         finally:
             await self._teardown()
+
+    async def _dispatch_schedule(self, msg: dict) -> None:
+        """Dispatch the scheduler's answer to a register/reschedule."""
+        kind = msg.get("type")
+        if kind == "empty_task":
+            await self._finish_empty()
+        elif kind == "tiny_task":
+            await self._finish_tiny(msg)
+        elif kind == "small_task":
+            await self._finish_small(msg)
+        elif kind == "need_back_source":
+            await self._back_source()
+        elif kind == "normal_task":
+            await self._pull_pieces_p2p(msg)
+        elif kind == "schedule_failed":
+            raise DfError(Code.SchedError, msg.get("reason", "schedule failed"))
+        else:
+            raise DfError(Code.SchedError, f"unexpected scheduler response {kind}")
 
     @property
     def from_p2p(self) -> bool:
@@ -133,6 +141,67 @@ class PeerTaskConductor:
     async def _finish_empty(self) -> None:
         self.store.update_task(content_length=0, total_piece_count=0, piece_size=1)
         await self._safe_send({"type": "download_finished", "content_length": 0})
+
+    # -- tiny: content inlined by the scheduler (ref storeTinyPeerTask :569)
+
+    async def _finish_tiny(self, msg: dict) -> None:
+        content = bytes(msg.get("content") or b"")
+        self._from_p2p = True
+        self.store.update_task(content_length=len(content),
+                               piece_size=max(len(content), 1),
+                               total_piece_count=1)
+        if 0 not in self.store.metadata.pieces:
+            self.store.write_piece(0, content)
+        await self._safe_send({"type": "download_finished",
+                               "content_length": len(content),
+                               "piece_size": max(len(content), 1),
+                               "total_piece_count": 1})
+
+    # -- small: one direct parent + piece 0 (ref pullSinglePiece :904) -----
+
+    async def _finish_small(self, msg: dict) -> None:
+        task_wire = msg.get("task") or {}
+        parent = msg.get("parent") or {}
+        piece = PieceInfo.from_wire(msg.get("piece") or {})
+        host = parent.get("host") or {}
+        self._apply_task_meta(task_wire)
+        try:
+            if piece.piece_num not in self.store.metadata.pieces:
+                data, cost_ms = await self.downloader.download_piece(
+                    host.get("ip", ""), host.get("upload_port", 0),
+                    self.task_id, piece.piece_num,
+                    src_peer_id=parent.get("id", ""),
+                    expected_size=piece.range_size)
+                await self.limiter.wait(len(data))
+                rec = self.store.write_piece(piece.piece_num, data,
+                                             expected_digest=piece.digest,
+                                             cost_ms=cost_ms)
+                await self._report_piece(rec, parent_id=parent.get("id", ""))
+                if self.on_piece is not None:
+                    await self.on_piece(self.store, rec)
+            self._from_p2p = True
+            await self._safe_send({
+                "type": "download_finished",
+                "content_length": self.store.metadata.content_length,
+                "piece_size": self.store.metadata.piece_size,
+                "total_piece_count": self.store.metadata.total_piece_count,
+            })
+        except DfError as e:
+            # The handed-out parent was bad: ask for a reschedule and run
+            # whatever the scheduler answers (normal/back-source path).
+            log.warning("small-task direct pull failed, rescheduling",
+                        task=self.task_id[:16], error=str(e))
+            await self._safe_send({"type": "reschedule",
+                                   "blocklist": [parent.get("id", "")]})
+            nxt = await self._stream.recv(timeout=60.0)
+            if nxt is None:
+                raise DfError(Code.SchedError,
+                              "scheduler closed stream after small-task retry")
+            if nxt.get("type") == "small_task":
+                # Don't ping-pong between bad small parents forever.
+                raise DfError(Code.ClientPieceDownloadFail,
+                              "small-task retry returned another direct parent")
+            await self._dispatch_schedule(nxt)
 
     # -- back-to-source (reference backSource :503) ------------------------
 

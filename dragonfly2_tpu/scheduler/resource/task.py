@@ -47,6 +47,9 @@ class Task:
         self.piece_size = 0
         self.total_piece_count = -1
         self.pieces: dict[int, PieceInfo] = {}   # known piece metadata
+        # Tiny-task content (≤128 B), inlined in register responses once a
+        # finisher uploads it (reference task.go:133 DirectPiece).
+        self.direct_piece: bytes = b""
         self.fsm = FSM(TaskState.PENDING, _TASK_EVENTS)
         self.dag: DAG = DAG()                    # peer tree: parent → child
         self.back_to_source_limit = back_to_source_limit

@@ -23,7 +23,7 @@ import asyncio
 from dragonfly2_tpu.pkg import aio, dflog
 from dragonfly2_tpu.pkg.errors import Code, DfError
 from dragonfly2_tpu.pkg.fsm import TransitionError
-from dragonfly2_tpu.pkg.piece import PieceInfo
+from dragonfly2_tpu.pkg.piece import PieceInfo, SizeScope
 from dragonfly2_tpu.pkg.types import HostType
 from dragonfly2_tpu.rpc import RpcContext, ServerStream
 from dragonfly2_tpu.scheduler.config import SchedulerConfig
@@ -42,6 +42,12 @@ from dragonfly2_tpu.scheduler.scheduling.scheduling import ScheduleResult
 from dragonfly2_tpu.scheduler.seed_client import SeedPeerClientPool
 
 log = dflog.get("scheduler.service")
+
+from dragonfly2_tpu.pkg import metrics  # noqa: E402
+
+REGISTER_SCOPE_COUNT = metrics.counter(
+    "scheduler_register_size_scope_total",
+    "Peer registrations by task size scope shortcut", ("scope",))
 
 
 class SchedulerService:
@@ -158,10 +164,31 @@ class SchedulerService:
         if task.content_length == 0:
             peer.fsm.event("register_empty")
             peer.fsm.event("download_succeeded")
+            REGISTER_SCOPE_COUNT.labels("empty").inc()
             await peer.announce_stream.send({"type": "empty_task"})
             return
 
+        # Size-scope shortcuts (reference service_v1.go:885-996): once the
+        # task has succeeded somewhere, tiny content is inlined in the
+        # register response and single-piece tasks get one direct parent —
+        # no announce-stream scheduling machinery for either.
+        if not peer.is_seed and task.state == TaskState.SUCCEEDED:
+            scope = task.size_scope()
+            if (scope == SizeScope.TINY
+                    and len(task.direct_piece) == task.content_length):
+                peer.fsm.event("register_tiny")
+                peer.fsm.event("download_succeeded")
+                REGISTER_SCOPE_COUNT.labels("tiny").inc()
+                await peer.announce_stream.send({
+                    "type": "tiny_task", "task": task.to_wire(),
+                    "content": task.direct_piece})
+                return
+            if scope == SizeScope.SMALL and await self._register_small(task, peer):
+                REGISTER_SCOPE_COUNT.labels("small").inc()
+                return
+
         peer.fsm.event("register_normal")
+        REGISTER_SCOPE_COUNT.labels("normal").inc()
 
         # Seed peers and solo first-comers go straight to origin; everyone
         # else gets parents (back-to-source dedup: ~1 origin fetch per task).
@@ -200,6 +227,31 @@ class SchedulerService:
         # loop instead of demoting it to a redundant origin fetch.
         patience = 30.0 if seeding else 0.0
         await self._schedule_and_send(task, peer, patience=patience)
+
+    async def _register_small(self, task: Task, peer: Peer) -> bool:
+        """Single-piece shortcut (reference registerSmallTask :917): hand
+        the registrant one SUCCEEDED parent plus piece 0's info so it can
+        fetch the whole content with one upload-server GET. Returns False
+        to fall through to normal registration."""
+        piece = task.pieces.get(0)
+        if piece is None:
+            return False
+        candidates = self.scheduling.find_candidate_parents(peer)
+        parent = next((c for c in candidates
+                       if c.state == PeerState.SUCCEEDED
+                       and c.host.upload_port > 0), None)
+        if parent is None:
+            return False
+        try:
+            task.delete_peer_in_edges(peer.id)
+            task.add_peer_edge(parent.id, peer.id)
+            peer.fsm.event("register_small")
+        except Exception:
+            return False
+        await peer.announce_stream.send({
+            "type": "small_task", "task": task.to_wire(),
+            "parent": parent.to_wire(), "piece": piece.to_wire()})
+        return True
 
     def _seed_active(self, task: Task) -> bool:
         return any(p.is_seed and not p.is_done() for p in task.peers())
@@ -334,6 +386,8 @@ class SchedulerService:
     # -- completion (reference :1180/:1236) --------------------------------
 
     def _handle_download_finished(self, msg: dict, task: Task, peer: Peer) -> None:
+        if peer.state == PeerState.SUCCEEDED:
+            return  # tiny-register peers are marked succeeded up front
         try:
             peer.fsm.event("download_succeeded")
         except TransitionError:
@@ -354,6 +408,12 @@ class SchedulerService:
         if task.fsm.can("download_succeeded"):
             task.fsm.event("download_succeeded")
         log.info("peer finished", peer=peer.id[:24], task=task.id[:16])
+        # Tiny tasks: pull the content off the finisher's upload server so
+        # later registrants get it inlined (reference service_v1.go:1196-1210
+        # fills Task.DirectPiece the same way).
+        if (task.size_scope() == SizeScope.TINY and not task.direct_piece
+                and peer.host.upload_port > 0):
+            aio.spawn(self._fetch_direct_piece(task, peer))
         # Persistent-cache replica bookkeeping: a replication download that
         # finished becomes a durable replica row (reference service_v2.go
         # persistent cache peer state handling).
@@ -593,6 +653,28 @@ class SchedulerService:
                 log.info("replication triggered", task=task_id[:16],
                          host=host.id)
         return fired
+
+    async def _fetch_direct_piece(self, task: Task, peer: Peer) -> None:
+        """Download a tiny task's full content (≤128 B) from the finished
+        peer's upload server into ``task.direct_piece``."""
+        import aiohttp
+
+        url = (f"http://{peer.host.ip}:{peer.host.upload_port}"
+               f"/download/{task.id[:3]}/{task.id}")
+        try:
+            async with aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(total=10)) as sess:
+                async with sess.get(url, params={"peerId": peer.id,
+                                                 "pieceNum": "0"}) as resp:
+                    if resp.status != 200:
+                        return
+                    data = await resp.read()
+        except aiohttp.ClientError:
+            return
+        if len(data) == task.content_length:
+            task.direct_piece = data
+            log.info("tiny direct piece cached", task=task.id[:16],
+                     size=len(data))
 
     async def announce_task(self, body: dict, ctx: RpcContext) -> dict:
         """A daemon announces an already-complete local task (dfcache import,

@@ -1,0 +1,208 @@
+"""Tiny/small size-scope shortcuts, end-to-end.
+
+Reference: scheduler/service/service_v1.go:885-996 — once a task has
+succeeded somewhere, ≤128 B content is inlined in the register response
+(registerTinyTask; DirectPiece filled scheduler-side per :1196-1210) and
+single-piece tasks get one direct SUCCEEDED parent (registerSmallTask), so
+neither pays the announce-stream scheduling machinery.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+
+from aiohttp import web
+
+from dragonfly2_tpu.pkg.piece import Range, SizeScope
+from dragonfly2_tpu.scheduler.service import REGISTER_SCOPE_COUNT
+
+from tests.test_p2p_e2e import (
+    daemon_config,
+    start_daemon,
+    start_scheduler,
+)
+import tests.test_p2p_e2e as e2e
+
+
+def _scope_count(scope: str) -> float:
+    return REGISTER_SCOPE_COUNT.labels(scope)._value.get()
+
+
+async def _start_origin(content: bytes):
+    stats = {"gets": 0}
+
+    async def blob(request: web.Request) -> web.Response:
+        stats["gets"] += 1
+        rng = request.headers.get("Range")
+        if rng:
+            r = Range.parse_http(rng, len(content))
+            return web.Response(
+                status=206, body=content[r.start:r.start + r.length],
+                headers={"Content-Range":
+                         f"bytes {r.start}-{r.start + r.length - 1}/{len(content)}",
+                         "Accept-Ranges": "bytes"})
+        return web.Response(body=content, headers={"Accept-Ranges": "bytes"})
+
+    app = web.Application()
+    app.router.add_get("/blob", blob)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    return runner, site._server.sockets[0].getsockname()[1], stats
+
+
+async def _dfget(daemon, url, out, digest):
+    from dragonfly2_tpu.client import dfget as dfget_lib
+    from dragonfly2_tpu.proto.common import UrlMeta
+
+    return await dfget_lib.download(
+        dfget_lib.DfgetConfig(
+            url=url, output=out, daemon_sock=daemon.config.unix_sock,
+            meta=UrlMeta(digest=digest), allow_source_fallback=False,
+            timeout=60.0))
+
+
+async def _wait(predicate, timeout=10.0):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(0.05)
+    return False
+
+
+def test_tiny_task_inlined_in_register(run_async, tmp_path):
+    """100 B file: after the first download the scheduler caches the
+    content (DirectPiece) off the finisher's upload server; the next
+    registrant receives it inline — zero piece traffic, zero origin."""
+    content = b"x" * 37 + b"tiny-checkpoint-metadata" + b"y" * 39  # 100 B
+    digest = "sha256:" + hashlib.sha256(content).hexdigest()
+
+    async def body():
+        origin, oport, stats = await _start_origin(content)
+        sched = await start_scheduler()
+        url = f"http://127.0.0.1:{oport}/blob"
+        daemons = []
+        try:
+            a = await start_daemon(tmp_path, "a", sched.port())
+            b = await start_daemon(tmp_path, "b", sched.port())
+            daemons += [a, b]
+
+            r1 = await _dfget(a, url, str(tmp_path / "o1"), digest)
+            assert r1["state"] == "done"
+            origin_after_first = stats["gets"]
+
+            # The scheduler pulls the tiny content off peer A's upload
+            # server (async after download_finished).
+            task = next(iter(sched.service.tasks.all()))
+            assert task.size_scope() == SizeScope.TINY
+            assert await _wait(lambda: task.direct_piece == content), \
+                "scheduler never cached the direct piece"
+
+            before_tiny = _scope_count("tiny")
+            r2 = await _dfget(b, url, str(tmp_path / "o2"), digest)
+            assert r2["state"] == "done"
+            assert (tmp_path / "o2").read_bytes() == content
+            assert _scope_count("tiny") == before_tiny + 1
+            assert stats["gets"] == origin_after_first  # no origin traffic
+        finally:
+            for d in daemons:
+                await d.stop()
+            await sched.stop()
+            await origin.cleanup()
+
+    run_async(body(), timeout=60)
+
+
+def test_small_task_direct_parent(run_async, tmp_path):
+    """1 MiB file (single piece, > tiny): a later registrant gets one
+    SUCCEEDED parent + piece 0 info in the register response and completes
+    with a single upload-server GET."""
+    content = bytes(hashlib.sha256(b"seed").digest()) * (1 << 15)  # 1 MiB
+    digest = "sha256:" + hashlib.sha256(content).hexdigest()
+
+    async def body():
+        origin, oport, stats = await _start_origin(content)
+        sched = await start_scheduler()
+        url = f"http://127.0.0.1:{oport}/blob"
+        daemons = []
+        try:
+            a = await start_daemon(tmp_path, "a", sched.port())
+            b = await start_daemon(tmp_path, "b", sched.port())
+            daemons += [a, b]
+
+            r1 = await _dfget(a, url, str(tmp_path / "o1"), digest)
+            assert r1["state"] == "done"
+            origin_after_first = stats["gets"]
+
+            task = next(iter(sched.service.tasks.all()))
+            assert task.size_scope() == SizeScope.SMALL
+            assert await _wait(lambda: 0 in task.pieces)
+
+            before_small = _scope_count("small")
+            r2 = await _dfget(b, url, str(tmp_path / "o2"), digest)
+            assert r2["state"] == "done"
+            assert (tmp_path / "o2").read_bytes() == content
+            assert r2["from_p2p"]
+            assert _scope_count("small") == before_small + 1
+            assert stats["gets"] == origin_after_first
+        finally:
+            for d in daemons:
+                await d.stop()
+            await sched.stop()
+            await origin.cleanup()
+
+    run_async(body(), timeout=60)
+
+
+def test_small_task_falls_back_when_parent_gone(run_async, tmp_path):
+    """If the direct parent dies between scheduling and the piece GET, the
+    registrant reschedules instead of failing the download."""
+    content = bytes(hashlib.sha256(b"fall").digest()) * (1 << 15)  # 1 MiB
+    digest = "sha256:" + hashlib.sha256(content).hexdigest()
+
+    async def body():
+        origin, oport, stats = await _start_origin(content)
+        sched = await start_scheduler()
+        url = f"http://127.0.0.1:{oport}/blob"
+        daemons = []
+        try:
+            a = await start_daemon(tmp_path, "a", sched.port())
+            daemons.append(a)
+            r1 = await _dfget(a, url, str(tmp_path / "o1"), digest)
+            assert r1["state"] == "done"
+
+            # Sabotage the recorded upload port so the direct pull fails;
+            # the host row still looks alive to the scheduler.
+            task = next(iter(sched.service.tasks.all()))
+            assert task.size_scope() == SizeScope.SMALL
+            host_a = next(iter(sched.service.hosts.all()))
+            real_port = host_a.upload_port
+            host_a.upload_port = 1  # closed port
+
+            b = await start_daemon(tmp_path, "b", sched.port())
+            daemons.append(b)
+            before_small = _scope_count("small")
+
+            async def heal():
+                # Let the small attempt fail once, then restore the port so
+                # the rescheduled normal path can use parent A again.
+                await asyncio.sleep(0.5)
+                host_a.upload_port = real_port
+
+            healer = asyncio.ensure_future(heal())
+            r2 = await _dfget(b, url, str(tmp_path / "o2"), digest)
+            await healer
+            assert r2["state"] == "done"
+            assert (tmp_path / "o2").read_bytes() == content
+            assert _scope_count("small") == before_small + 1  # tried small
+        finally:
+            for d in daemons:
+                await d.stop()
+            await sched.stop()
+            await origin.cleanup()
+
+    run_async(body(), timeout=60)
