@@ -145,6 +145,12 @@ def _add_daemon(sub: argparse._SubParsersAction) -> None:
                    help="enable gossip peer exchange on this UDP port (0 = ephemeral)")
     p.add_argument("--pex-seed", action="append", default=[],
                    help="PEX bootstrap host:port (repeatable)")
+    p.add_argument("--pex-secret", default="",
+                   help="shared HMAC secret for gossip datagrams")
+    p.add_argument("--prefetch", action="store_true",
+                   help="ranged-request misses also prefetch the whole task")
+    p.add_argument("--hijack-https", action="store_true",
+                   help="TLS-intercept CONNECT tunnels with a CA-forged cert")
     p.set_defaults(func=_run_daemon)
 
 
@@ -188,6 +194,13 @@ def _run_daemon(args: argparse.Namespace) -> int:
         if args.pex_port >= 0:
             cfg.pex.port = args.pex_port
         cfg.pex.seeds = args.pex_seed
+    if args.pex_secret:
+        cfg.pex.secret = args.pex_secret
+    if args.prefetch:
+        cfg.download.prefetch = True
+    if args.hijack_https:
+        cfg.proxy.enabled = True
+        cfg.proxy.hijack_https = True
 
     async def run() -> int:
         daemon = Daemon(cfg)

@@ -74,6 +74,7 @@ class Daemon:
             total_rate_limit=rate,
             host_wire=self._host_wire,
             traffic_shaper=config.download.traffic_shaper,
+            prefetch=config.download.prefetch,
         )
         self.rpc = DaemonRpcServer(self.task_manager)
         self.proxy = None

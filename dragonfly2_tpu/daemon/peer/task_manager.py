@@ -43,6 +43,17 @@ class FileTaskRequest:
             range_header=self.meta.range,
         )
 
+    def parent_task_id(self) -> str:
+        """Whole-content task id for ranged requests (reference
+        task_id.go:40-44) — the store partial/completed reuse looks up."""
+        return idgen.parent_task_id_v1(
+            self.url,
+            digest=self.meta.digest,
+            tag=self.meta.tag,
+            application=self.meta.application,
+            filters=self.meta.filter,
+        )
+
 
 @dataclass
 class StreamTaskRequest:
@@ -119,9 +130,13 @@ class TaskManager:
         host_wire=None,
         traffic_shaper: str = "plain",
         pex=None,
+        prefetch: bool = False,
     ):
         self.storage = storage
         self.piece_manager = piece_manager
+        # Ranged-request prefetch: a range miss also kicks off a background
+        # whole-task download (reference peertask_manager.go:288).
+        self.prefetch = prefetch
         self.host_ip = host_ip
         self.scheduler_client = scheduler_client
         self.conductor_factory = conductor_factory
@@ -382,6 +397,33 @@ class TaskManager:
             yield self._final_progress(reused, task_id, peer_id, from_reuse=True)
             return
 
+        # 1b. Ranged request: serve the slice off the whole-content parent
+        # task when its pieces cover the range — completed OR partial
+        # (reference peertask_reuse.go:234 + FindPartialCompletedTask).
+        if req.meta.range:
+            parent_id = req.parent_task_id()
+            parent = (self.storage.find_completed_task(parent_id)
+                      or self.storage.find_partial_completed_task(parent_id))
+            rng = None
+            if parent is not None and parent.metadata.piece_size > 0:
+                rng = Range.parse_http(req.meta.range,
+                                       parent.metadata.content_length)
+            if (rng is not None and rng.length > 0
+                    and parent.covers_range(rng.start, rng.length)):
+                log.info("reusing ranged slice from parent task",
+                         parent=parent_id[:16], start=rng.start,
+                         length=rng.length)
+                parent.export_range(req.output, rng.start, rng.length)
+                yield FileTaskProgress(
+                    state="done", task_id=task_id, peer_id=peer_id,
+                    content_length=rng.length, completed_length=rng.length,
+                    piece_count=0, total_piece_count=0, from_reuse=True)
+                return
+            # Miss: the ranged task downloads just its delta below; with
+            # prefetch on, the whole task starts in the background so the
+            # next overlapping range hits the parent store.
+            self._maybe_prefetch(parent_id, req)
+
         # 2. Dedup: piggyback on a running conductor for the same task
         # (reference getOrCreatePeerTaskConductor :201).
         running = self._running.get(task_id)
@@ -563,6 +605,21 @@ class TaskManager:
             attrs["range"] = rng
             return attrs, self._stream_from_store(store, rng)
 
+        # Ranged stream against a partially-downloaded task: serve straight
+        # off the store when the range's pieces already landed (reference
+        # tryReuseStreamPeerTask :234 partial reuse).
+        if req.range is not None:
+            partial = self.storage.find_partial_completed_task(task_id)
+            if partial is not None and partial.metadata.piece_size > 0:
+                rng = self._resolve_range(req.range,
+                                          partial.metadata.content_length)
+                if (rng is not None and rng.length > 0
+                        and partial.covers_range(rng.start, rng.length)):
+                    attrs = self._stream_attrs(partial, task_id, peer_id,
+                                               from_reuse=True)
+                    attrs["range"] = rng
+                    return attrs, self._stream_from_store(partial, rng)
+
         q = self.broker.subscribe(task_id)
         run = self._running.get(task_id)
         if run is None:
@@ -619,6 +676,31 @@ class TaskManager:
         if rng is not None and rng.length < 0 and content_length >= 0:
             return Range(rng.start, max(0, content_length - rng.start))
         return rng
+
+    def _maybe_prefetch(self, parent_id: str, req: FileTaskRequest) -> None:
+        """Kick off a background whole-task download after a ranged-request
+        miss (reference peertask_manager.go:288 prefetch)."""
+        if not self.prefetch or parent_id in self._running:
+            return
+        if self.storage.find_completed_task(parent_id) is not None:
+            return
+        from dataclasses import replace
+
+        meta = replace(req.meta, range="", header=dict(req.meta.header))
+        meta.header.pop("Range", None)
+        peer_id = idgen.peer_id_v1(self.host_ip)
+        file_req = FileTaskRequest(url=req.url, output="", meta=meta,
+                                   peer_id=peer_id)
+        store = self.storage.register_task(TaskStoreMetadata(
+            task_id=parent_id, peer_id=peer_id, url=req.url, tag=meta.tag,
+            application=meta.application, header=dict(meta.header)))
+        run = _RunningTask(store)
+        self._running[parent_id] = run
+        store.pin()
+        log.info("prefetching whole task for ranged request",
+                 task=parent_id[:16])
+        aio.spawn(self._run_background_download(
+            parent_id, peer_id, file_req, store, run))
 
     async def _run_background_download(self, task_id: str, peer_id: str,
                                        req: FileTaskRequest, store, run: _RunningTask) -> None:

@@ -377,6 +377,34 @@ class LocalTaskStore:
                 bad.append(r.num)
         return sorted(bad)
 
+    def covers_range(self, start: int, length: int) -> bool:
+        """True when every piece overlapping [start, start+length) is
+        present — the partial-reuse predicate (reference
+        storage_manager.go:564 FindPartialCompletedTask checks piece
+        coverage of the requested range the same way)."""
+        m = self.metadata
+        if m.piece_size <= 0 or length <= 0 or start < 0:
+            return False
+        if m.content_length >= 0 and start + length > m.content_length:
+            return False
+        first = start // m.piece_size
+        last = (start + length - 1) // m.piece_size
+        return all(n in m.pieces for n in range(first, last + 1))
+
+    def export_range(self, dest: str, start: int, length: int) -> None:
+        """Write the byte range [start, start+length) to ``dest`` from the
+        covering pieces (caller checks covers_range first)."""
+        os.makedirs(os.path.dirname(os.path.abspath(dest)), exist_ok=True)
+        m = self.metadata
+        first = start // m.piece_size
+        last = (start + length - 1) // m.piece_size
+        end = start + length
+        with open(dest, "wb") as out:
+            for n in range(first, last + 1):
+                data = self.read_piece(n)
+                p0 = n * m.piece_size
+                out.write(data[max(0, start - p0):max(0, min(len(data), end - p0))])
+
     def store_to(self, dest: str, *, hardlink: bool = True) -> None:
         """Land the completed content at ``dest``: hardlink when possible,
         else copy (reference local_storage.go:353)."""
