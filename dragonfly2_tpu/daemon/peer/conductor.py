@@ -267,9 +267,11 @@ class PeerTaskConductor:
     async def _pull_pieces_p2p(self, schedule_msg: dict) -> None:
         self._from_p2p = True
         self._apply_task_meta(schedule_msg.get("task") or {})
+        # Dead parents need no extra hook here: the synchronizer's
+        # drop_parent marks them blocked, and the next starvation pass
+        # sends them in the reschedule blocklist (ref reportInvalidPeer).
         self.synchronizer = PieceTaskSynchronizer(
-            self.task_id, self.peer_id, self.dispatcher,
-            on_parent_dead=self._on_parent_dead)
+            self.task_id, self.peer_id, self.dispatcher)
         self.synchronizer.sync_parents(schedule_msg.get("parents") or [])
         # Resume support: pieces already on disk need no re-download.
         self.dispatcher.mark_known_downloaded(self.store.metadata.pieces.keys())
@@ -329,11 +331,6 @@ class PeerTaskConductor:
                 if self.dispatcher.piece_size > 0 else None,
             )
         return m.total_piece_count >= 0 and self.store.is_complete()
-
-    def _on_parent_dead(self, parent_peer_id: str) -> None:
-        # Next dispatcher starvation triggers a reschedule with this parent
-        # in the blocklist (reference reportInvalidPeer).
-        pass
 
     async def _receive_scheduler_loop(self) -> None:
         """The ONLY reader of the scheduler stream after registration:

@@ -1,0 +1,168 @@
+"""Scheduler churn/load: 200 concurrent registrants on one task.
+
+VERDICT r1 weak #6: `_schedule_and_send` runs a patience loop per
+registering peer; at fleet scale that is hundreds of concurrent retry
+loops. This drives 200 simulated peers (fake announce streams, no real
+daemons) through register → schedule → piece reports → finish, with a
+slice of peers dying mid-download, and asserts: ~1 origin fetch, every
+survivor finishes, and the event loop never stalls (scheduling stays
+O(events), no busy spin). Models the v5p-256 fan-out (SURVEY §6 north
+star) at unit-test scale.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+from dragonfly2_tpu.scheduler.config import SchedulerConfig
+from dragonfly2_tpu.scheduler.service import SchedulerService
+
+N_PEERS = 200
+N_DIE = 30          # peers that vanish mid-download
+N_PIECES = 10
+PIECE_SIZE = 1 << 20
+CONTENT_LEN = N_PIECES * PIECE_SIZE
+
+
+class FakeStream:
+    """Duck-typed ServerStream: the scheduler sends into to_peer, the
+    simulated peer feeds requests into to_sched."""
+
+    def __init__(self, open_body):
+        self.open_body = open_body
+        self.to_sched: asyncio.Queue = asyncio.Queue()
+        self.to_peer: asyncio.Queue = asyncio.Queue()
+
+    async def send(self, body):
+        await self.to_peer.put(body)
+
+    async def recv(self, timeout=None):
+        return await self.to_sched.get()
+
+
+async def _serve(svc: SchedulerService, stream: FakeStream):
+    try:
+        await svc.announce_peer(stream, None)
+    except Exception:
+        pass
+
+
+def _open_body(i: int) -> dict:
+    return {
+        "host": {"id": f"host-{i}", "hostname": f"h{i}", "ip": "10.0.0.1",
+                 "port": 8000 + i, "upload_port": 9000 + i},
+        "peer_id": f"peer-{i}",
+        "task_id": "churn-task",
+        "url": "http://origin/blob",
+    }
+
+
+def test_churn_200_peers_one_origin_fetch(run_async):
+    async def body():
+        rng = random.Random(7)
+        cfg = SchedulerConfig()
+        cfg.scheduling.retry_interval = 0.02
+        cfg.scheduling.no_source_patience = 0.5
+        cfg.seed_peer_enabled = False
+        svc = SchedulerService(cfg)
+
+        origin_fetches = 0
+        finished: set[int] = set()
+        max_lag = 0.0
+
+        async def heartbeat():
+            nonlocal max_lag
+            loop = asyncio.get_running_loop()
+            while True:
+                t0 = loop.time()
+                await asyncio.sleep(0.01)
+                max_lag = max(max_lag, loop.time() - t0 - 0.01)
+
+        async def peer(i: int):
+            nonlocal origin_fetches
+            stream = FakeStream(_open_body(i))
+            server = asyncio.ensure_future(_serve(svc, stream))
+            dies = i < N_DIE and i > 0
+            try:
+                await stream.to_sched.put({"type": "register"})
+                msg = await asyncio.wait_for(stream.to_peer.get(), timeout=30)
+                kind = msg.get("type")
+                if kind == "need_back_source":
+                    origin_fetches += 1
+                elif kind == "small_task":
+                    finished.add(i)
+                    await stream.to_sched.put(
+                        {"type": "download_finished",
+                         "content_length": CONTENT_LEN,
+                         "piece_size": PIECE_SIZE,
+                         "total_piece_count": N_PIECES})
+                    return
+                elif kind != "normal_task":
+                    raise AssertionError(f"peer {i} got {kind}: {msg}")
+
+                await stream.to_sched.put({
+                    "type": "download_started",
+                    "content_length": CONTENT_LEN,
+                    "piece_size": PIECE_SIZE,
+                    "total_piece_count": N_PIECES})
+                for n in range(N_PIECES):
+                    if dies and n == N_PIECES // 2:
+                        return  # vanish: stream reader sees close below
+                    await asyncio.sleep(rng.uniform(0, 0.01))
+                    await stream.to_sched.put({
+                        "type": "piece_finished",
+                        "piece": {"piece_num": n,
+                                  "range_start": n * PIECE_SIZE,
+                                  "range_size": PIECE_SIZE,
+                                  "digest": "", "download_cost_ms": 5,
+                                  "dst_peer_id": ""}})
+                # A slice of survivors exercises the reschedule path first.
+                if i % 10 == 5:
+                    await stream.to_sched.put({"type": "reschedule",
+                                               "blocklist": [],
+                                               "description": "test churn"})
+                    nxt = await asyncio.wait_for(stream.to_peer.get(),
+                                                 timeout=30)
+                    assert nxt.get("type") in ("normal_task",
+                                               "need_back_source"), nxt
+                    if nxt.get("type") == "need_back_source":
+                        origin_fetches += 1
+                await stream.to_sched.put({
+                    "type": "download_finished",
+                    "content_length": CONTENT_LEN,
+                    "piece_size": PIECE_SIZE,
+                    "total_piece_count": N_PIECES})
+                finished.add(i)
+            finally:
+                await stream.to_sched.put(None)  # client half-close
+                await asyncio.wait_for(server, timeout=30)
+
+        hb = asyncio.ensure_future(heartbeat())
+        try:
+            # Staggered arrival storm: all 200 within ~0.5 s.
+            async def delayed(i):
+                await asyncio.sleep(rng.uniform(0, 0.5))
+                await peer(i)
+
+            await asyncio.wait_for(
+                asyncio.gather(*[delayed(i) for i in range(N_PEERS)]),
+                timeout=90)
+        finally:
+            hb.cancel()
+
+        survivors = N_PEERS - (N_DIE - 1)   # peer 0 never dies
+        assert len(finished) == survivors, (len(finished), survivors)
+        # Origin economy: the first peer + at most a couple of reschedule
+        # demotions while the DAG warms up.
+        assert origin_fetches <= 3, origin_fetches
+        # The event loop stayed responsive through the storm.
+        assert max_lag < 0.25, f"event loop stalled {max_lag * 1000:.0f} ms"
+        # All dead peers were cleaned off the DAG (stream-gone handling).
+        task = svc.tasks.load("churn-task")
+        gone = [p for p in task.peers() if p.id in
+                {f"peer-{i}" for i in range(1, N_DIE)}]
+        assert all(p.state in ("failed", "leave") for p in gone), \
+            [(p.id, p.state) for p in gone][:5]
+
+    run_async(body(), timeout=120)
