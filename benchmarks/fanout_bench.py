@@ -1,0 +1,220 @@
+"""BASELINE config #2 bench: P2P fan-out, 1 seed + 8 peers, one origin.
+
+Real processes (scheduler + seed + 8 peer daemons spawned via the CLI,
+mirroring tests/test_multiprocess_e2e.py); the 8 clients run the dfget
+library concurrently against their daemons' unix sockets. Reports:
+
+  - aggregate_gbps      total client bytes delivered / wall time
+  - p50_ttfp_s          median time-to-first-piece across clients
+  - origin_ratio        origin bytes served / content size (1.0 = one copy)
+
+Usage: python benchmarks/fanout_bench.py [--mb 256] [--peers 8]
+Writes a JSON line to stdout and (with --publish) updates
+BASELINE.json["published"]["config2_fanout"].
+
+Reference yardstick: test/e2e/v2/dfget_test.go:26-80 (sha-verified
+fan-out), SURVEY §6; the reference publishes no numbers (BASELINE.md), so
+these become the numbers to beat.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import hashlib
+import json
+import os
+import random
+import signal
+import socket
+import statistics
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from aiohttp import web  # noqa: E402
+
+from dragonfly2_tpu.pkg.piece import Range  # noqa: E402
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn(args: list[str], log_path: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    logf = open(log_path, "w")
+    return subprocess.Popen(
+        [sys.executable, "-m", "dragonfly2_tpu.cli.main", *args],
+        stdout=logf, stderr=subprocess.STDOUT, env=env)
+
+
+def _wait_sock(path: str, timeout: float = 30.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            s = socket.socket(socket.AF_UNIX)
+            try:
+                s.connect(path)
+                return True
+            except OSError:
+                pass
+            finally:
+                s.close()
+        time.sleep(0.1)
+    return False
+
+
+async def run_bench(total_mb: int, n_peers: int, workdir: str) -> dict:
+    # randbytes caps at 2^31 bits; build large content from 16 MiB blocks.
+    rng = random.Random(99)
+    content = b"".join(rng.randbytes(16 << 20)
+                       for _ in range(max(1, total_mb // 16)))
+    sha = hashlib.sha256(content).hexdigest()
+    stats = {"streams": 0, "bytes": 0}
+
+    async def blob(request: web.Request) -> web.Response:
+        stats["streams"] += 1
+        rng = request.headers.get("Range")
+        if rng:
+            r = Range.parse_http(rng, len(content))
+            data = content[r.start:r.start + r.length]
+            stats["bytes"] += len(data)
+            return web.Response(status=206, body=data, headers={
+                "Accept-Ranges": "bytes",
+                "Content-Range":
+                    f"bytes {r.start}-{r.start + r.length - 1}/{len(content)}"})
+        stats["bytes"] += len(content)
+        return web.Response(body=content, headers={"Accept-Ranges": "bytes"})
+
+    app = web.Application()
+    app.router.add_get("/model.safetensors", blob)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    origin_port = site._server.sockets[0].getsockname()[1]
+
+    sched_port = _free_port()
+    procs: list[subprocess.Popen] = []
+    names = ["seed"] + [f"peer{i}" for i in range(n_peers)]
+    homes = {n: os.path.join(workdir, n) for n in names}
+    try:
+        procs.append(_spawn(
+            ["scheduler", "--host", "127.0.0.1", "--port", str(sched_port)],
+            os.path.join(workdir, "sched.log")))
+        procs.append(_spawn(
+            ["daemon", "--work-home", homes["seed"], "--seed-peer",
+             "--scheduler", f"127.0.0.1:{sched_port}"],
+            os.path.join(workdir, "seed.log")))
+        for i in range(n_peers):
+            procs.append(_spawn(
+                ["daemon", "--work-home", homes[f"peer{i}"],
+                 "--scheduler", f"127.0.0.1:{sched_port}"],
+                os.path.join(workdir, f"peer{i}.log")))
+        for n in names:
+            ok = await asyncio.to_thread(
+                _wait_sock, os.path.join(homes[n], "run", "dfdaemon.sock"))
+            if not ok:
+                raise RuntimeError(
+                    f"{n} did not come up; tail: "
+                    + open(os.path.join(workdir, f"{n}.log")).read()[-1500:])
+
+        from dragonfly2_tpu.client import dfget as dfget_lib
+        from dragonfly2_tpu.proto.common import UrlMeta
+
+        url = f"http://127.0.0.1:{origin_port}/model.safetensors"
+        ttfps: list[float] = []
+        t0 = time.perf_counter()
+
+        async def one_client(i: int) -> None:
+            started = time.perf_counter()
+            first_piece = [None]
+
+            def on_progress(frame: dict) -> None:
+                if (first_piece[0] is None
+                        and frame.get("completed_length", 0) > 0):
+                    first_piece[0] = time.perf_counter() - started
+
+            out = os.path.join(workdir, f"out{i}.bin")
+            result = await dfget_lib.download(
+                dfget_lib.DfgetConfig(
+                    url=url, output=out,
+                    daemon_sock=os.path.join(homes[f"peer{i}"], "run",
+                                             "dfdaemon.sock"),
+                    meta=UrlMeta(digest=f"sha256:{sha}"),
+                    allow_source_fallback=False, timeout=600.0),
+                on_progress)
+            if result.get("state") != "done":
+                raise RuntimeError(f"client {i} failed: {result}")
+            with open(out, "rb") as f:
+                actual = hashlib.file_digest(f, "sha256").hexdigest()
+            if actual != sha:
+                raise RuntimeError(f"client {i} sha mismatch")
+            ttfps.append(first_piece[0] if first_piece[0] is not None
+                         else time.perf_counter() - started)
+
+        await asyncio.gather(*[one_client(i) for i in range(n_peers)])
+        wall = time.perf_counter() - t0
+
+        total_bytes = n_peers * len(content)
+        return {
+            "config": "p2p-fanout",
+            "peers": n_peers,
+            "seed_peers": 1,
+            "content_mb": total_mb,
+            "aggregate_gbps": round(total_bytes / wall / 1e9, 3),
+            "per_peer_mbps": round(total_bytes / wall / n_peers / 1e6, 1),
+            "wall_s": round(wall, 2),
+            "p50_ttfp_s": round(statistics.median(ttfps), 3),
+            "origin_ratio": round(stats["bytes"] / len(content), 3),
+            "origin_streams": stats["streams"],
+        }
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        await runner.cleanup()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=int, default=256)
+    ap.add_argument("--peers", type=int, default=8)
+    ap.add_argument("--publish", action="store_true",
+                    help="record the result in BASELINE.json['published']")
+    ap.add_argument("--workdir", default="")
+    args = ap.parse_args()
+
+    import tempfile
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="df-fanout-")
+    result = asyncio.run(run_bench(args.mb, args.peers, workdir))
+    print(json.dumps(result))
+
+    if args.publish:
+        path = os.path.join(REPO, "BASELINE.json")
+        doc = json.load(open(path))
+        doc.setdefault("published", {})["config2_fanout"] = result
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
