@@ -1,0 +1,189 @@
+"""Compose-free localhost fabric launcher.
+
+Boots the docker-compose topology (manager + scheduler + seed peer + N
+peers) as plain processes for machines without docker — e.g. a TPU VM
+where the fabric runs straight on the host. Ctrl-C tears everything down.
+
+  python deploy/local_up.py [--peers 2] [--base-dir /tmp/df-fabric]
+  python deploy/local_up.py --smoke   # boot, dfget a test blob, exit
+
+Ports (host-local): manager REST 18080 / drpc 18065, scheduler 18002;
+daemon ports are ephemeral (printed at boot).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MANAGER_REST = 18080
+MANAGER_GRPC = 18065
+SCHEDULER_PORT = 18002
+
+
+def _spawn(args: list[str], log_path: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    logf = open(log_path, "w")
+    return subprocess.Popen(
+        [sys.executable, "-m", "dragonfly2_tpu.cli.main", *args],
+        stdout=logf, stderr=subprocess.STDOUT, env=env)
+
+
+def _wait_http(url: str, timeout: float = 30.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            urllib.request.urlopen(url, timeout=2)
+            return True
+        except Exception:
+            time.sleep(0.2)
+    return False
+
+
+def _wait_sock(path: str, timeout: float = 30.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            s = socket.socket(socket.AF_UNIX)
+            try:
+                s.connect(path)
+                return True
+            except OSError:
+                pass
+            finally:
+                s.close()
+        time.sleep(0.2)
+    return False
+
+
+def up(base_dir: str, n_peers: int) -> tuple[list[subprocess.Popen], dict]:
+    os.makedirs(base_dir, exist_ok=True)
+    procs: list[subprocess.Popen] = []
+    homes = {}
+
+    procs.append(_spawn(
+        ["manager", "--host", "127.0.0.1", "--port", str(MANAGER_REST),
+         "--grpc-port", str(MANAGER_GRPC),
+         "--db", os.path.join(base_dir, "manager.db")],
+        os.path.join(base_dir, "manager.log")))
+    if not _wait_http(f"http://127.0.0.1:{MANAGER_REST}/healthy"):
+        raise RuntimeError("manager did not come up; see manager.log")
+
+    procs.append(_spawn(
+        ["scheduler", "--host", "127.0.0.1", "--port", str(SCHEDULER_PORT),
+         "--manager", f"127.0.0.1:{MANAGER_GRPC}"],
+        os.path.join(base_dir, "scheduler.log")))
+
+    roles = [("seed", True)] + [(f"peer{i + 1}", False) for i in range(n_peers)]
+    for name, is_seed in roles:
+        home = os.path.join(base_dir, name)
+        homes[name] = home
+        args = ["daemon", "--work-home", home,
+                "--scheduler", f"127.0.0.1:{SCHEDULER_PORT}",
+                "--manager", f"127.0.0.1:{MANAGER_GRPC}"]
+        if is_seed:
+            args.append("--seed-peer")
+        procs.append(_spawn(args, os.path.join(base_dir, f"{name}.log")))
+    for name, _ in roles:
+        sock = os.path.join(homes[name], "run", "dfdaemon.sock")
+        if not _wait_sock(sock):
+            raise RuntimeError(f"{name} did not come up; see {name}.log")
+
+    return procs, homes
+
+
+def down(procs: list[subprocess.Popen]) -> None:
+    for p in reversed(procs):
+        try:
+            p.send_signal(signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def smoke(base_dir: str, homes: dict) -> None:
+    """Serve a blob from this process and dfget it through peer1."""
+    import hashlib
+    import random
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    content = random.Random(5).randbytes(4 << 20)
+    sha = hashlib.sha256(content).hexdigest()
+
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            try:
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(content)))
+                self.send_header("Accept-Ranges", "bytes")
+                self.end_headers()
+                self.wfile.write(content)
+            except OSError:
+                pass  # probe disconnects are expected
+
+        def log_message(self, *a):
+            pass
+
+    httpd = HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_port}/blob"
+    out = os.path.join(base_dir, "smoke.bin")
+    rc = subprocess.run(
+        [sys.executable, "-m", "dragonfly2_tpu.cli.main", "dfget", url,
+         "-O", out, "--work-home", homes["peer1"], "--no-daemon",
+         "--digest", f"sha256:{sha}"],
+        env={**os.environ, "PYTHONPATH": REPO}).returncode
+    httpd.shutdown()
+    if rc != 0:
+        raise RuntimeError("smoke dfget failed")
+    with open(out, "rb") as f:
+        if hashlib.sha256(f.read()).hexdigest() != sha:
+            raise RuntimeError("smoke sha mismatch")
+    print("smoke: dfget through the fabric OK")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--peers", type=int, default=2)
+    ap.add_argument("--base-dir", default="/tmp/df-fabric")
+    ap.add_argument("--smoke", action="store_true",
+                    help="boot, run one dfget through peer1, tear down")
+    args = ap.parse_args()
+
+    procs, homes = up(args.base_dir, args.peers)
+    print(json.dumps({
+        "manager_rest": f"http://127.0.0.1:{MANAGER_REST}",
+        "scheduler": f"127.0.0.1:{SCHEDULER_PORT}",
+        "daemons": {n: os.path.join(h, "run", "dfdaemon.sock")
+                    for n, h in homes.items()},
+    }, indent=2))
+    try:
+        if args.smoke:
+            smoke(args.base_dir, homes)
+            return 0
+        print("fabric up — Ctrl-C to stop")
+        signal.sigwait({signal.SIGINT, signal.SIGTERM})
+        return 0
+    finally:
+        down(procs)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

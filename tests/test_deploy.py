@@ -1,0 +1,40 @@
+"""Deploy artifacts: the compose-free launcher boots the full topology.
+
+Reference: deploy/docker-compose/docker-compose.yaml:51-93 (manager +
+scheduler + seed + peers). The Dockerfile/compose files are validated by
+shape here (can't run docker in CI); deploy/local_up.py is exercised for
+real: full boot + a dfget through the fabric.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_compose_topology_shape():
+    doc = yaml.safe_load(open(os.path.join(REPO, "deploy/docker-compose.yaml")))
+    services = doc["services"]
+    assert set(services) == {"manager", "scheduler", "seed-peer", "peer1", "peer2"}
+    assert services["scheduler"]["command"][0] == "scheduler"
+    assert "--seed-peer" in services["seed-peer"]["command"]
+    # Every service runs the one image with a role command.
+    assert all(s["image"] == "dragonfly2-tpu" for s in services.values())
+
+
+def test_local_up_smoke(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "deploy/local_up.py"),
+         "--smoke", "--peers", "1", "--base-dir", str(tmp_path / "fabric")],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert "smoke: dfget through the fabric OK" in proc.stdout
