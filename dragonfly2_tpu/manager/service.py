@@ -29,9 +29,18 @@ _CACHE_TTL = 10.0
 
 
 class ManagerService:
-    def __init__(self, db: Database | None = None):
+    def __init__(self, db: Database | None = None, *,
+                 searcher_plugin: str = ""):
         self.db = db or Database()
-        self.searcher = Searcher()
+        if searcher_plugin:
+            # Plugin-replaceable scheduler-cluster searcher (reference
+            # searcher.go:94 New → dfplugin lookup).
+            from dragonfly2_tpu.pkg import dfplugin
+
+            self.searcher = dfplugin.registry().create(
+                dfplugin.TYPE_SEARCHER, searcher_plugin)
+        else:
+            self.searcher = Searcher()
         self.jobs = jobqueue.JobQueue(self.db)
         self.signer = auth.TokenSigner()
         self._cache = TTLCache(default_ttl=_CACHE_TTL)

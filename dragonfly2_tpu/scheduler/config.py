@@ -35,6 +35,10 @@ class SchedulerServerConfig:
 
 @dataclass
 class SchedulingConfig:
+    # "default" = built-in weighted evaluator; any other name is resolved
+    # through the plugin registry (reference evaluator plugin.go:39
+    # LoadPlugin when algorithm == "plugin").
+    algorithm: str = "default"
     candidate_parent_limit: int = CANDIDATE_PARENT_LIMIT
     filter_parent_limit: int = FILTER_PARENT_LIMIT
     retry_limit: int = RETRY_LIMIT

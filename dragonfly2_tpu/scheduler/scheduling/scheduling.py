@@ -36,7 +36,16 @@ class ScheduleResult:
 class Scheduling:
     def __init__(self, config: SchedulingConfig | None = None, evaluator: Evaluator | None = None):
         self.config = config or SchedulingConfig()
-        self.evaluator = evaluator or Evaluator(self.config)
+        if evaluator is None:
+            algo = getattr(self.config, "algorithm", "default")
+            if algo and algo != "default":
+                from dragonfly2_tpu.pkg import dfplugin
+
+                evaluator = dfplugin.registry().create(
+                    dfplugin.TYPE_EVALUATOR, algo, config=self.config)
+            else:
+                evaluator = Evaluator(self.config)
+        self.evaluator = evaluator
 
     # -- v2-style scheduling (reference :85-213) ---------------------------
 

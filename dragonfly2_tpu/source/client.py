@@ -131,7 +131,21 @@ class Registry:
             scheme = urlsplit(url_or_scheme).scheme
         client = self._clients.get(scheme.lower())
         if client is None:
+            client = self._try_plugin(scheme.lower())
+        if client is None:
             raise SourceError(f"no source client for scheme {scheme!r}", Code.UnsupportedProtocol)
+        return client
+
+    def _try_plugin(self, scheme: str) -> ResourceClient | None:
+        """Unknown scheme: ask the plugin registry (reference
+        dfplugin.go:53-55 source plugin lookup) and cache the instance."""
+        from dragonfly2_tpu.pkg import dfplugin
+
+        factory = dfplugin.registry().get(dfplugin.TYPE_SOURCE, scheme)
+        if factory is None:
+            return None
+        client = factory() if callable(factory) else factory
+        self._clients[scheme] = client
         return client
 
     def schemes(self) -> list[str]:
