@@ -75,12 +75,12 @@ def bench_device_sink(total_mb: int = 512, piece_mb: int = 4, repeats: int = 5,
 
 
 def bench_staged_transfer(total_mb: int = 256, repeats: int = 5) -> float:
-    """Host→HBM staging GB/s (jax.device_put of pinned host pieces): the
-    transport leg the sink metric deliberately excludes. Reported alongside
-    so an end-to-end budget (BASELINE config #5's <60 s) can be decomposed
-    into staging + sink and neither hides the other's bottleneck."""
+    """Host→HBM staging GB/s (jax.device_put of a pageable host buffer —
+    the daemon's piece staging path): the transport leg the sink metric
+    deliberately excludes. Reported alongside so an end-to-end budget
+    (BASELINE config #5's <60 s) can be decomposed into staging + sink and
+    neither hides the other's bottleneck."""
     import jax
-    import jax.numpy as jnp
 
     n = (total_mb << 20) // 4
     host = np.random.RandomState(2).randint(

@@ -1,0 +1,96 @@
+"""Minimal embedded web console.
+
+Reference: the manager serves the dragonflyoss/console frontend submodule
+from manager/dist (manager.go New). A full SPA is out of scope for a
+fabric whose operators live in terminals; this single-file console covers
+the same read surface — clusters, schedulers, seed peers, peers, jobs —
+against the REST API with token sign-in, so the inventory item is real
+and usable rather than a submodule pointer.
+"""
+
+INDEX_HTML = """<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>dragonfly2-tpu console</title>
+<style>
+  body { font-family: ui-monospace, SFMono-Regular, Menlo, monospace;
+         margin: 2rem auto; max-width: 70rem; color: #222; }
+  h1 { font-size: 1.2rem; }
+  h2 { font-size: 1rem; margin-top: 1.5rem; }
+  table { border-collapse: collapse; width: 100%; font-size: 0.85rem; }
+  th, td { border: 1px solid #ccc; padding: 0.3rem 0.5rem; text-align: left; }
+  th { background: #f4f4f4; }
+  input, button { font: inherit; padding: 0.25rem 0.5rem; }
+  .err { color: #b00020; }
+  .state-active { color: #0a7d33; }
+  .state-inactive { color: #999; }
+</style>
+</head>
+<body>
+<h1>dragonfly2-tpu manager</h1>
+<div id="signin">
+  <input id="user" placeholder="user" value="root">
+  <input id="pass" placeholder="password" type="password">
+  <button onclick="signin()">sign in</button>
+  <span id="msg" class="err"></span>
+</div>
+<div id="main" style="display:none">
+  <h2>scheduler clusters</h2><table id="scheduler-clusters"></table>
+  <h2>schedulers</h2><table id="schedulers"></table>
+  <h2>seed peers</h2><table id="seed-peers"></table>
+  <h2>peers</h2><table id="peers"></table>
+  <h2>jobs</h2><table id="jobs"></table>
+</div>
+<script>
+let token = "";
+async function api(path) {
+  const r = await fetch("/api/v1/" + path,
+                        {headers: {Authorization: "Bearer " + token}});
+  if (!r.ok) throw new Error(path + ": " + r.status);
+  return await r.json();
+}
+function render(id, rows, cols) {
+  const t = document.getElementById(id);
+  if (!rows || !rows.length) { t.innerHTML = "<tr><td>none</td></tr>"; return; }
+  cols = cols || Object.keys(rows[0]).filter(
+      k => typeof rows[0][k] !== "object").slice(0, 8);
+  t.innerHTML = "<tr>" + cols.map(c => "<th>" + c + "</th>").join("") + "</tr>"
+    + rows.map(r => "<tr>" + cols.map(c => {
+        let v = r[c] == null ? "" : r[c];
+        const cls = c === "state" ? ' class="state-' + v + '"' : "";
+        return "<td" + cls + ">" + v + "</td>";
+      }).join("") + "</tr>").join("");
+}
+async function refresh() {
+  render("scheduler-clusters", await api("scheduler-clusters"),
+         ["id", "name", "bio", "is_default"]);
+  render("schedulers", await api("schedulers"),
+         ["id", "hostname", "ip", "port", "state", "scheduler_cluster_id"]);
+  render("seed-peers", await api("seed-peers"),
+         ["id", "hostname", "ip", "port", "download_port", "state"]);
+  render("peers", await api("peers"),
+         ["id", "hostname", "ip", "port", "state"]);
+  render("jobs", await api("jobs"),
+         ["id", "type", "state", "created_at"]);
+}
+async function signin() {
+  document.getElementById("msg").textContent = "";
+  try {
+    const r = await fetch("/api/v1/users/signin", {method: "POST",
+      body: JSON.stringify({name: document.getElementById("user").value,
+                            password: document.getElementById("pass").value})});
+    if (!r.ok) throw new Error("signin " + r.status);
+    token = (await r.json()).token;
+    document.getElementById("signin").style.display = "none";
+    document.getElementById("main").style.display = "";
+    await refresh();
+    setInterval(refresh, 5000);
+  } catch (e) {
+    document.getElementById("msg").textContent = e.message;
+  }
+}
+</script>
+</body>
+</html>
+"""

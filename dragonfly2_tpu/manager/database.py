@@ -144,6 +144,10 @@ CREATE TABLE IF NOT EXISTS oauth (
   client_id TEXT DEFAULT '',
   client_secret TEXT DEFAULT '',
   redirect_url TEXT DEFAULT '',
+  auth_url TEXT DEFAULT '',
+  token_url TEXT DEFAULT '',
+  user_info_url TEXT DEFAULT '',
+  scopes TEXT DEFAULT '',
   created_at REAL, updated_at REAL
 );
 CREATE TABLE IF NOT EXISTS jobs (

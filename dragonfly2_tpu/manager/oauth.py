@@ -1,0 +1,113 @@
+"""OAuth2 sign-in providers.
+
+Reference: manager/auth/ (oauth2 sign-in via provider rows in the oauth
+table; handlers/oauth.go + user sign-in redirect flow). Providers are
+plain authorization-code OAuth2 endpoints configured per row — the
+reference hardcodes google/github shapes in the SDKs; here any spec-shaped
+provider works (auth_url/token_url/user_info_url).
+
+Flow:
+  GET /api/v1/users/signin/oauth/{name}
+      → {"redirect_url": "<auth_url>?client_id=...&state=..."}
+  provider redirects to <redirect_url>?code=C&state=S
+  GET /api/v1/oauth/{name}/callback?code=C&state=S
+      → exchanges the code, fetches user info, upserts the user
+        (oauth-{provider}-{remote id}), returns a signed session token.
+"""
+
+from __future__ import annotations
+
+import secrets
+import time
+from urllib.parse import urlencode
+
+import aiohttp
+
+from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg.errors import Code, DfError
+
+log = dflog.get("manager.oauth")
+
+_STATE_TTL = 600.0
+
+
+class OAuthFlow:
+    def __init__(self, service):
+        self.service = service
+        self._states: dict[str, float] = {}  # state -> issue time
+
+    def _provider(self, name: str) -> dict:
+        row = self.service.db.find("oauth", name=name)
+        if not row:
+            raise DfError(Code.NotFound, f"oauth provider {name!r} not found")
+        return row
+
+    def _check_state(self, state: str) -> bool:
+        now = time.time()
+        self._states = {s: t for s, t in self._states.items()
+                        if now - t < _STATE_TTL}
+        return self._states.pop(state, None) is not None
+
+    def authorize_url(self, name: str) -> str:
+        p = self._provider(name)
+        state = secrets.token_urlsafe(16)
+        self._states[state] = time.time()
+        query = urlencode({
+            "response_type": "code",
+            "client_id": p["client_id"],
+            "redirect_uri": p["redirect_url"],
+            "scope": p.get("scopes") or "",
+            "state": state,
+        })
+        return f"{p['auth_url']}?{query}"
+
+    async def exchange(self, name: str, code: str, state: str) -> str:
+        """Code → provider token → user info → local user → session token."""
+        p = self._provider(name)
+        if not self._check_state(state):
+            raise DfError(Code.Unauthorized, "bad oauth state")
+        async with aiohttp.ClientSession() as http:
+            async with http.post(p["token_url"], data={
+                "grant_type": "authorization_code",
+                "code": code,
+                "client_id": p["client_id"],
+                "client_secret": p["client_secret"],
+                "redirect_uri": p["redirect_url"],
+            }, headers={"Accept": "application/json"}) as resp:
+                if resp.status != 200:
+                    raise DfError(Code.Unauthorized,
+                                  f"token exchange failed ({resp.status})")
+                token_doc = await resp.json(content_type=None)
+            access = token_doc.get("access_token", "")
+            if not access:
+                raise DfError(Code.Unauthorized, "provider returned no token")
+            async with http.get(p["user_info_url"], headers={
+                "Authorization": f"Bearer {access}",
+                "Accept": "application/json",
+            }) as resp:
+                if resp.status != 200:
+                    raise DfError(Code.Unauthorized,
+                                  f"user info failed ({resp.status})")
+                info = await resp.json(content_type=None)
+
+        remote_id = str(info.get("id") or info.get("sub") or info.get("login")
+                        or info.get("email") or "")
+        if not remote_id:
+            raise DfError(Code.Unauthorized, "user info lacks an id")
+        local_name = f"oauth-{name}-{remote_id}"
+        user = self.service.db.find("users", name=local_name)
+        if user is None:
+            from dragonfly2_tpu.manager import auth
+
+            user = self.service.db.insert("users", {
+                "name": local_name,
+                # Unusable password: oauth users sign in via the provider.
+                "encrypted_password": auth.hash_password(
+                    secrets.token_urlsafe(32)),
+                "email": info.get("email", ""),
+            })
+            self.service.db.insert(
+                "user_roles", {"user_id": user["id"], "role": auth.ROLE_GUEST})
+            log.info("oauth user created", provider=name, user=local_name)
+        return self.service.signer.sign(
+            user["id"], local_name, self.service.roles_of(user["id"]))

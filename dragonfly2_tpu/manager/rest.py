@@ -24,7 +24,14 @@ from dragonfly2_tpu.pkg.errors import Code, DfError
 log = dflog.get("manager.rest")
 
 _PUBLIC = {("POST", "/api/v1/users/signin"), ("POST", "/api/v1/users/signup"),
-           ("GET", "/healthy"), ("GET", "/metrics")}
+           ("GET", "/healthy"), ("GET", "/metrics"), ("GET", "/")}
+def _is_public_oauth_path(path: str) -> bool:
+    """Only the two oauth redirect legs are tokenless: the signin-redirect
+    builder and the provider callback. The generic /api/v1/oauth/{id}
+    resource reads stay authenticated."""
+    return (path.startswith("/api/v1/users/signin/oauth/")
+            or (path.startswith("/api/v1/oauth/")
+                and path.endswith("/callback")))
 
 # table -> mutable columns accepted from the API
 _RESOURCES: dict[str, set[str]] = {
@@ -39,7 +46,8 @@ _RESOURCES: dict[str, set[str]] = {
     "peers": set(),  # read/delete only; rows come from sync-peers jobs
     "applications": {"name", "url", "bio", "priority", "user_id"},
     "configs": {"name", "value", "bio", "user_id"},
-    "oauth": {"name", "bio", "client_id", "client_secret", "redirect_url"},
+    "oauth": {"name", "bio", "client_id", "client_secret", "redirect_url",
+              "auth_url", "token_url", "user_info_url", "scopes"},
     "buckets": {"name"},
 }
 _TABLE_OF = {r: r.replace("-", "_") for r in _RESOURCES}
@@ -67,7 +75,10 @@ def json_error(e: Exception) -> web.Response:
 
 class RestServer:
     def __init__(self, service: ManagerService):
+        from dragonfly2_tpu.manager.oauth import OAuthFlow
+
         self.service = service
+        self._oauth_flow = OAuthFlow(service)
         self._runner: web.AppRunner | None = None
         self._port = 0
 
@@ -76,14 +87,26 @@ class RestServer:
         r = app.router
         r.add_get("/healthy", self._healthy)
         r.add_get("/metrics", self._metrics)
+        r.add_get("/", self._console)
         r.add_post("/api/v1/users/signin", self._signin)
         r.add_post("/api/v1/users/signup", self._signup)
+        r.add_get("/api/v1/users/signin/oauth/{name}", self._oauth_signin)
+        r.add_get("/api/v1/oauth/{name}/callback", self._oauth_callback)
         r.add_get("/api/v1/users/{id}", self._get_user)
         r.add_post("/api/v1/users/{id}/reset_password", self._reset_password)
         r.add_get("/api/v1/users/{id}/roles", self._get_roles)
         r.add_post("/api/v1/personal-access-tokens", self._create_pat)
         r.add_get("/api/v1/personal-access-tokens", self._list_pats)
         r.add_delete("/api/v1/personal-access-tokens/{id}", self._delete_pat)
+        # RBAC management (reference manager/permission/rbac, handlers
+        # permission.go / role.go): roles, per-role policies, user grants.
+        r.add_get("/api/v1/roles", self._list_roles)
+        r.add_get("/api/v1/roles/{role}", self._get_role_policies)
+        r.add_post("/api/v1/roles", self._create_role_policy)
+        r.add_delete("/api/v1/roles/{role}", self._delete_role_policy)
+        r.add_put("/api/v1/users/{id}/roles/{role}", self._grant_role)
+        r.add_delete("/api/v1/users/{id}/roles/{role}", self._revoke_role)
+        r.add_get("/api/v1/permissions", self._list_permissions)
         r.add_post("/api/v1/jobs", self._create_job)
         r.add_get("/api/v1/jobs", self._list_jobs)
         r.add_get("/api/v1/jobs/{id}", self._get_job)
@@ -120,7 +143,9 @@ class RestServer:
     @web.middleware
     async def _auth_middleware(self, request: web.Request, handler):
         try:
-            if (request.method, request.path) in _PUBLIC:
+            if ((request.method, request.path) in _PUBLIC
+                    or (request.method == "GET"
+                        and _is_public_oauth_path(request.path))):
                 return await handler(request)
             token = request.headers.get("Authorization", "")
             if token.startswith("Bearer "):
@@ -128,7 +153,8 @@ class RestServer:
             identity = self.service.verify_token(token) if token else None
             if identity is None:
                 return web.json_response({"message": "unauthorized"}, status=401)
-            if not auth.can(identity.get("roles", []), request.method):
+            if not self.service.rbac.enforce_request(
+                    identity.get("roles", []), request.method, request.path):
                 return web.json_response({"message": "forbidden"}, status=403)
             request["identity"] = identity
             return await handler(request)
@@ -141,7 +167,31 @@ class RestServer:
                 return json_error(e)
             return web.json_response({"message": str(e)}, status=400)
 
+    # -- console -----------------------------------------------------------
+
+    async def _console(self, request: web.Request) -> web.Response:
+        from dragonfly2_tpu.manager.console import INDEX_HTML
+
+        return web.Response(text=INDEX_HTML, content_type="text/html")
+
     # -- auth endpoints ----------------------------------------------------
+
+    async def _oauth_signin(self, request: web.Request) -> web.Response:
+        try:
+            url = self._oauth_flow.authorize_url(request.match_info["name"])
+        except DfError as e:
+            return json_error(e)
+        return web.json_response({"redirect_url": url})
+
+    async def _oauth_callback(self, request: web.Request) -> web.Response:
+        try:
+            token = await self._oauth_flow.exchange(
+                request.match_info["name"],
+                request.query.get("code", ""),
+                request.query.get("state", ""))
+        except DfError as e:
+            return json_error(e)
+        return web.json_response({"token": token})
 
     async def _signin(self, request: web.Request) -> web.Response:
         body = await request.json()
@@ -175,6 +225,63 @@ class RestServer:
         self.service.reset_password(int(request.match_info["id"]),
                                     body["new_password"])
         return web.json_response({})
+
+    # -- RBAC endpoints ----------------------------------------------------
+
+    @staticmethod
+    def _require_root(request: web.Request) -> web.Response | None:
+        """Role/policy mutation is root-only: enforcement by path object
+        alone would let any role with write access to "users"/"roles"
+        grant itself root (privilege escalation)."""
+        if auth.ROLE_ROOT not in request["identity"].get("roles", []):
+            return web.json_response({"message": "root required"}, status=403)
+        return None
+
+    async def _list_roles(self, request: web.Request) -> web.Response:
+        return web.json_response({"roles": self.service.rbac.roles()})
+
+    async def _get_role_policies(self, request: web.Request) -> web.Response:
+        role = request.match_info["role"]
+        return web.json_response(
+            {"role": role, "policies": self.service.rbac.policies(role)})
+
+    async def _create_role_policy(self, request: web.Request) -> web.Response:
+        if (deny := self._require_root(request)) is not None:
+            return deny
+        body = await request.json()
+        self.service.rbac.add_policy(body["role"], body["object"],
+                                     body.get("action", "read"))
+        return web.json_response({"ok": True})
+
+    async def _delete_role_policy(self, request: web.Request) -> web.Response:
+        if (deny := self._require_root(request)) is not None:
+            return deny
+        role = request.match_info["role"]
+        body = await request.json()
+        self.service.rbac.remove_policy(role, body["object"],
+                                        body.get("action", "read"))
+        return web.json_response({"ok": True})
+
+    async def _grant_role(self, request: web.Request) -> web.Response:
+        if (deny := self._require_root(request)) is not None:
+            return deny
+        self.service.grant_role(int(request.match_info["id"]),
+                                request.match_info["role"])
+        return web.json_response({"ok": True})
+
+    async def _revoke_role(self, request: web.Request) -> web.Response:
+        if (deny := self._require_root(request)) is not None:
+            return deny
+        self.service.revoke_role(int(request.match_info["id"]),
+                                 request.match_info["role"])
+        return web.json_response({"ok": True})
+
+    async def _list_permissions(self, request: web.Request) -> web.Response:
+        """Permission vocabulary: the resource groups policies can name."""
+        objects = sorted(_RESOURCES) + ["jobs", "users", "roles",
+                                        "personal-access-tokens", "*"]
+        return web.json_response(
+            {"objects": objects, "actions": ["read", "*"]})
 
     async def _create_pat(self, request: web.Request) -> web.Response:
         body = await request.json()

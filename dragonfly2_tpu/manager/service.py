@@ -43,6 +43,9 @@ class ManagerService:
             self.searcher = Searcher()
         self.jobs = jobqueue.JobQueue(self.db)
         self.signer = auth.TokenSigner()
+        from dragonfly2_tpu.manager.rbac import Enforcer
+
+        self.rbac = Enforcer(self.db)
         self._cache = TTLCache(default_ttl=_CACHE_TTL)
         # Keepalive stream generations: the newest stream per instance owns
         # liveness; stale stream teardowns must not flip an instance inactive.
@@ -90,6 +93,15 @@ class ManagerService:
 
     def roles_of(self, user_id: int) -> list[str]:
         return [r["role"] for r in self.db.list("user_roles", user_id=user_id)]
+
+    def grant_role(self, user_id: int, role: str) -> None:
+        if not self.db.find("user_roles", user_id=user_id, role=role):
+            self.db.insert("user_roles", {"user_id": user_id, "role": role})
+
+    def revoke_role(self, user_id: int, role: str) -> None:
+        # user_roles has no surrogate id (pure join table) — delete by key.
+        self.db.execute("DELETE FROM user_roles WHERE user_id=? AND role=?",
+                        (user_id, role))
 
     def reset_password(self, user_id: int, new_password: str) -> None:
         self.db.update("users", user_id,

@@ -22,7 +22,10 @@ class Code(enum.IntEnum):
     ServerUnavailable = 500
     ResourceLacked = 501
     BadRequest = 400
+    Unauthorized = 401
     PeerTaskNotFound = 404
+    NotFound = 404              # alias: generic REST not-found
+    InvalidArgument = 422
     UnknownError = 1000
     RequestTimeout = 1001
 

@@ -6,7 +6,10 @@ dashboards (cmd/dependency/dependency.go:95-114). The /debug surface is
 the Python analog of pprof: live thread stacks and asyncio task dumps.
 
 Routes: GET /metrics (Prometheus text), GET /healthy,
-        GET /debug/stacks (all thread stacks), GET /debug/tasks (asyncio).
+        GET /debug/stacks (all thread stacks), GET /debug/tasks (asyncio),
+        GET /debug/profile?seconds=N (cProfile sample, pprof's CPU
+        profile analog), GET /debug/heap?topn=N (tracemalloc snapshot,
+        pprof's heap profile analog; first call arms tracing).
 """
 
 from __future__ import annotations
@@ -50,6 +53,7 @@ class MetricsServer:
     def __init__(self):
         self._runner: web.AppRunner | None = None
         self._port = 0
+        self._profiling = False
 
     async def serve(self, host: str, port: int) -> int:
         app = web.Application()
@@ -57,6 +61,8 @@ class MetricsServer:
         app.router.add_get("/healthy", self._healthy)
         app.router.add_get("/debug/stacks", self._stacks)
         app.router.add_get("/debug/tasks", self._tasks)
+        app.router.add_get("/debug/profile", self._profile)
+        app.router.add_get("/debug/heap", self._heap)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, host, port)
@@ -87,3 +93,58 @@ class MetricsServer:
 
     async def _tasks(self, request: web.Request) -> web.Response:
         return web.Response(text=_task_dump())
+
+    async def _profile(self, request: web.Request) -> web.Response:
+        """CPU profile of the event-loop thread for ?seconds=N (default 5,
+        cap 60): cProfile runs while the loop keeps serving, then pstats
+        text comes back — the pprof /debug/pprof/profile analog."""
+        import cProfile
+        import pstats
+
+        try:
+            seconds = min(max(float(request.query.get("seconds", "5")), 0.1),
+                          60.0)
+        except ValueError:
+            return web.Response(text="bad seconds value\n", status=400)
+        if self._profiling:
+            return web.Response(text="a profile is already running\n",
+                                status=409)
+        self._profiling = True
+        prof = cProfile.Profile()
+        try:
+            try:
+                prof.enable()
+            except ValueError as e:  # another profiler is active
+                return web.Response(text=f"{e}\n", status=409)
+            try:
+                await asyncio.sleep(seconds)
+            finally:
+                prof.disable()
+        finally:
+            self._profiling = False
+        out = io.StringIO()
+        stats = pstats.Stats(prof, stream=out)
+        stats.sort_stats("cumulative").print_stats(60)
+        return web.Response(text=out.getvalue())
+
+    async def _heap(self, request: web.Request) -> web.Response:
+        """Heap allocation snapshot via tracemalloc (armed on first call;
+        subsequent calls show current top allocators) — the pprof
+        /debug/pprof/heap analog."""
+        import tracemalloc
+
+        try:
+            topn = min(int(request.query.get("topn", "30")), 200)
+        except ValueError:
+            return web.Response(text="bad topn value\n", status=400)
+        if not tracemalloc.is_tracing():
+            tracemalloc.start()
+            return web.Response(
+                text="tracemalloc armed; call again for a snapshot\n")
+        snapshot = tracemalloc.take_snapshot()
+        current, peak = tracemalloc.get_traced_memory()
+        lines = [f"traced current={current / 1e6:.1f}MB "
+                 f"peak={peak / 1e6:.1f}MB", ""]
+        for stat in snapshot.statistics("lineno")[:topn]:
+            lines.append(str(stat))
+        return web.Response(text="\n".join(lines) + "\n")

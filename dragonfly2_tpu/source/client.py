@@ -199,6 +199,18 @@ def _ensure_builtin_clients() -> None:
             _default.register("s3", S3SourceClient())
     except Exception:
         pass
+    try:
+        from dragonfly2_tpu.source.clients.oss import (
+            OBSSourceClient,
+            OSSSourceClient,
+        )
+
+        if OSSSourceClient.available() and "oss" not in _default._clients:
+            _default.register("oss", OSSSourceClient())
+        if OBSSourceClient.available() and "obs" not in _default._clients:
+            _default.register("obs", OBSSourceClient())
+    except Exception:
+        pass
     if "hdfs" not in _default._clients:
         from dragonfly2_tpu.source.clients.hdfs import HDFSSourceClient
 

@@ -1,0 +1,51 @@
+"""oss:// and obs:// origin clients.
+
+Reference: pkg/source/clients/ossprotocol/oss.go (389 LoC over the Aliyun
+SDK). Aliyun OSS and Huawei OBS both expose S3-compatible endpoints, so
+these ride the same SigV4 object-storage client as s3:// — one signing
+implementation, three schemes (the reference carries separate SDK
+wrappers because the Go SDKs differ, not the wire).
+
+Env (OSS):  DF_OSS_ENDPOINT, OSS_ACCESS_KEY_ID, OSS_ACCESS_KEY_SECRET
+Env (OBS):  DF_OBS_ENDPOINT, OBS_ACCESS_KEY_ID, OBS_SECRET_ACCESS_KEY
+"""
+
+from __future__ import annotations
+
+import os
+
+from dragonfly2_tpu.pkg.objectstorage.s3 import S3ObjectStorage
+from dragonfly2_tpu.source.clients.s3 import S3SourceClient
+
+
+class OSSSourceClient(S3SourceClient):
+    scheme = "oss"
+
+    def __init__(self, backend: S3ObjectStorage | None = None):
+        super().__init__(backend or S3ObjectStorage(
+            endpoint=os.environ.get(
+                "DF_OSS_ENDPOINT", "https://oss-cn-hangzhou.aliyuncs.com"),
+            access_key=os.environ.get("OSS_ACCESS_KEY_ID", ""),
+            secret_key=os.environ.get("OSS_ACCESS_KEY_SECRET", ""),
+            region=os.environ.get("OSS_REGION", "cn-hangzhou")))
+
+    @staticmethod
+    def available() -> bool:
+        return bool(os.environ.get("DF_OSS_ENDPOINT")
+                    or os.environ.get("OSS_ACCESS_KEY_ID"))
+
+class OBSSourceClient(OSSSourceClient):
+    scheme = "obs"
+
+    def __init__(self, backend: S3ObjectStorage | None = None):
+        S3SourceClient.__init__(self, backend or S3ObjectStorage(
+            endpoint=os.environ.get(
+                "DF_OBS_ENDPOINT", "https://obs.cn-north-4.myhuaweicloud.com"),
+            access_key=os.environ.get("OBS_ACCESS_KEY_ID", ""),
+            secret_key=os.environ.get("OBS_SECRET_ACCESS_KEY", ""),
+            region=os.environ.get("OBS_REGION", "cn-north-4")))
+
+    @staticmethod
+    def available() -> bool:
+        return bool(os.environ.get("DF_OBS_ENDPOINT")
+                    or os.environ.get("OBS_ACCESS_KEY_ID"))

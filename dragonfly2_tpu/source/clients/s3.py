@@ -25,14 +25,16 @@ from dragonfly2_tpu.source.client import (
 )
 
 
-def _parse(url: str) -> tuple[str, str]:
-    parts = urlsplit(url)
-    if parts.scheme != "s3":
-        raise SourceError(f"not an s3 url: {url}", Code.UnsupportedProtocol)
-    return parts.netloc, parts.path.lstrip("/")
-
-
 class S3SourceClient(ResourceClient):
+    scheme = "s3"   # subclasses (oss/obs) override
+
+    def _parse(self, url: str) -> tuple[str, str]:
+        parts = urlsplit(url)
+        if parts.scheme != self.scheme:
+            raise SourceError(f"not an {self.scheme} url: {url}",
+                              Code.UnsupportedProtocol)
+        return parts.netloc, parts.path.lstrip("/")
+
     def __init__(self, backend: S3ObjectStorage | None = None):
         self._backend = backend or S3ObjectStorage(
             endpoint=os.environ.get("DF_S3_ENDPOINT")
@@ -50,7 +52,7 @@ class S3SourceClient(ResourceClient):
                     or os.environ.get("AWS_ACCESS_KEY_ID"))
 
     async def download(self, request: Request) -> Response:
-        bucket, key = _parse(request.url)
+        bucket, key = self._parse(request.url)
         start, end = -1, -1
         content_length = -1
         rng_header = request.header.get("Range", "")
@@ -73,7 +75,7 @@ class S3SourceClient(ResourceClient):
                         content_length=content_length, support_range=True)
 
     async def get_content_length(self, request: Request) -> int:
-        bucket, key = _parse(request.url)
+        bucket, key = self._parse(request.url)
         try:
             return (await self._backend.get_object_metadata(bucket, key)).content_length
         except ObjectStorageError as e:
@@ -83,13 +85,13 @@ class S3SourceClient(ResourceClient):
         return True
 
     async def list_metadata(self, request: Request) -> list[ListEntry]:
-        bucket, prefix = _parse(request.url)
+        bucket, prefix = self._parse(request.url)
         try:
             metas = await self._backend.list_object_metadatas(
                 bucket, prefix=prefix.rstrip("/") + "/" if prefix else "")
         except ObjectStorageError as e:
             raise SourceError(f"s3 list {request.url}: {e}", Code.SourceNotFound)
-        return [ListEntry(url=f"s3://{bucket}/{m.key}", name=m.key,
+        return [ListEntry(url=f"{self.scheme}://{bucket}/{m.key}", name=m.key,
                           is_dir=False, content_length=m.content_length)
                 for m in metas]
 

@@ -1,0 +1,205 @@
+"""Fine RBAC, OAuth sign-in, embedded console, profiling endpoints.
+
+Reference: manager/permission/rbac/rbac.go (casbin role policies),
+manager/auth (oauth2 providers), manager console submodule,
+cmd/dependency/dependency.go:95-114 (pprof endpoints).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import aiohttp
+from aiohttp import web
+
+from dragonfly2_tpu.manager.rest import RestServer
+from dragonfly2_tpu.manager.service import ManagerService
+
+
+async def _start_rest(svc: ManagerService) -> tuple[RestServer, int]:
+    rest = RestServer(svc)
+    port = await rest.serve("127.0.0.1", 0)
+    return rest, port
+
+
+async def _signin(http, port, name, password) -> str:
+    async with http.post(f"http://127.0.0.1:{port}/api/v1/users/signin",
+                         json={"name": name, "password": password}) as r:
+        assert r.status == 200, await r.text()
+        return (await r.json())["token"]
+
+
+def test_rbac_custom_role_policies(run_async):
+    """A custom role grants exactly its policies: job-operator can manage
+    jobs but only read schedulers; guests stay read-only everywhere."""
+    async def run():
+        svc = ManagerService()
+        rest, port = await _start_rest(svc)
+        try:
+            async with aiohttp.ClientSession() as http:
+                root = await _signin(http, port, "root", "dragonfly")
+                h_root = {"Authorization": f"Bearer {root}"}
+
+                # Root defines the role and creates an operator user.
+                async with http.post(
+                        f"http://127.0.0.1:{port}/api/v1/roles",
+                        json={"role": "job-operator", "object": "jobs",
+                              "action": "*"}, headers=h_root) as r:
+                    assert r.status == 200
+                async with http.post(
+                        f"http://127.0.0.1:{port}/api/v1/users/signup",
+                        json={"name": "op", "password": "pw"}) as r:
+                    uid = (await r.json())["id"]
+                async with http.put(
+                        f"http://127.0.0.1:{port}/api/v1/users/{uid}/roles/job-operator",
+                        headers=h_root) as r:
+                    assert r.status == 200, await r.text()
+
+                # Re-signin picks up the new role.
+                op = await _signin(http, port, "op", "pw")
+                h_op = {"Authorization": f"Bearer {op}"}
+                # Can create jobs...
+                async with http.post(
+                        f"http://127.0.0.1:{port}/api/v1/jobs",
+                        json={"type": "preheat",
+                              "args": {"type": "file", "url": "http://x/y"}},
+                        headers=h_op) as r:
+                    assert r.status == 200, await r.text()
+                # ...can read schedulers (guest role came with signup)...
+                async with http.get(
+                        f"http://127.0.0.1:{port}/api/v1/schedulers",
+                        headers=h_op) as r:
+                    assert r.status == 200
+                # ...but cannot create scheduler clusters.
+                async with http.post(
+                        f"http://127.0.0.1:{port}/api/v1/scheduler-clusters",
+                        json={"name": "x"}, headers=h_op) as r:
+                    assert r.status == 403
+                # Revoking the role closes the jobs door again.
+                async with http.delete(
+                        f"http://127.0.0.1:{port}/api/v1/users/{uid}/roles/job-operator",
+                        headers=h_root) as r:
+                    assert r.status == 200
+                op2 = await _signin(http, port, "op", "pw")
+                async with http.post(
+                        f"http://127.0.0.1:{port}/api/v1/jobs",
+                        json={"type": "preheat", "args": {}},
+                        headers={"Authorization": f"Bearer {op2}"}) as r:
+                    assert r.status == 403
+        finally:
+            await rest.close()
+
+    run_async(run())
+
+
+def test_oauth_flow_against_fake_provider(run_async):
+    """Full authorization-code flow against an in-process provider:
+    authorize URL → code → token exchange → user info → local user with a
+    session token."""
+    async def run():
+        codes = {"good-code": {"id": 4242, "email": "a@b.c"}}
+
+        async def token_ep(request: web.Request) -> web.Response:
+            form = await request.post()
+            if form["code"] in codes and form["client_secret"] == "s3cr3t":
+                return web.json_response({"access_token": "at-xyz"})
+            return web.json_response({}, status=400)
+
+        async def userinfo_ep(request: web.Request) -> web.Response:
+            if request.headers.get("Authorization") == "Bearer at-xyz":
+                return web.json_response(codes["good-code"])
+            return web.json_response({}, status=401)
+
+        app = web.Application()
+        app.router.add_post("/token", token_ep)
+        app.router.add_get("/userinfo", userinfo_ep)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        pport = site._server.sockets[0].getsockname()[1]
+
+        svc = ManagerService()
+        rest, port = await _start_rest(svc)
+        try:
+            async with aiohttp.ClientSession() as http:
+                root = await _signin(http, port, "root", "dragonfly")
+                async with http.post(
+                        f"http://127.0.0.1:{port}/api/v1/oauth",
+                        json={"name": "fakehub", "client_id": "cid",
+                              "client_secret": "s3cr3t",
+                              "redirect_url": "http://localhost/cb",
+                              "auth_url": f"http://127.0.0.1:{pport}/authorize",
+                              "token_url": f"http://127.0.0.1:{pport}/token",
+                              "user_info_url": f"http://127.0.0.1:{pport}/userinfo"},
+                        headers={"Authorization": f"Bearer {root}"}) as r:
+                    assert r.status == 200, await r.text()
+
+                async with http.get(
+                        f"http://127.0.0.1:{port}/api/v1/users/signin/oauth/fakehub") as r:
+                    assert r.status == 200
+                    redirect = (await r.json())["redirect_url"]
+                assert redirect.startswith(f"http://127.0.0.1:{pport}/authorize?")
+                state = redirect.split("state=")[1].split("&")[0]
+
+                async with http.get(
+                        f"http://127.0.0.1:{port}/api/v1/oauth/fakehub/callback",
+                        params={"code": "good-code", "state": state}) as r:
+                    assert r.status == 200, await r.text()
+                    token = (await r.json())["token"]
+                ident = svc.verify_token(token)
+                assert ident and ident["name"] == "oauth-fakehub-4242"
+
+                # Replayed state is rejected.
+                async with http.get(
+                        f"http://127.0.0.1:{port}/api/v1/oauth/fakehub/callback",
+                        params={"code": "good-code", "state": state}) as r:
+                    assert r.status == 401
+        finally:
+            await rest.close()
+            await runner.cleanup()
+
+    run_async(run())
+
+
+def test_console_served_and_lists_resources(run_async):
+    async def run():
+        svc = ManagerService()
+        rest, port = await _start_rest(svc)
+        try:
+            async with aiohttp.ClientSession() as http:
+                async with http.get(f"http://127.0.0.1:{port}/") as r:
+                    assert r.status == 200
+                    body = await r.text()
+                assert "dragonfly2-tpu console" in body
+                assert "scheduler-clusters" in body
+        finally:
+            await rest.close()
+
+    run_async(run())
+
+
+def test_profiling_endpoints(run_async):
+    from dragonfly2_tpu.pkg.metrics_server import MetricsServer
+
+    async def run():
+        ms = MetricsServer()
+        port = await ms.serve("127.0.0.1", 0)
+        try:
+            async with aiohttp.ClientSession() as http:
+                async with http.get(
+                        f"http://127.0.0.1:{port}/debug/profile",
+                        params={"seconds": "0.2"}) as r:
+                    assert r.status == 200
+                    assert "cumulative" in await r.text()
+                # First heap call arms tracemalloc, second snapshots.
+                async with http.get(f"http://127.0.0.1:{port}/debug/heap") as r:
+                    assert r.status == 200
+                _ = bytearray(2 << 20)  # allocate something traceable
+                async with http.get(f"http://127.0.0.1:{port}/debug/heap") as r:
+                    text = await r.text()
+                    assert "traced current=" in text
+        finally:
+            await ms.close()
+
+    run_async(run())
