@@ -201,26 +201,37 @@ class PeerExchange:
                 "tasks": tasks}
 
     # Gossip authentication: with a shared secret configured, every
-    # datagram is MAC'd (sha256 HMAC, 16-byte tag) and unauthenticated or
-    # forged packets are dropped on receipt — membership and possession
-    # state can then only be injected by secret holders.
+    # datagram is MAC'd (sha256 HMAC, 16-byte tag) over a wall-clock
+    # timestamp plus the payload, and unauthenticated, forged, or stale
+    # packets are dropped on receipt — membership and possession state can
+    # then only be injected by secret holders, and a captured datagram
+    # cannot be replayed outside the freshness window to resurrect departed
+    # peers or deleted task announcements.
     _MAC_LEN = 16
+    _TS_LEN = 8
+    _FRESHNESS_S = 60.0
 
     def _seal(self, data: bytes) -> bytes:
         if not self.secret:
             return data
-        mac = hmac_mod.new(self.secret, data, hashlib.sha256).digest()
-        return mac[: self._MAC_LEN] + data
+        ts = int(time.time() * 1000).to_bytes(self._TS_LEN, "big")
+        mac = hmac_mod.new(self.secret, ts + data, hashlib.sha256).digest()
+        return mac[: self._MAC_LEN] + ts + data
 
     def _authenticate(self, data: bytes) -> bytes | None:
         if not self.secret:
             return data
-        if len(data) <= self._MAC_LEN:
+        if len(data) <= self._MAC_LEN + self._TS_LEN:
             return None
-        mac, payload = data[: self._MAC_LEN], data[self._MAC_LEN:]
-        want = hmac_mod.new(self.secret, payload,
+        mac = data[: self._MAC_LEN]
+        ts_bytes = data[self._MAC_LEN: self._MAC_LEN + self._TS_LEN]
+        payload = data[self._MAC_LEN + self._TS_LEN:]
+        want = hmac_mod.new(self.secret, ts_bytes + payload,
                             hashlib.sha256).digest()[: self._MAC_LEN]
         if not hmac_mod.compare_digest(mac, want):
+            return None
+        ts = int.from_bytes(ts_bytes, "big") / 1000.0
+        if abs(time.time() - ts) > self._FRESHNESS_S:
             return None
         return payload
 

@@ -107,9 +107,18 @@ class Proxy:
 
     def _http(self) -> aiohttp.ClientSession:
         """One shared upstream session: connection reuse across proxied
-        requests instead of a handshake per request."""
+        requests instead of a handshake per request. Honors the same
+        DRAGONFLY_SSL_CA_FILE / DRAGONFLY_SSL_INSECURE trust knobs as the
+        back-to-source HTTP client, so re-originated requests inside a
+        hijacked tunnel reach private-CA upstreams too."""
         if self._session is None or self._session.closed:
-            self._session = aiohttp.ClientSession(auto_decompress=False)
+            from dragonfly2_tpu.source.clients.http import HTTPSourceClient
+
+            ssl_ctx = HTTPSourceClient._ssl_config()
+            connector = (aiohttp.TCPConnector(ssl=ssl_ctx)
+                         if ssl_ctx is not None else None)
+            self._session = aiohttp.ClientSession(
+                auto_decompress=False, connector=connector)
         return self._session
 
     async def serve(self, host: str = "127.0.0.1", port: int = 0) -> int:

@@ -48,8 +48,20 @@ class OAuthFlow:
                         if now - t < _STATE_TTL}
         return self._states.pop(state, None) is not None
 
+    _MAX_STATES = 10_000
+
     def authorize_url(self, name: str) -> str:
         p = self._provider(name)
+        # This endpoint is reachable unauthenticated; prune expired states
+        # here too (not only at exchange) and cap the dict so hammering the
+        # signin URL cannot grow memory without bound.
+        now = time.time()
+        self._states = {s: t for s, t in self._states.items()
+                        if now - t < _STATE_TTL}
+        if len(self._states) >= self._MAX_STATES:
+            for s in sorted(self._states, key=self._states.get)[
+                    : len(self._states) - self._MAX_STATES + 1]:
+                del self._states[s]
         state = secrets.token_urlsafe(16)
         self._states[state] = time.time()
         query = urlencode({

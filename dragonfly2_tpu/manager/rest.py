@@ -155,7 +155,14 @@ class RestServer:
                 return web.json_response({"message": "unauthorized"}, status=401)
             if not self.service.rbac.enforce_request(
                     identity.get("roles", []), request.method, request.path):
-                return web.json_response({"message": "forbidden"}, status=403)
+                # Self-service exception: a user may always change their own
+                # password (the handler re-checks root-or-self, so this
+                # cannot be widened into cross-user access).
+                if not (request.method == "POST"
+                        and request.path ==
+                        f"/api/v1/users/{identity.get('uid')}/reset_password"):
+                    return web.json_response(
+                        {"message": "forbidden"}, status=403)
             request["identity"] = identity
             return await handler(request)
         except web.HTTPException:
@@ -221,9 +228,17 @@ class RestServer:
             {"roles": self.service.roles_of(int(request.match_info["id"]))})
 
     async def _reset_password(self, request: web.Request) -> web.Response:
+        # Root or self only: a custom role granted (users, *) must not be
+        # able to reset root's password — that would escalate a scoped
+        # user-management grant to full takeover.
+        target = int(request.match_info["id"])
+        identity = request["identity"]
+        if (auth.ROLE_ROOT not in identity.get("roles", [])
+                and identity.get("uid") != target):
+            return web.json_response(
+                {"message": "root or self required"}, status=403)
         body = await request.json()
-        self.service.reset_password(int(request.match_info["id"]),
-                                    body["new_password"])
+        self.service.reset_password(target, body["new_password"])
         return web.json_response({})
 
     # -- RBAC endpoints ----------------------------------------------------

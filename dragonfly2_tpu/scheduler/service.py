@@ -89,6 +89,13 @@ class SchedulerService:
         host.port = h.get("port", host.port)
         host.upload_port = h.get("upload_port", host.upload_port)
 
+        task_for_digest = self.tasks.load(open_body["task_id"])
+        if (task_for_digest is not None and not task_for_digest.digest
+                and open_body.get("digest")):
+            # Backfill: a later registrant may know the content digest the
+            # first one didn't — it guards the tiny inline-content cache.
+            task_for_digest.digest = open_body["digest"]
+
         task = self.tasks.load_or_store(
             Task(
                 open_body["task_id"],
@@ -176,13 +183,21 @@ class SchedulerService:
             scope = task.size_scope()
             if (scope == SizeScope.TINY
                     and len(task.direct_piece) == task.content_length):
-                peer.fsm.event("register_tiny")
-                peer.fsm.event("download_succeeded")
-                REGISTER_SCOPE_COUNT.labels("tiny").inc()
-                await peer.announce_stream.send({
-                    "type": "tiny_task", "task": task.to_wire(),
-                    "content": task.direct_piece})
-                return
+                if not self._verify_direct_piece(task, task.direct_piece):
+                    # A newly-learned digest contradicts the cached inline
+                    # content: drop the poisoned cache and fall through to
+                    # normal registration (a fresh fetch re-verifies).
+                    log.warning("cached tiny piece failed digest, dropped",
+                                task=task.id[:16])
+                    task.direct_piece = b""
+                else:
+                    peer.fsm.event("register_tiny")
+                    peer.fsm.event("download_succeeded")
+                    REGISTER_SCOPE_COUNT.labels("tiny").inc()
+                    await peer.announce_stream.send({
+                        "type": "tiny_task", "task": task.to_wire(),
+                        "content": task.direct_piece})
+                    return
             if scope == SizeScope.SMALL and await self._register_small(task, peer):
                 REGISTER_SCOPE_COUNT.labels("small").inc()
                 return
@@ -671,10 +686,41 @@ class SchedulerService:
                     data = await resp.read()
         except aiohttp.ClientError:
             return
-        if len(data) == task.content_length:
-            task.direct_piece = data
-            log.info("tiny direct piece cached", task=task.id[:16],
-                     size=len(data))
+        if len(data) != task.content_length:
+            return
+        # Verify against the reported piece-0 digest (or the whole-task
+        # digest) before caching: a corrupt or malicious finisher must not
+        # poison the inlined content for every later registrant.
+        if not self._verify_direct_piece(task, data):
+            log.warning("tiny direct piece digest mismatch, not cached",
+                        task=task.id[:16], peer=peer.id[:16])
+            return
+        task.direct_piece = data
+        log.info("tiny direct piece cached", task=task.id[:16],
+                 size=len(data))
+
+    @staticmethod
+    def _verify_direct_piece(task: Task, data: bytes) -> bool:
+        """True iff ``data`` matches every digest the task has on record
+        (piece 0's digest and/or the task content digest)."""
+        from dragonfly2_tpu.pkg import digest as dfdigest
+
+        expectations = []
+        piece = task.pieces.get(0)
+        if piece is not None and piece.digest:
+            expectations.append(piece.digest)
+        if task.digest:
+            expectations.append(task.digest)
+        for value in expectations:
+            try:
+                expected = dfdigest.parse(value)
+            except dfdigest.InvalidDigestError:
+                return False
+            if dfdigest.hash_bytes(expected.algorithm, data) != expected:
+                return False
+        # No digest on record: accept (nothing to verify against), matching
+        # the reference's behavior for digest-less tasks.
+        return True
 
     async def announce_task(self, body: dict, ctx: RpcContext) -> dict:
         """A daemon announces an already-complete local task (dfcache import,

@@ -203,3 +203,56 @@ def test_profiling_endpoints(run_async):
             await ms.close()
 
     run_async(run())
+
+
+def test_reset_password_root_or_self_only(run_async):
+    """A role granted (users, *) must NOT reset other users' passwords —
+    that grant would otherwise escalate to root takeover. Root and the
+    user themself may (ADVICE r2, manager/rest.py _reset_password)."""
+    async def run():
+        svc = ManagerService()
+        rest, port = await _start_rest(svc)
+        try:
+            async with aiohttp.ClientSession() as http:
+                root = await _signin(http, port, "root", "dragonfly")
+                h_root = {"Authorization": f"Bearer {root}"}
+                root_id = svc.db.find("users", name="root")["id"]
+
+                # A user-manager role with full users access.
+                async with http.post(
+                        f"http://127.0.0.1:{port}/api/v1/roles",
+                        json={"role": "user-mgr", "object": "users",
+                              "action": "*"}, headers=h_root) as r:
+                    assert r.status == 200
+                async with http.post(
+                        f"http://127.0.0.1:{port}/api/v1/users/signup",
+                        json={"name": "mgr", "password": "pw"}) as r:
+                    mgr_id = (await r.json())["id"]
+                async with http.put(
+                        f"http://127.0.0.1:{port}/api/v1/users/{mgr_id}/roles/user-mgr",
+                        headers=h_root) as r:
+                    assert r.status == 200
+
+                mgr = await _signin(http, port, "mgr", "pw")
+                h_mgr = {"Authorization": f"Bearer {mgr}"}
+                # Cannot reset root's password.
+                async with http.post(
+                        f"http://127.0.0.1:{port}/api/v1/users/{root_id}/reset_password",
+                        json={"new_password": "owned"}, headers=h_mgr) as r:
+                    assert r.status == 403
+                # Can reset their own.
+                async with http.post(
+                        f"http://127.0.0.1:{port}/api/v1/users/{mgr_id}/reset_password",
+                        json={"new_password": "pw2"}, headers=h_mgr) as r:
+                    assert r.status == 200, await r.text()
+                await _signin(http, port, "mgr", "pw2")
+                # Root can reset anyone's.
+                async with http.post(
+                        f"http://127.0.0.1:{port}/api/v1/users/{mgr_id}/reset_password",
+                        json={"new_password": "pw3"}, headers=h_root) as r:
+                    assert r.status == 200
+                await _signin(http, port, "mgr", "pw3")
+        finally:
+            await rest.close()
+
+    run_async(run())

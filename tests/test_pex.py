@@ -225,3 +225,37 @@ def test_gossip_hmac_auth(run_async):
             await intruder.stop()
 
     run_async(run())
+
+
+def test_gossip_replay_rejected(run_async):
+    """Sealed datagrams embed a MAC'd timestamp; a captured datagram older
+    than the freshness window is dropped on receipt, so replay cannot
+    resurrect departed peers or stale possession (ADVICE round 2)."""
+    async def run():
+        a = PeerExchange(ip="127.0.0.1", peer_port=1, gossip_interval=0.1,
+                         secret="cluster-key")
+        try:
+            await a.start(0)
+            payload = b"\x81\xa1t\xa4ping"  # any bytes; seal/authenticate only
+
+            fresh = a._seal(payload)
+            assert a._authenticate(fresh) == payload
+
+            # Forge a datagram stamped outside the freshness window.
+            import time as _t
+
+            old_ts = int((_t.time() - a._FRESHNESS_S - 5) * 1000)
+            ts = old_ts.to_bytes(a._TS_LEN, "big")
+            import hashlib as _h
+            import hmac as _hm
+
+            mac = _hm.new(a.secret, ts + payload, _h.sha256).digest()[: a._MAC_LEN]
+            assert a._authenticate(mac + ts + payload) is None
+
+            # Tampered timestamp (fresh time, stale MAC) also fails.
+            ts2 = int(_t.time() * 1000).to_bytes(a._TS_LEN, "big")
+            assert a._authenticate(mac + ts2 + payload) is None
+        finally:
+            await a.stop()
+
+    run_async(run())

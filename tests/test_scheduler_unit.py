@@ -254,3 +254,55 @@ class TestSlotReleaseOnFinish:
             task, child)
         assert child.state == PeerState.SUCCEEDED
         assert parent_host.concurrent_upload_count == 0
+
+
+class TestDirectPieceVerification:
+    """The tiny inline-content cache must be digest-guarded: a corrupt or
+    malicious finisher must not poison the content for later registrants
+    (scheduler/service.py _verify_direct_piece)."""
+
+    def _task(self, content: bytes, digest: str = "") -> Task:
+        import hashlib
+
+        from dragonfly2_tpu.pkg.piece import PieceInfo
+
+        t = Task("t-tiny", "http://x", digest=digest)
+        t.content_length = len(content)
+        t.piece_size = 4 * 1024 * 1024
+        t.total_piece_count = 1
+        t.store_piece(PieceInfo(
+            0, 0, len(content),
+            digest="md5:" + hashlib.md5(content).hexdigest()))
+        return t
+
+    def test_accepts_matching_content(self):
+        from dragonfly2_tpu.scheduler.service import SchedulerService
+
+        content = b"tiny" * 10
+        task = self._task(content)
+        assert SchedulerService._verify_direct_piece(task, content)
+
+    def test_rejects_on_piece_digest_mismatch(self):
+        from dragonfly2_tpu.scheduler.service import SchedulerService
+
+        content = b"tiny" * 10
+        task = self._task(content)
+        assert not SchedulerService._verify_direct_piece(task, b"x" * 40)
+
+    def test_rejects_on_task_digest_mismatch(self):
+        import hashlib
+
+        from dragonfly2_tpu.scheduler.service import SchedulerService
+
+        content = b"tiny" * 10
+        task = Task("t-tiny2", "http://x",
+                    digest="sha256:" + hashlib.sha256(b"other").hexdigest())
+        task.content_length = len(content)
+        assert not SchedulerService._verify_direct_piece(task, content)
+
+    def test_accepts_when_no_digest_on_record(self):
+        from dragonfly2_tpu.scheduler.service import SchedulerService
+
+        task = Task("t-tiny3", "http://x")
+        task.content_length = 8
+        assert SchedulerService._verify_direct_piece(task, b"whatever")
