@@ -24,7 +24,11 @@ log = dflog.get("cli")
 def _add_dfget(sub: argparse._SubParsersAction) -> None:
     p = sub.add_parser("dfget", help="download a file through the P2P fabric")
     p.add_argument("url", help="source URL (http/https/file/gs)")
-    p.add_argument("-O", "--output", required=True, help="output path")
+    p.add_argument("-O", "--output", default="",
+                   help="output path (optional with --device tpu)")
+    p.add_argument("--device", default="", choices=["", "tpu"],
+                   help="also land verified pieces into the daemon's TPU "
+                        "HBM sink (requires tpu_sink.enabled in the daemon)")
     p.add_argument("--tag", default="", help="task isolation tag")
     p.add_argument("--application", default="")
     p.add_argument("--digest", default="", help="expected digest algo:hex")
@@ -61,11 +65,16 @@ def _run_dfget(args: argparse.Namespace) -> int:
         recursive=args.recursive,
         level=args.level,
         timeout=args.timeout,
+        device=args.device,
     )
+    if not args.output and args.device != "tpu":
+        sys.stderr.write("dfget: error: -O/--output is required "
+                         "(optional only with --device tpu)\n")
+        return 2
 
     async def run() -> int:
         if not args.no_daemon and not await dfget_lib.is_daemon_alive(path.daemon_sock):
-            _spawn_daemon(path)
+            _spawn_daemon(path, device_sink=(args.device == "tpu"))
             await _wait_daemon(path.daemon_sock)
         start = time.monotonic()
         state = {"last": 0}
@@ -88,7 +97,9 @@ def _run_dfget(args: argparse.Namespace) -> int:
         sys.stderr.write(
             f"\rdownloaded {format_size(size)} in {elapsed:.2f}s "
             f"({format_size(int(rate))}/s) task={result.get('task_id', '')[:16]} "
-            f"reuse={result.get('from_reuse', False)} p2p={result.get('from_p2p', False)}\n"
+            f"reuse={result.get('from_reuse', False)} p2p={result.get('from_p2p', False)}"
+            + (f" device_verified={result.get('device_verified', False)}"
+               if cfg.device else "") + "\n"
         )
         return 0
 
@@ -99,11 +110,13 @@ def _run_dfget(args: argparse.Namespace) -> int:
         return 1
 
 
-def _spawn_daemon(path: Dfpath) -> None:
+def _spawn_daemon(path: Dfpath, *, device_sink: bool = False) -> None:
     """Fork a daemon like dfget does (reference cmd/dfget/cmd/root.go:313)."""
     path.ensure()
     cmd = [sys.executable, "-m", "dragonfly2_tpu.cli.main", "daemon",
            "--work-home", path.root]
+    if device_sink:
+        cmd.append("--device-sink")
     with open(os.path.join(path.log_dir, "daemon-spawn.log"), "ab") as logf:
         subprocess.Popen(cmd, stdout=logf, stderr=logf,
                          start_new_session=True, close_fds=True)
@@ -151,6 +164,9 @@ def _add_daemon(sub: argparse._SubParsersAction) -> None:
                    help="ranged-request misses also prefetch the whole task")
     p.add_argument("--hijack-https", action="store_true",
                    help="TLS-intercept CONNECT tunnels with a CA-forged cert")
+    p.add_argument("--device-sink", action="store_true",
+                   help="enable the TPU HBM sink (tasks with --device tpu "
+                        "land verified pieces in device memory)")
     p.set_defaults(func=_run_daemon)
 
 
@@ -198,6 +214,8 @@ def _run_daemon(args: argparse.Namespace) -> int:
         cfg.pex.secret = args.pex_secret
     if args.prefetch:
         cfg.download.prefetch = True
+    if args.device_sink:
+        cfg.tpu_sink.enabled = True
     if args.hijack_https:
         cfg.proxy.enabled = True
         cfg.proxy.hijack_https = True

@@ -1,0 +1,101 @@
+"""Device-landing client API: fetch content through the P2P fabric and
+hand it back as a JAX array in TPU HBM.
+
+The north-star flow (BASELINE.json): a JAX training/serving process embeds
+a dfdaemon (`daemon.daemon.Daemon` is pure asyncio — it runs on the
+process's loop), and checkpoint shards arrive as device buffers without an
+intermediate file export:
+
+    d = Daemon(cfg_with_tpu_sink_enabled)
+    await d.start()
+    arr = await device.download_to_device(d, url, digest="sha256:...",
+                                          dtype="bfloat16", shape=[8192, 4096])
+
+No reference analog: Dragonfly2's dfget terminates at the filesystem
+(client/dfget/dfget.go:47 Download → file output); ours can terminate in HBM.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg.errors import Code, DfError
+from dragonfly2_tpu.proto.common import UrlMeta
+
+log = dflog.get("client.device")
+
+
+@dataclass
+class DeviceResult:
+    """A completed device landing: the verified sink plus task facts."""
+
+    task_id: str
+    content_length: int
+    from_p2p: bool
+    from_reuse: bool
+    sink: object  # TaskDeviceSink
+
+    def as_bytes_array(self):
+        return self.sink.as_bytes_array()
+
+    def as_tensor(self, dtype, shape):
+        return self.sink.as_tensor(dtype, shape)
+
+    def shard_to_mesh(self, mesh, axis_name: str = "d"):
+        return self.sink.shard_to_mesh(mesh, axis_name)
+
+
+async def download_to_device(daemon, url: str, *, digest: str = "",
+                             tag: str = "", application: str = "",
+                             header: dict | None = None,
+                             dtype=None, shape=None,
+                             mesh=None, axis_name: str = "d",
+                             claim: bool = True):
+    """Download ``url`` through the embedded daemon's P2P machinery and
+    land it in the device sink. Returns a jax.Array when ``dtype``+
+    ``shape`` (bitcast tensor) or ``mesh`` (sharded uint32 words) is
+    given, else a DeviceResult exposing the sink.
+
+    ``claim``: take ownership of the sink (the manager forgets it — HBM is
+    released when the caller drops the arrays). With ``claim=False`` the
+    sink stays resident for other consumers until its TTL.
+    """
+    from dragonfly2_tpu.daemon.peer.task_manager import FileTaskRequest
+
+    tm = daemon.task_manager
+    if tm.device_sinks is None:
+        raise DfError(Code.BadRequest,
+                      "daemon has no device sink (set tpu_sink.enabled)")
+    req = FileTaskRequest(
+        url=url, output="",
+        meta=UrlMeta(digest=digest, tag=tag, application=application,
+                     header=header or {}),
+        device="tpu",
+    )
+    final = None
+    async for progress in tm.start_file_task(req):
+        if progress.state == "failed":
+            raise DfError.from_wire(progress.error or {})
+        if progress.state == "done":
+            final = progress
+    if final is None:
+        raise DfError(Code.UnknownError, "download ended without a result")
+    if not final.device_verified:
+        raise DfError(Code.ClientPieceDownloadFail,
+                      "content did not land in the device sink "
+                      "(sink cap reached or pieces misaligned)")
+    task_id = final.task_id
+    sink = (tm.device_sinks.take(task_id) if claim
+            else tm.device_sinks.get(task_id))
+    if sink is None:
+        raise DfError(Code.UnknownError, "device sink vanished after verify")
+    result = DeviceResult(task_id=task_id,
+                          content_length=final.content_length,
+                          from_p2p=final.from_p2p,
+                          from_reuse=final.from_reuse, sink=sink)
+    if dtype is not None and shape is not None:
+        return result.as_tensor(dtype, shape)
+    if mesh is not None:
+        return result.shard_to_mesh(mesh, axis_name)
+    return result

@@ -31,6 +31,7 @@ class DfgetConfig:
     level: int = 5                       # recursion depth cap
     timeout: float = 0.0                 # 0 = none
     allow_source_fallback: bool = True   # direct fetch if daemon dead
+    device: str = ""                     # "tpu": land in daemon's HBM sink
 
 
 async def download(cfg: DfgetConfig, on_progress: Callable[[dict], None] | None = None) -> dict:
@@ -43,7 +44,10 @@ async def download(cfg: DfgetConfig, on_progress: Callable[[dict], None] | None 
             sp.set_attr("task_id", result.get("task_id", ""))
             return result
         except DfError as e:
-            if e.code == Code.ClientConnectionError and cfg.allow_source_fallback:
+            if (e.code == Code.ClientConnectionError and cfg.allow_source_fallback
+                    and not cfg.device):
+                # Direct source fallback cannot land into the daemon's HBM
+                # sink — a device request must fail loudly instead.
                 log.warning("daemon unreachable; falling back to direct source download")
                 return await _download_from_source(cfg)
             raise
@@ -56,9 +60,10 @@ async def _daemon_download(cfg: DfgetConfig, on_progress) -> dict:
             "Daemon.Download",
             {
                 "url": cfg.url,
-                "output": os.path.abspath(cfg.output),
+                "output": os.path.abspath(cfg.output) if cfg.output else "",
                 "meta": cfg.meta.to_wire(),
                 "disable_back_source": cfg.disable_back_source,
+                "device": cfg.device,
             },
         )
         final: dict | None = None

@@ -122,12 +122,15 @@ class PexOption:
 
 @dataclass
 class TPUSinkOption:
-    """--device=tpu sink: land verified pieces into TPU HBM (no reference
-    analog; BASELINE.json north star)."""
+    """--device=tpu sink: land verified pieces into TPU HBM as they
+    verify (daemon/peer/device_sink.DeviceSinkManager; no reference
+    analog — BASELINE.json north star). Requests opt in per task with
+    ``device="tpu"`` (dfget --device tpu)."""
 
     enabled: bool = False
-    mesh_shape: list[int] = field(default_factory=list)
-    donate_staging: bool = True
+    mesh_shape: list[int] = field(default_factory=list)  # for shard_to_mesh
+    batch_pieces: int = 8       # pieces staged per device dispatch
+    max_tasks: int = 4          # concurrent HBM-resident tasks
 
 
 @dataclass

@@ -65,6 +65,14 @@ class Daemon:
             self.scheduler_client = SchedulerClient(config.scheduler.addrs)
 
         self.upload = UploadManager(self.storage, rate_limit=config.upload.rate_limit)
+        device_sinks = None
+        if config.tpu_sink.enabled:
+            from dragonfly2_tpu.daemon.peer.device_sink import DeviceSinkManager
+
+            device_sinks = DeviceSinkManager(
+                mesh_shape=config.tpu_sink.mesh_shape,
+                batch_pieces=config.tpu_sink.batch_pieces,
+                max_tasks=config.tpu_sink.max_tasks)
         self.task_manager = TaskManager(
             self.storage,
             self.piece_manager,
@@ -75,6 +83,7 @@ class Daemon:
             host_wire=self._host_wire,
             traffic_shaper=config.download.traffic_shaper,
             prefetch=config.download.prefetch,
+            device_sinks=device_sinks,
         )
         self.rpc = DaemonRpcServer(self.task_manager)
         self.proxy = None
@@ -329,6 +338,8 @@ class Daemon:
             await self.object_storage.close()
         await self.upload.close()
         await self.rpc.close()
+        if self.task_manager.device_sinks is not None:
+            self.task_manager.device_sinks.close()
         self.storage.close()
         self._stopped.set()
 

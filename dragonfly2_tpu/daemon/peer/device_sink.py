@@ -1,0 +1,247 @@
+"""Device sink manager: the daemon-side terminal store for ``--device=tpu``.
+
+The reference daemon's terminal store is always the filesystem
+(client/daemon/storage/storage_manager.go:54-131 — TaskStorageDriver with
+one local-disk implementation). The TPU build adds a second, selectable
+terminal: TPU HBM. When a download request carries ``device="tpu"``, every
+verified piece is landed into a preallocated device buffer as it arrives
+(ops/hbm_sink.HBMSink), completion re-verifies the landed bytes ON DEVICE
+against host-side checksums, and the result is consumable as a JAX array
+(``as_tensor``) or a mesh-sharded array (``shard_to_mesh``) without ever
+re-reading host storage.
+
+Threading: all sink mutations run on ONE dedicated worker thread — the
+piece read-back, host→device staging and the jit dispatches would
+otherwise stall the daemon's event loop (upload serving, RPC) for the
+duration of each copy. The async surface awaits that thread, so the
+download path still backpressures on landing.
+
+Lifecycle: sinks are created lazily at the first landed piece (task
+metadata — length and piece size — is unknown at request time), verified
+at completion, and held for a TTL for the consuming process to claim
+(``take``). Failed or aborted tasks discard their sink immediately;
+unclaimed sinks expire so HBM is not leaked. The disk store remains
+authoritative for upload/reuse — the sink is an *additional* terminal,
+which is what lets other peers still fetch pieces from this host.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from dragonfly2_tpu.pkg import dflog, metrics
+
+log = dflog.get("peer.device_sink")
+
+SINK_LANDED_BYTES = metrics.counter(
+    "device_sink_landed_bytes_total", "Bytes landed into device sinks")
+SINK_VERIFY_COUNT = metrics.counter(
+    "device_sink_verify_total", "Device sink verifications", ("result",))
+
+
+class DeviceSinkError(Exception):
+    pass
+
+
+class TaskDeviceSink:
+    """One task's HBM landing: wraps ops.hbm_sink.HBMSink with the piece
+    bookkeeping the daemon needs (which pieces landed, their host digests,
+    staleness)."""
+
+    def __init__(self, task_id: str, content_length: int, piece_size: int, *,
+                 device=None, batch_pieces: int = 8):
+        from dragonfly2_tpu.ops.hbm_sink import HBMSink
+
+        # HBM offsets are word-addressed: a non-word-aligned piece size
+        # (only possible for single-piece tasks, where it equals the
+        # content length) rounds up — zero padding is checksum-neutral.
+        total_pieces = max(
+            1, (content_length + piece_size - 1) // piece_size)
+        if piece_size % 4 and total_pieces > 1:
+            raise DeviceSinkError(
+                f"piece size {piece_size} not 4-byte aligned")
+        aligned = piece_size + ((-piece_size) % 4)
+        self.task_id = task_id
+        self.sink = HBMSink(content_length, aligned, device=device,
+                            batch_pieces=batch_pieces)
+        self.created_at = time.time()
+        self.verified = False
+        # Host-side piece digests at land time: lets a later finalize
+        # detect that the store's content changed under a resident sink.
+        self.piece_digests: dict[int, str] = {}
+
+    def land(self, piece_num: int, data: bytes, digest: str = "") -> None:
+        self.sink.land_piece(piece_num, data)
+        self.piece_digests[piece_num] = digest
+        SINK_LANDED_BYTES.inc(len(data))
+
+    @property
+    def landed(self) -> set[int]:
+        return self.sink.landed
+
+    def verify(self) -> None:
+        try:
+            self.sink.verify()
+        except ValueError as e:
+            SINK_VERIFY_COUNT.labels("corrupt").inc()
+            raise DeviceSinkError(str(e)) from e
+        SINK_VERIFY_COUNT.labels("ok").inc()
+        self.verified = True
+
+    # Consumption — delegates to the HBMSink.
+
+    def as_bytes_array(self):
+        return self.sink.as_bytes_array()
+
+    def as_tensor(self, dtype, shape):
+        return self.sink.as_tensor(dtype, shape)
+
+    def shard_to_mesh(self, mesh, axis_name: str = "d"):
+        return self.sink.shard_to_mesh(mesh, axis_name)
+
+
+class DeviceSinkManager:
+    """Owns the per-task sinks a daemon is landing. Selected per request
+    (FileTaskRequest.device == "tpu"); gated by TPUSinkOption.enabled."""
+
+    def __init__(self, *, mesh_shape: list[int] | None = None,
+                 batch_pieces: int = 8, max_tasks: int = 4,
+                 ttl: float = 600.0, device=None):
+        self.mesh_shape = list(mesh_shape or [])
+        self.batch_pieces = batch_pieces
+        self.max_tasks = max_tasks
+        self.ttl = ttl
+        self._device = device
+        self._sinks: dict[str, TaskDeviceSink] = {}
+        # Single worker: serializes sink mutation (HBMSink is not
+        # thread-safe) and keeps device copies off the event loop.
+        self._exec = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="device-sink")
+
+    def close(self) -> None:
+        self._exec.shutdown(wait=False, cancel_futures=True)
+
+    async def _run(self, fn, *args):
+        return await asyncio.get_running_loop().run_in_executor(
+            self._exec, fn, *args)
+
+    # -- landing ----------------------------------------------------------
+
+    async def on_piece(self, task_id: str, store, rec) -> None:
+        """Land one verified piece as it arrives (conductor/back-source
+        on_piece hook). Creation is lazy: the first piece to arrive after
+        the task's length and piece size are known allocates the buffer."""
+        await self._run(self._land_sync, task_id, store, rec)
+
+    def _land_sync(self, task_id: str, store, rec) -> None:
+        sink = self._sinks.get(task_id)
+        if sink is None:
+            m = store.metadata
+            if m.content_length < 0 or m.piece_size <= 0:
+                return  # metadata not known yet; backfill catches it later
+            sink = self._create(task_id, m.content_length, m.piece_size)
+            if sink is None:
+                return
+        if rec.num in sink.landed:
+            return
+        sink.land(rec.num, store.read_piece(rec.num), rec.digest)
+
+    def _create(self, task_id: str, content_length: int,
+                piece_size: int) -> TaskDeviceSink | None:
+        self._expire()
+        if len(self._sinks) >= self.max_tasks:
+            log.warning("device sink cap reached; landing to disk only",
+                        task=task_id[:16], cap=self.max_tasks)
+            return None
+        try:
+            sink = TaskDeviceSink(task_id, content_length, piece_size,
+                                  device=self._device,
+                                  batch_pieces=self.batch_pieces)
+        except Exception as e:
+            # Includes device OOM (XlaRuntimeError): degrade to disk-only
+            # rather than failing the whole download.
+            log.warning("device sink unavailable for task",
+                        task=task_id[:16], error=str(e)[:200])
+            return None
+        self._sinks[task_id] = sink
+        log.info("device sink created", task=task_id[:16],
+                 bytes=content_length)
+        return sink
+
+    # -- completion -------------------------------------------------------
+
+    async def finalize(self, task_id: str, store) -> TaskDeviceSink | None:
+        """Complete the landing: backfill pieces the streaming hook missed
+        (reuse path, tiny/small shortcuts, pre-metadata arrivals), then
+        verify every landed piece on device. Returns None when no sink
+        could be allocated (cap reached, misaligned pieces) — disk-only
+        degradation; raises DeviceSinkError on device-copy CORRUPTION."""
+        return await self._run(self._finalize_sync, task_id, store)
+
+    def _finalize_sync(self, task_id: str, store) -> TaskDeviceSink | None:
+        m = store.metadata
+        sink = self._sinks.get(task_id)
+        if sink is not None and self._stale(sink, store):
+            # The store's content changed under a resident sink (same task
+            # id, new bytes — e.g. origin changed between invalidate and
+            # retry): a mixed buffer must never verify. Rebuild.
+            log.warning("device sink stale vs store; rebuilding",
+                        task=task_id[:16])
+            del self._sinks[task_id]
+            sink = None
+        if sink is None:
+            sink = self._create(task_id, m.content_length, m.piece_size)
+            if sink is None:
+                return None
+        for rec in store.get_pieces():
+            if rec.num not in sink.landed:
+                sink.land(rec.num, store.read_piece(rec.num), rec.digest)
+        sink.verify()
+        log.info("device sink verified", task=task_id[:16],
+                 pieces=len(sink.landed))
+        return sink
+
+    @staticmethod
+    def _stale(sink: TaskDeviceSink, store) -> bool:
+        pieces = store.metadata.pieces
+        for num, digest in sink.piece_digests.items():
+            rec = pieces.get(num)
+            if rec is None or (digest and rec.digest and rec.digest != digest):
+                return True
+        return False
+
+    # -- consumption / lifecycle ------------------------------------------
+
+    def get(self, task_id: str) -> TaskDeviceSink | None:
+        return self._sinks.get(task_id)
+
+    def take(self, task_id: str) -> TaskDeviceSink | None:
+        """Claim the sink (caller owns the buffer; manager forgets it)."""
+        return self._sinks.pop(task_id, None)
+
+    def discard(self, task_id: str) -> None:
+        self._sinks.pop(task_id, None)
+
+    def _expire(self) -> None:
+        now = time.time()
+        for tid in [t for t, s in self._sinks.items()
+                    if now - s.created_at > self.ttl]:
+            log.info("device sink expired", task=tid[:16])
+            del self._sinks[tid]
+
+    def default_mesh(self):
+        """Mesh over local devices per TPUSinkOption.mesh_shape (or all
+        devices on one axis when unset)."""
+        import numpy as np
+        import jax
+        from jax.sharding import Mesh
+
+        devices = jax.devices()
+        if self.mesh_shape:
+            n = int(np.prod(self.mesh_shape))
+            names = tuple(f"d{i}" for i in range(len(self.mesh_shape)))
+            return Mesh(np.asarray(devices[:n]).reshape(self.mesh_shape),
+                        names)
+        return Mesh(np.asarray(devices), ("d",))

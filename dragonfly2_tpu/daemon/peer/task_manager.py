@@ -32,6 +32,9 @@ class FileTaskRequest:
     peer_id: str = ""
     disable_back_source: bool = False
     range: Range | None = None
+    # Terminal device: "" = disk only; "tpu" additionally lands verified
+    # pieces into an HBM sink (daemon/peer/device_sink.py) as they arrive.
+    device: str = ""
 
     def task_id(self) -> str:
         return idgen.task_id_v1(
@@ -90,6 +93,9 @@ class FileTaskProgress:
     error: dict | None = None
     from_reuse: bool = False
     from_p2p: bool = False
+    # True when the content also landed in a device sink and passed
+    # on-device verification (device="tpu" requests).
+    device_verified: bool = False
 
     def to_wire(self) -> dict:
         return {
@@ -104,6 +110,7 @@ class FileTaskProgress:
             "error": self.error,
             "from_reuse": self.from_reuse,
             "from_p2p": self.from_p2p,
+            "device_verified": self.device_verified,
         }
 
 
@@ -131,9 +138,14 @@ class TaskManager:
         traffic_shaper: str = "plain",
         pex=None,
         prefetch: bool = False,
+        device_sinks=None,
     ):
         self.storage = storage
         self.piece_manager = piece_manager
+        # HBM terminal store (daemon/peer/device_sink.DeviceSinkManager) —
+        # present iff TPUSinkOption.enabled; requests select it per task
+        # via FileTaskRequest.device == "tpu".
+        self.device_sinks = device_sinks
         # Ranged-request prefetch: a range miss also kicks off a background
         # whole-task download (reference peertask_manager.go:288).
         self.prefetch = prefetch
@@ -164,11 +176,18 @@ class TaskManager:
         """Run the download into ``store``; returns from_p2p. Publishes piece
         events to the broker so SyncPieceTasks children see pieces live."""
 
+        sink_wanted = (req.device == "tpu" and self.device_sinks is not None
+                       and req.range is None)
+
         async def on_piece(st, rec) -> None:
             m = st.metadata
             self.broker.publish(task_id, PieceEvent(
                 [rec.num], m.total_piece_count, m.content_length, m.piece_size,
                 digests={rec.num: rec.digest}))
+            if sink_wanted:
+                # Land into HBM as the piece verifies — by completion the
+                # device buffer only awaits the final on-device check.
+                await self.device_sinks.on_piece(task_id, st, rec)
             if progress_q is not None:
                 await progress_q.on_piece(st, rec)
 
@@ -393,8 +412,16 @@ class TaskManager:
         reused = self.storage.find_completed_task(task_id)
         if reused is not None:
             log.info("reusing completed task", task_id=task_id[:16])
-            reused.store_to(req.output)
-            yield self._final_progress(reused, task_id, peer_id, from_reuse=True)
+            if req.output:
+                reused.store_to(req.output)
+            try:
+                dev = await self._finalize_device(req, task_id, reused)
+            except DfError as e:
+                yield FileTaskProgress(state="failed", task_id=task_id,
+                                       peer_id=peer_id, error=e.to_wire())
+                return
+            yield self._final_progress(reused, task_id, peer_id,
+                                       from_reuse=True, device_verified=dev)
             return
 
         # 1b. Ranged request: serve the slice off the whole-content parent
@@ -440,8 +467,16 @@ class TaskManager:
                     state="failed", task_id=task_id, peer_id=peer_id,
                     error=DfError(Code.UnknownError, "dedup race: no store").to_wire())
                 return
-            store.store_to(req.output)
-            yield self._final_progress(store, task_id, peer_id, from_reuse=True)
+            if req.output:
+                store.store_to(req.output)
+            try:
+                dev = await self._finalize_device(req, task_id, store)
+            except DfError as e:
+                yield FileTaskProgress(state="failed", task_id=task_id,
+                                       peer_id=peer_id, error=e.to_wire())
+                return
+            yield self._final_progress(store, task_id, peer_id,
+                                       from_reuse=True, device_verified=dev)
             return
 
         store = self.storage.register_task(
@@ -471,8 +506,10 @@ class TaskManager:
                 store.metadata.digest = req.meta.digest
             store.mark_done()
             self._pex_announce(task_id)
-            store.store_to(req.output)
+            if req.output:
+                store.store_to(req.output)
         except DfError as e:
+            self._discard_sink(req, task_id)
             store.mark_invalid()
             run.error = e
             self.broker.publish(task_id, PieceEvent([], failed=True))
@@ -481,6 +518,7 @@ class TaskManager:
             return
         except Exception as e:  # pragma: no cover - defensive
             log.error("file task crashed", exc_info=True)
+            self._discard_sink(req, task_id)
             store.mark_invalid()
             run.error = DfError(Code.UnknownError, describe(e))
             self.broker.publish(task_id, PieceEvent([], failed=True))
@@ -499,6 +537,7 @@ class TaskManager:
                 if run.error is None:
                     run.error = DfError(Code.ClientContextCanceled,
                                         "download aborted by client")
+                self._discard_sink(req, task_id)
                 store.mark_invalid()
                 self.broker.publish(task_id, PieceEvent([], failed=True))
             store.unpin()
@@ -508,7 +547,19 @@ class TaskManager:
         self.broker.publish(task_id, PieceEvent(
             [], store.metadata.total_piece_count, store.metadata.content_length,
             store.metadata.piece_size, done=True))
-        yield self._final_progress(store, task_id, peer_id, from_p2p=from_p2p)
+
+        # Device finalize AFTER the disk result is final: a corrupt DEVICE
+        # copy fails this requesting stream only — the store is complete,
+        # digest-verified, announced, and reusable (dedup waiters and
+        # future requests are served from disk).
+        try:
+            device_verified = await self._finalize_device(req, task_id, store)
+        except DfError as e:
+            yield FileTaskProgress(state="failed", task_id=task_id,
+                                   peer_id=peer_id, error=e.to_wire())
+            return
+        yield self._final_progress(store, task_id, peer_id, from_p2p=from_p2p,
+                                   device_verified=device_verified)
 
     # -- seed task (reference StartSeedTask :401 + seeder ObtainSeeds) -----
 
@@ -821,7 +872,8 @@ class TaskManager:
     # -- helpers -----------------------------------------------------------
 
     def _final_progress(self, store, task_id: str, peer_id: str, *,
-                        from_reuse: bool = False, from_p2p: bool = False) -> FileTaskProgress:
+                        from_reuse: bool = False, from_p2p: bool = False,
+                        device_verified: bool = False) -> FileTaskProgress:
         m = store.metadata
         return FileTaskProgress(
             state="done",
@@ -834,7 +886,38 @@ class TaskManager:
             digest=m.digest,
             from_reuse=from_reuse,
             from_p2p=from_p2p,
+            device_verified=device_verified,
         )
+
+    def _discard_sink(self, req: "FileTaskRequest", task_id: str) -> None:
+        """Drop a partially-landed sink on any failure/abort path: a stale
+        resident sink could otherwise shadow a later retry's bytes."""
+        if req.device and self.device_sinks is not None:
+            self.device_sinks.discard(task_id)
+
+    async def _finalize_device(self, req: "FileTaskRequest", task_id: str,
+                               store) -> bool:
+        """Run the device-sink completion for a ``device='tpu'`` request:
+        backfill + on-device verify. Sink *unavailability* (cap reached,
+        misaligned pieces, option disabled) degrades to disk-only — the
+        file result is already digest-verified. Device-copy CORRUPTION
+        raises: silently handing back a bad buffer would defeat
+        verify-on-land. The DISK store stays valid either way — callers
+        must fail only the requesting stream, not the task."""
+        if req.device != "tpu" or req.range is not None:
+            return False
+        if self.device_sinks is None:
+            log.warning("device=tpu requested but sink disabled "
+                        "(TPUSinkOption.enabled=false)", task_id=task_id[:16])
+            return False
+        from dragonfly2_tpu.daemon.peer.device_sink import DeviceSinkError
+
+        try:
+            return await self.device_sinks.finalize(task_id, store) is not None
+        except DeviceSinkError as e:
+            self.device_sinks.discard(task_id)
+            raise DfError(Code.ClientPieceDownloadFail,
+                          f"device sink verification failed: {e}")
 
     async def _stream_progress(self, task: asyncio.Task, progress_q: "_ProgressAggregator"):
         while True:

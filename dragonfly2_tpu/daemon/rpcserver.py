@@ -62,13 +62,17 @@ class DaemonRpcServer:
         body = stream.open_body or {}
         url = body.get("url", "")
         output = body.get("output", "")
-        if not url or not output:
+        device = body.get("device", "")
+        # Output may be omitted only when the content terminates in a
+        # device sink (--device=tpu): the result lives in HBM, not a path.
+        if not url or (not output and device != "tpu"):
             raise DfError(Code.BadRequest, "url and output are required")
         req = FileTaskRequest(
             url=url,
             output=output,
             meta=UrlMeta.from_wire(body.get("meta")),
             disable_back_source=body.get("disable_back_source", False),
+            device=device,
         )
         if req.meta.range:
             req.range = Range.parse_http(req.meta.range)

@@ -1,0 +1,320 @@
+"""--device=tpu end-to-end: P2P download terminates in a device buffer.
+
+VERDICT r2 item 1: dfget/daemon constructs an HBMSink, the conductor's
+on_piece lands pieces as they verify, completion runs on-device
+verification, and the result is consumable as a tensor or a mesh-sharded
+array. Runs on the virtual 8-device CPU mesh (conftest) — the same code
+path the real chip takes.
+
+Terminal-store seam mirrored from the reference:
+client/daemon/storage/storage_manager.go:54-131 (TaskStorageDriver), with
+HBM as a second, per-task-selectable terminal.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+
+import numpy as np
+
+from dragonfly2_tpu.client import dfget as dfget_lib
+from dragonfly2_tpu.client import device as device_lib
+from dragonfly2_tpu.daemon.daemon import Daemon
+from dragonfly2_tpu.proto.common import UrlMeta
+
+from tests.test_p2p_e2e import daemon_config, start_origin, start_scheduler
+import tests.test_p2p_e2e as e2e
+
+CONTENT = e2e.CONTENT          # 10 MiB, 3 pieces at 4 MiB
+SHA = e2e.SHA
+
+
+async def _start_sink_daemon(tmp_path, name, scheduler_port, *, seed=False,
+                             mesh_shape=None) -> Daemon:
+    cfg = daemon_config(tmp_path, name, scheduler_port, seed=seed)
+    cfg.tpu_sink.enabled = True
+    if mesh_shape:
+        cfg.tpu_sink.mesh_shape = mesh_shape
+    d = Daemon(cfg)
+    await d.start()
+    return d
+
+
+def test_p2p_download_lands_in_device_buffer(run_async, tmp_path):
+    """Seed + peer: the peer's P2P download lands in HBM piece-by-piece,
+    verifies on device, and the bytes match the origin exactly."""
+
+    async def body():
+        origin, oport, stats = await start_origin()
+        sched = await start_scheduler()
+        url = f"http://127.0.0.1:{oport}/blob"
+        daemons = []
+        try:
+            seed = await e2e.start_daemon(tmp_path, "seed", sched.port(),
+                                          seed=True)
+            peer = await _start_sink_daemon(tmp_path, "peer", sched.port())
+            daemons += [seed, peer]
+
+            result = await device_lib.download_to_device(
+                peer, url, digest=SHA)
+            assert result.from_p2p
+            assert result.content_length == len(CONTENT)
+            assert result.sink.verified
+
+            landed = bytes(np.asarray(result.as_bytes_array()))
+            assert landed == CONTENT
+
+            # Streaming landing actually happened: pieces were landed by
+            # the on_piece hook, not only the completion backfill.
+            assert len(result.sink.landed) == 3
+
+            # Origin served ~one copy (the seed's fetch); the device
+            # landing added no origin traffic.
+            assert stats["blob_bytes"] <= len(CONTENT) * 1.25
+        finally:
+            for d in daemons:
+                await d.stop()
+            await sched.stop()
+            await origin.cleanup()
+
+    run_async(body(), timeout=120)
+
+
+def test_device_result_as_tensor_and_mesh(run_async, tmp_path):
+    """Consumption paths: bitcast to a typed tensor and shard over the
+    8-device CPU mesh with one contiguous shard per device."""
+
+    async def body():
+        import jax
+
+        origin, oport, _ = await start_origin()
+        sched = await start_scheduler()
+        url = f"http://127.0.0.1:{oport}/blob"
+        daemons = []
+        try:
+            peer = await _start_sink_daemon(tmp_path, "solo", sched.port())
+            daemons.append(peer)
+
+            result = await device_lib.download_to_device(
+                peer, url, digest=SHA, claim=False)
+
+            # Typed view: float32 words of the first piece region.
+            n = (len(CONTENT) // 4) // 8 * 8
+            t = result.as_tensor("float32", [n])
+            want = np.frombuffer(CONTENT[: n * 4], dtype="<f4")
+            got = np.asarray(t)
+            assert got.shape == (n,)
+            np.testing.assert_array_equal(
+                got.view(np.uint32), want.view(np.uint32))
+
+            # Mesh sharding: every device holds a contiguous uint32 shard.
+            mesh = peer.task_manager.device_sinks.default_mesh()
+            sharded = result.shard_to_mesh(mesh)
+            assert len(sharded.devices()) == len(jax.devices())
+            whole = np.asarray(sharded)
+            padded = np.frombuffer(
+                CONTENT + b"\x00" * ((-len(CONTENT)) % 4), dtype="<u4")
+            np.testing.assert_array_equal(whole[: padded.size], padded)
+
+            # claim=False leaves the sink resident for other consumers.
+            assert peer.task_manager.device_sinks.get(result.task_id) is not None
+        finally:
+            for d in daemons:
+                await d.stop()
+            await sched.stop()
+            await origin.cleanup()
+
+    run_async(body(), timeout=120)
+
+
+def test_dfget_device_flag_and_reuse(run_async, tmp_path):
+    """The wire path: dfget with device="tpu" reports device_verified on
+    both the fresh download and the warm (reuse) path, where the sink is
+    backfilled from the completed store."""
+
+    async def body():
+        origin, oport, _ = await start_origin()
+        sched = await start_scheduler()
+        url = f"http://127.0.0.1:{oport}/blob"
+        daemons = []
+        try:
+            peer = await _start_sink_daemon(tmp_path, "wire", sched.port())
+            daemons.append(peer)
+
+            r1 = await dfget_lib.download(dfget_lib.DfgetConfig(
+                url=url, output=str(tmp_path / "o1"),
+                daemon_sock=peer.config.unix_sock,
+                meta=UrlMeta(digest=SHA), device="tpu",
+                allow_source_fallback=False, timeout=60.0))
+            assert r1["state"] == "done"
+            assert r1["device_verified"]
+            assert (tmp_path / "o1").read_bytes() == CONTENT
+
+            # Claim the sink (drops it from the manager), then re-download:
+            # the reuse path must rebuild and re-verify from the store.
+            assert peer.task_manager.device_sinks.take(r1["task_id"]) is not None
+            r2 = await dfget_lib.download(dfget_lib.DfgetConfig(
+                url=url, output="", daemon_sock=peer.config.unix_sock,
+                meta=UrlMeta(digest=SHA), device="tpu",
+                allow_source_fallback=False, timeout=60.0))
+            assert r2["state"] == "done"
+            assert r2["from_reuse"]
+            assert r2["device_verified"]
+            sink = peer.task_manager.device_sinks.get(r2["task_id"])
+            assert sink is not None and sink.verified
+        finally:
+            for d in daemons:
+                await d.stop()
+            await sched.stop()
+            await origin.cleanup()
+
+    run_async(body(), timeout=120)
+
+
+def test_corrupt_device_copy_fails_verification(run_async, tmp_path):
+    """verify() must name a corrupted piece instead of handing back a bad
+    buffer (checksum mismatch between host-recorded and on-device)."""
+    import pytest
+
+    from dragonfly2_tpu.daemon.peer.device_sink import (
+        DeviceSinkError,
+        TaskDeviceSink,
+    )
+
+    piece = 256 * 1024
+    data0 = bytes(random.Random(1).randbytes(piece))
+    data1 = bytes(random.Random(2).randbytes(piece))
+    sink = TaskDeviceSink("t-corrupt", piece * 2, piece)
+    sink.land(0, data0)
+    # Record piece 1's checksum for DIFFERENT bytes than we land.
+    sink.sink.host_checksums[1] = (0x12345678, 0x9ABCDEF0)
+    sink.sink.landed.add(1)
+    sink.sink._pending.append(
+        (1, np.frombuffer(data1, dtype="<u4")))
+    with pytest.raises(DeviceSinkError, match="piece 1"):
+        sink.verify()
+
+
+def test_sink_unavailable_degrades_to_disk(run_async, tmp_path):
+    """Sink cap reached: the request still completes (disk verified) with
+    device_verified=False rather than failing."""
+
+    async def body():
+        origin, oport, _ = await start_origin()
+        sched = await start_scheduler()
+        url = f"http://127.0.0.1:{oport}/blob"
+        daemons = []
+        try:
+            cfg = daemon_config(tmp_path, "capped", sched.port())
+            cfg.tpu_sink.enabled = True
+            cfg.tpu_sink.max_tasks = 0          # nothing fits
+            peer = Daemon(cfg)
+            await peer.start()
+            daemons.append(peer)
+
+            r = await dfget_lib.download(dfget_lib.DfgetConfig(
+                url=url, output=str(tmp_path / "o"),
+                daemon_sock=peer.config.unix_sock,
+                meta=UrlMeta(digest=SHA), device="tpu",
+                allow_source_fallback=False, timeout=60.0))
+            assert r["state"] == "done"
+            assert not r["device_verified"]
+            assert (tmp_path / "o").read_bytes() == CONTENT
+        finally:
+            for d in daemons:
+                await d.stop()
+            await sched.stop()
+            await origin.cleanup()
+
+    run_async(body(), timeout=120)
+
+
+def test_device_corruption_fails_request_but_not_store(run_async, tmp_path):
+    """Code-review regression: a corrupt DEVICE copy fails the requesting
+    stream only — the digest-verified disk store must stay valid and
+    reusable (no mark_invalid, dedup/future requests serve from disk)."""
+
+    async def body():
+        origin, oport, _ = await start_origin()
+        sched = await start_scheduler()
+        url = f"http://127.0.0.1:{oport}/blob"
+        daemons = []
+        try:
+            peer = await _start_sink_daemon(tmp_path, "corrupt", sched.port())
+            daemons.append(peer)
+            mgr = peer.task_manager.device_sinks
+
+            # Sabotage: make every finalize report corruption.
+            async def bad_finalize(task_id, store):
+                from dragonfly2_tpu.daemon.peer.device_sink import (
+                    DeviceSinkError,
+                )
+                raise DeviceSinkError("piece 0 corrupt in HBM: injected")
+
+            mgr.finalize = bad_finalize
+
+            import pytest
+
+            from dragonfly2_tpu.pkg.errors import DfError
+
+            with pytest.raises(DfError, match="device sink verification"):
+                await device_lib.download_to_device(peer, url, digest=SHA)
+
+            # The disk store survived and serves the next (non-device)
+            # request instantly from reuse.
+            r = await dfget_lib.download(dfget_lib.DfgetConfig(
+                url=url, output=str(tmp_path / "o"),
+                daemon_sock=peer.config.unix_sock,
+                meta=UrlMeta(digest=SHA),
+                allow_source_fallback=False, timeout=60.0))
+            assert r["state"] == "done"
+            assert r["from_reuse"]
+            assert (tmp_path / "o").read_bytes() == CONTENT
+        finally:
+            for d in daemons:
+                await d.stop()
+            await sched.stop()
+            await origin.cleanup()
+
+    run_async(body(), timeout=120)
+
+
+def test_stale_sink_rebuilt_when_store_content_changed(run_async, tmp_path):
+    """Code-review regression: a resident sink whose recorded piece
+    digests no longer match the store (content changed under the same
+    task id) is rebuilt, never verified as a mixed buffer."""
+
+    async def body():
+        from dragonfly2_tpu.daemon.peer.device_sink import DeviceSinkManager
+        from dragonfly2_tpu.storage.local_store import (
+            LocalTaskStore,
+            TaskStoreMetadata,
+        )
+
+        piece = 256 * 1024
+        old = bytes(random.Random(3).randbytes(piece * 2))
+        new = bytes(random.Random(4).randbytes(piece * 2))
+
+        store = LocalTaskStore(
+            str(tmp_path / "t1"),
+            TaskStoreMetadata(task_id="t-stale", content_length=piece * 2,
+                              piece_size=piece, total_piece_count=2))
+        store.write_piece(0, new[:piece])
+        store.write_piece(1, new[piece:])
+
+        mgr = DeviceSinkManager()
+        try:
+            # A sink left over from the OLD content.
+            sink = mgr._create("t-stale", piece * 2, piece)
+            sink.land(0, old[:piece], "md5:stale-digest-0")
+            sink.land(1, old[piece:], "md5:stale-digest-1")
+
+            result = await mgr.finalize("t-stale", store)
+            assert result is not None and result.verified
+            landed = bytes(np.asarray(result.as_bytes_array()))
+            assert landed == new          # rebuilt, not mixed
+        finally:
+            mgr.close()
+
+    run_async(body(), timeout=60)
