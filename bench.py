@@ -1,13 +1,21 @@
 """Benchmark: TPU verify+land throughput (the fabric's device sink).
 
 Measures the hot TPU-side path of the checkpoint fan-out north star: staged
-host pieces → HBM scatter → on-device integrity checksums, in GB/s on the
-real chip. Baseline: the host-side verify the reference architecture implies
-(sha256 over the same bytes — Dragonfly2 verifies digests on CPU;
+device batches → on-device integrity checksums → flat-buffer assembly, in
+GB/s on the real chip. This is exactly the device work HBMSink does per
+landed byte (ops/hbm_sink.py v3: checksum-at-flush + one-shot assembly).
+Baseline: the host-side verify the reference architecture implies (sha256
+over the same bytes — Dragonfly2 verifies digests on CPU;
 pkg/digest/digest_reader.go), so vs_baseline = device-sink GB/s ÷ CPU-sha256
 GB/s.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Methodology notes (tunneled backends): a host scalar fetch costs 40-70 ms
+and block_until_ready can return early, so throughput is measured with the
+SLOPE method — run the workload at two iteration counts with a hard scalar
+fetch each, and divide the extra work by the extra time. Fixed overhead
+(fetch, dispatch warmup) cancels.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 from __future__ import annotations
@@ -29,80 +37,161 @@ def bench_cpu_sha256(data: bytes, repeats: int = 3) -> float:
     return len(data) / best
 
 
-def bench_device_sink(total_mb: int = 512, piece_mb: int = 4, repeats: int = 5,
-                      batches: int = 48) -> float:
-    """Verify+land over HBM-resident pieces: staged pieces (already DMA'd to
-    the device by the transfer path) are scattered into the task buffer and
-    integrity-checksummed on device. Host→HBM staging is excluded — it is
-    transport hardware (PCIe on a TPU VM, the network tunnel here), not the
-    sink's compute.
+def _probe_backend_subprocess(timeout_s: float) -> str | None:
+    """Probe device availability in a THROWAWAY subprocess so a hung
+    backend (tunnel stall) cannot wedge the bench process itself. Returns
+    an error string, or None when a device op round-tripped."""
+    import subprocess
+    import sys as _sys
 
-    Steady-state: ``batches`` fused land+checksum steps run back-to-back
-    with ONE confirmation fetch at the end — the sink streams pieces
-    continuously in production, so a per-batch host round trip (60+ ms over
-    a tunneled backend, 100x the kernel time) is not part of its throughput."""
-    import jax
+    code = ("import jax, numpy as np, jax.numpy as jnp; "
+            "x = jnp.ones((8,)) + 1; "
+            "assert float(np.asarray(x[0])) == 2.0; "
+            "print('PROBE_OK', jax.default_backend())")
+    try:
+        proc = subprocess.run([_sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return f"device probe hung (> {timeout_s:.0f}s)"
+    if proc.returncode != 0 or "PROBE_OK" not in proc.stdout:
+        return (proc.stderr.strip().splitlines() or ["probe failed"])[-1][:200]
+    return None
+
+
+def _init_backend_with_retry(max_attempts: int = 4,
+                             probe_timeout_s: float = 120.0):
+    """Backend init with bounded backoff (round-2 lesson: a single transient
+    'Unable to initialize backend' burned the whole round's device number;
+    round-3 lesson: the tunnel can HANG rather than fail, so each attempt
+    probes in a subprocess with a hard timeout). Returns (jax, attempts)."""
+    delay = 5.0
+    last = None
+    for attempt in range(1, max_attempts + 1):
+        last = _probe_backend_subprocess(probe_timeout_s)
+        if last is None:
+            import jax
+
+            return jax, attempt
+        if attempt < max_attempts:
+            time.sleep(delay)
+            delay = min(delay * 2, 30.0)
+    raise RuntimeError(f"backend init failed after {max_attempts} attempts: {last}")
+
+
+def bench_device_sink(jax, total_mb: int = 512, piece_mb: int = 4,
+                      batch_pieces: int = 16) -> float:
+    """Steady-state verify+land GB/s: HBMSink's whole device cost per
+    landed byte — ONE fused dispatch assembling the staged batches into
+    the flat content while folding per-piece checksums from the same read
+    (host→HBM staging is excluded: it is transport hardware — PCIe on a
+    TPU VM, the network tunnel here)."""
     import jax.numpy as jnp
 
-    from dragonfly2_tpu.ops.hbm_sink import land_and_checksum
+    from dragonfly2_tpu.ops.hbm_sink import _assemble_checksum_jit
 
-    piece_bytes = piece_mb << 20
+    piece_words = (piece_mb << 20) // 4
     n_pieces = total_mb // piece_mb
-    piece_words = piece_bytes // 4
+    n_batches = n_pieces // batch_pieces
     rng = np.random.RandomState(0)
-    host_pieces = rng.randint(0, 2**31, size=(n_pieces, piece_words),
-                              dtype=np.int64).astype(np.uint32)
-    offsets = jnp.asarray(np.arange(n_pieces, dtype=np.int32) * piece_words)
-    staged = jnp.asarray(host_pieces)          # one-time staging
-    jax.block_until_ready(staged)
+    batches = tuple(
+        jnp.asarray(rng.randint(0, 2**31, size=(batch_pieces, piece_words),
+                                dtype=np.int64).astype(np.uint32))
+        for _ in range(n_batches))
+    jax.block_until_ready(batches)
+    plan = tuple(("b", bi, 0, batch_pieces) for bi in range(n_batches))
+    nbytes = n_pieces * piece_words * 4
 
-    def run_once() -> float:
-        buffer = jnp.zeros((n_pieces * piece_words,), jnp.uint32)
-        jax.block_until_ready(buffer)
+    def work():
+        flat, sums, xors = _assemble_checksum_jit(batches, plan, piece_words)
+        return sums, flat
+
+    def run(iters: int) -> float:
         t0 = time.perf_counter()
-        sums = None
-        for _ in range(batches):
-            buffer, sums, xors = land_and_checksum(
-                buffer, staged, offsets, piece_words)
-        # Host scalar fetch = hard completion barrier (remote backends can
-        # report block_until_ready before the final result lands).
-        _ = int(np.asarray(sums)[0])
+        r = None
+        for _ in range(iters):
+            r = work()
+        # Hard completion barrier: host scalar fetches (block_until_ready
+        # can return early over a tunneled backend).
+        _ = int(np.asarray(r[0][0]))
+        _ = int(np.asarray(r[1][-1:])[0])
         return time.perf_counter() - t0
 
-    run_once()  # compile
-    best = min(run_once() for _ in range(repeats))
-    return (batches * n_pieces * piece_bytes) / best
+    work()  # compile
+    run(2)  # warm
+    n1, n2 = 8, 32
+    slopes = []
+    for _ in range(3):
+        t1 = run(n1)
+        t2 = run(n2)
+        if t2 > t1:
+            slopes.append((n2 - n1) * nbytes / (t2 - t1))
+    if not slopes:
+        # Noise beat every slope; fall back to a big sample alone.
+        return nbytes * n2 / run(n2)
+    slopes.sort()
+    return slopes[len(slopes) // 2]
 
 
-def bench_staged_transfer(total_mb: int = 256, repeats: int = 5) -> float:
+def bench_staged_transfer(jax, total_mb: int = 64, repeats: int = 4) -> float:
     """Host→HBM staging GB/s (jax.device_put of a pageable host buffer —
     the daemon's piece staging path): the transport leg the sink metric
     deliberately excludes. Reported alongside so an end-to-end budget
     (BASELINE config #5's <60 s) can be decomposed into staging + sink and
     neither hides the other's bottleneck."""
-    import jax
-
     n = (total_mb << 20) // 4
     host = np.random.RandomState(2).randint(
         0, 2**31, size=(n,), dtype=np.int64).astype(np.uint32)
 
-    def run_once() -> float:
+    def run(iters: int) -> float:
         t0 = time.perf_counter()
-        staged = jax.device_put(host)
-        jax.block_until_ready(staged)
+        staged = None
+        for _ in range(iters):
+            staged = jax.device_put(host)
+        # One hard barrier; the slope below cancels its fixed cost.
+        _ = int(np.asarray(staged[:1])[0])
         return time.perf_counter() - t0
 
-    run_once()
-    best = min(run_once() for _ in range(repeats))
-    return (total_mb << 20) / best
+    run(1)
+    n1, n2 = 2, 6
+    slopes = []
+    for _ in range(max(1, repeats // 2)):
+        t1 = run(n1)
+        t2 = run(n2)
+        if t2 > t1:
+            slopes.append((n2 - n1) * (total_mb << 20) / (t2 - t1))
+    if not slopes:
+        return (total_mb << 20) * n2 / run(n2)
+    slopes.sort()
+    return slopes[len(slopes) // 2]
+
+
+def sink_smoke(jax) -> str:
+    """Real-chip smoke of the PRODUCT path: HBMSink lands host pieces,
+    verifies on device, and round-trips the bytes exactly."""
+    from dragonfly2_tpu.ops.hbm_sink import HBMSink
+
+    piece = 1 << 20
+    rng = np.random.RandomState(7)
+    content = rng.bytes(8 * piece + 12345)   # tail piece
+    sink = HBMSink(len(content), piece, batch_pieces=4)
+    nums = list(range((len(content) + piece - 1) // piece))
+    rng.shuffle(nums)
+    for n in nums:
+        sink.land_piece(n, content[n * piece:(n + 1) * piece])
+    if not sink.complete():
+        return "incomplete"
+    sink.verify()
+    out = np.asarray(sink.as_bytes_array()).tobytes()
+    return "ok" if out == content else "bytes mismatch"
 
 
 def main() -> int:
-    total_mb = 256
     data = np.random.RandomState(1).bytes(64 << 20)
     cpu_bps = bench_cpu_sha256(data)
     try:
-        device_bps = bench_device_sink(total_mb)
+        jax, attempts = _init_backend_with_retry()
+        device_bps = bench_device_sink(jax)
     except Exception as e:  # no usable accelerator: report CPU path honestly
         print(json.dumps({
             "metric": "verify_and_land_throughput",
@@ -113,9 +202,13 @@ def main() -> int:
         }))
         return 0
     try:
-        staged_bps = bench_staged_transfer()
+        staged_bps = bench_staged_transfer(jax)
     except Exception:
         staged_bps = 0.0
+    try:
+        smoke = sink_smoke(jax)
+    except Exception as e:
+        smoke = f"failed: {e}"
     print(json.dumps({
         "metric": "verify_and_land_throughput",
         "value": round(device_bps / 1e9, 3),
@@ -123,6 +216,9 @@ def main() -> int:
         "vs_baseline": round(device_bps / cpu_bps, 3),
         "staged_host_to_hbm_gbps": round(staged_bps / 1e9, 3),
         "cpu_sha256_gbps": round(cpu_bps / 1e9, 3),
+        "backend_init_attempts": attempts,
+        "sink_smoke": smoke,
+        "backend": jax.default_backend(),
     }))
     return 0
 

@@ -146,6 +146,10 @@ class DeviceSinkManager:
                 return
         if rec.num in sink.landed:
             return
+        if rec.num >= sink.sink.total_pieces:
+            log.warning("piece out of sink range, skipped",
+                        task=task_id[:16], piece=rec.num)
+            return
         sink.land(rec.num, store.read_piece(rec.num), rec.digest)
 
     def _create(self, task_id: str, content_length: int,

@@ -39,12 +39,25 @@ def checksum_numpy(data: bytes) -> tuple[int, int]:
 
 @functools.partial(jax.jit, static_argnames=("piece_words",))
 def _chunk_checksums_xla(words, piece_words: int):
-    """words: uint32[n_pieces * piece_words] → (sum32[n], xor32[n])."""
-    w = words.reshape(-1, piece_words)
-    # uint32 accumulation wraps mod 2^32 — exactly the checksum definition.
-    sums = jnp.sum(w, axis=1, dtype=jnp.uint32)
-    xors = jax.lax.reduce(w, jnp.uint32(0), jax.lax.bitwise_xor, (1,))
-    return sums, xors
+    """words: uint32[n_pieces * piece_words] → (sum32[n], xor32[n]).
+
+    All arithmetic runs in int32: the TPU VPU has no native uint32 ops, so
+    uint32 reductions get emulated at ~25 GB/s while int32 reductions run
+    at memory bandwidth (~100x measured on v5e). Two's-complement wraparound
+    add and xor have identical bit patterns to the uint32 definition. The
+    (k, rows, LANES) reshape maps the reduction onto the (sublane, lane)
+    tiling instead of one 10^6-element axis."""
+    w = jax.lax.bitcast_convert_type(words, jnp.int32)
+    if piece_words % 128 == 0:
+        w = w.reshape(-1, piece_words // 128, 128)
+        axes = (1, 2)
+    else:
+        w = w.reshape(-1, piece_words)
+        axes = (1,)
+    sums = jnp.sum(w, axis=axes, dtype=jnp.int32)
+    xors = jax.lax.reduce(w, jnp.int32(0), jax.lax.bitwise_xor, axes)
+    return (jax.lax.bitcast_convert_type(sums, jnp.uint32),
+            jax.lax.bitcast_convert_type(xors, jnp.uint32))
 
 
 def _pallas_available() -> bool:
@@ -215,8 +228,16 @@ def chunk_checksums(words, piece_words: int, *, use_pallas: bool | None = None):
     n_pieces = words.shape[0] // piece_words
     explicit = use_pallas is not None
     if use_pallas is None:
-        use_pallas = (_pallas_available() and piece_words % 128 == 0
-                      and n_pieces % 8 == 0)
+        # Default to XLA: with int32 arithmetic it reduces at memory
+        # bandwidth, while the Pallas grid pipeline caps at ~20-90 GB/s on
+        # v5e (measured round 3). The kernel stays available explicitly.
+        use_pallas = False
+    if use_pallas and not (_pallas_available() and piece_words % 128 == 0
+                           and n_pieces % 8 == 0):
+        # use_pallas is only ever truthy when passed explicitly.
+        raise ValueError(
+            "pallas checksum kernel needs a TPU backend, piece_words "
+            "% 128 == 0 and n_pieces % 8 == 0")
     if use_pallas:
         try:
             return _chunk_checksums_pallas(words, piece_words)

@@ -219,3 +219,49 @@ def test_hbm_sink_contiguous_runs(tmp_path):
     assert sink.complete()
     assert sink.verify()
     assert np.asarray(sink.as_bytes_array()).tobytes() == b"".join(blobs)
+
+
+def test_hbm_sink_rejects_out_of_range_piece():
+    """A stray out-of-range piece must raise, not poison a (possibly
+    already drained) sink — code-review regression r3."""
+    sink = HBMSink(4096, 1024)
+    with pytest.raises(ValueError, match="out of range"):
+        sink.land_piece(4, b"\x00" * 1024)
+    with pytest.raises(ValueError, match="out of range"):
+        sink.land_piece(-1, b"\x00" * 1024)
+
+
+def test_hbm_sink_fragmented_gather_path():
+    """Badly scrambled arrival falls back to the traced-permutation
+    gather (fixed graph) — content and verification must stay exact."""
+    rng = np.random.RandomState(9)
+    piece = 512
+    total_pieces = 64
+    content = rng.bytes(piece * total_pieces - 123)  # tail piece
+    sink = HBMSink(len(content), piece, batch_pieces=1)
+    sink._SEGMENT_CAP = 4          # force the gather path
+    nums = list(range(total_pieces))
+    rng.shuffle(nums)              # every piece its own batch, scrambled
+    for n in nums:
+        sink.land_piece(n, content[n * piece:(n + 1) * piece])
+    assert sink.complete()
+    assert sink.verify()
+    assert np.asarray(sink.as_bytes_array()).tobytes() == content
+
+
+def test_hbm_sink_gather_path_with_missing_pieces():
+    """The gather fallback zero-fills not-landed slots."""
+    rng = np.random.RandomState(10)
+    piece = 512
+    content = rng.bytes(piece * 16)
+    sink = HBMSink(len(content), piece, batch_pieces=1)
+    sink._SEGMENT_CAP = 2
+    for n in (0, 3, 5, 11, 2, 9):
+        sink.land_piece(n, content[n * piece:(n + 1) * piece])
+    out = np.asarray(sink.as_bytes_array()).tobytes()
+    for n in range(16):
+        got = out[n * piece:(n + 1) * piece]
+        if n in (0, 3, 5, 11, 2, 9):
+            assert got == content[n * piece:(n + 1) * piece], n
+        else:
+            assert got == b"\x00" * piece, n
