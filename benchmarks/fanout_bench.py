@@ -59,7 +59,7 @@ def _spawn(args: list[str], log_path: str) -> subprocess.Popen:
         stdout=logf, stderr=subprocess.STDOUT, env=env)
 
 
-def _wait_sock(path: str, timeout: float = 30.0) -> bool:
+def _wait_sock(path: str, timeout: float = 120.0) -> bool:
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if os.path.exists(path):
@@ -75,7 +75,29 @@ def _wait_sock(path: str, timeout: float = 30.0) -> bool:
     return False
 
 
-async def run_bench(total_mb: int, n_peers: int, workdir: str) -> dict:
+async def _grab_profile(port: int, seconds: float, out_path: str) -> str:
+    """Pull /debug/profile from a daemon's metrics server mid-bench; save
+    the full pstats text and return the top cumulative lines."""
+    import aiohttp
+
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{port}/debug/profile",
+                             params={"seconds": str(seconds)},
+                             timeout=aiohttp.ClientTimeout(
+                                 total=seconds + 30)) as r:
+                text = await r.text()
+    except Exception as e:  # noqa: BLE001 - profile is best-effort
+        return f"profile failed: {e}"
+    with open(out_path, "w") as f:
+        f.write(text)
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    return "\n".join(lines[4:24])
+
+
+async def run_bench(total_mb: int, n_peers: int, workdir: str,
+                    profile: bool = False,
+                    origin_concurrency: int = 4) -> dict:
     # randbytes caps at 2^31 bits; build large content from 16 MiB blocks.
     rng = random.Random(99)
     content = b"".join(rng.randbytes(16 << 20)
@@ -113,15 +135,21 @@ async def run_bench(total_mb: int, n_peers: int, workdir: str) -> dict:
         procs.append(_spawn(
             ["scheduler", "--host", "127.0.0.1", "--port", str(sched_port)],
             os.path.join(workdir, "sched.log")))
-        procs.append(_spawn(
-            ["daemon", "--work-home", homes["seed"], "--seed-peer",
-             "--scheduler", f"127.0.0.1:{sched_port}"],
-            os.path.join(workdir, "seed.log")))
+        seed_metrics = _free_port() if profile else 0
+        peer0_metrics = _free_port() if profile else 0
+        seed_args = ["daemon", "--work-home", homes["seed"], "--seed-peer",
+                     "--scheduler", f"127.0.0.1:{sched_port}",
+                     "--piece-concurrency", str(origin_concurrency)]
+        if profile:
+            seed_args += ["--metrics-port", str(seed_metrics)]
+        procs.append(_spawn(seed_args, os.path.join(workdir, "seed.log")))
         for i in range(n_peers):
-            procs.append(_spawn(
-                ["daemon", "--work-home", homes[f"peer{i}"],
-                 "--scheduler", f"127.0.0.1:{sched_port}"],
-                os.path.join(workdir, f"peer{i}.log")))
+            peer_args = ["daemon", "--work-home", homes[f"peer{i}"],
+                         "--scheduler", f"127.0.0.1:{sched_port}"]
+            if profile and i == 0:
+                peer_args += ["--metrics-port", str(peer0_metrics)]
+            procs.append(_spawn(peer_args,
+                                os.path.join(workdir, f"peer{i}.log")))
         for n in names:
             ok = await asyncio.to_thread(
                 _wait_sock, os.path.join(homes[n], "run", "dfdaemon.sock"))
@@ -164,11 +192,28 @@ async def run_bench(total_mb: int, n_peers: int, workdir: str) -> dict:
             ttfps.append(first_piece[0] if first_piece[0] is not None
                          else time.perf_counter() - started)
 
-        await asyncio.gather(*[one_client(i) for i in range(n_peers)])
+        profiles: dict[str, str] = {}
+        clients = asyncio.gather(*[one_client(i) for i in range(n_peers)])
+        if profile:
+            # Sample both roles while the transfer is actually running.
+            async def sample():
+                await asyncio.sleep(1.0)
+                profiles["seed"] = await _grab_profile(
+                    seed_metrics, 10.0,
+                    os.path.join(workdir, "profile_seed.txt"))
+                profiles["peer0"] = await _grab_profile(
+                    peer0_metrics, 10.0,
+                    os.path.join(workdir, "profile_peer0.txt"))
+
+            sampler = asyncio.ensure_future(sample())
+            await clients
+            await sampler
+        else:
+            await clients
         wall = time.perf_counter() - t0
 
         total_bytes = n_peers * len(content)
-        return {
+        result = {
             "config": "p2p-fanout",
             "peers": n_peers,
             "seed_peers": 1,
@@ -179,7 +224,18 @@ async def run_bench(total_mb: int, n_peers: int, workdir: str) -> dict:
             "p50_ttfp_s": round(statistics.median(ttfps), 3),
             "origin_ratio": round(stats["bytes"] / len(content), 3),
             "origin_streams": stats["streams"],
+            "origin_concurrency": origin_concurrency,
+            "host_cores": os.cpu_count(),
         }
+        # The seed is the only origin client; its request fan-in must stay
+        # within the configured concurrency (+1 for the initial HEAD-like
+        # probe) — against real GCS this is per-task request pressure.
+        assert stats["streams"] <= origin_concurrency + 1, (
+            f"origin saw {stats['streams']} streams > "
+            f"{origin_concurrency} configured")
+        if profile:
+            result["profiles"] = profiles
+        return result
     finally:
         for p in procs:
             p.send_signal(signal.SIGTERM)
@@ -197,13 +253,26 @@ def main() -> int:
     ap.add_argument("--peers", type=int, default=8)
     ap.add_argument("--publish", action="store_true",
                     help="record the result in BASELINE.json['published']")
+    ap.add_argument("--profile", action="store_true",
+                    help="cProfile the seed and one peer mid-bench "
+                         "(saves profile_{seed,peer0}.txt in the workdir)")
+    ap.add_argument("--origin-concurrency", type=int, default=4,
+                    help="seed's concurrent origin range streams (asserted "
+                         "as the origin's observed request fan-in bound)")
     ap.add_argument("--workdir", default="")
     args = ap.parse_args()
 
     import tempfile
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="df-fanout-")
-    result = asyncio.run(run_bench(args.mb, args.peers, workdir))
+    result = asyncio.run(run_bench(args.mb, args.peers, workdir,
+                                   profile=args.profile,
+                                   origin_concurrency=args.origin_concurrency))
+    if args.profile:
+        for role, text in (result.get("profiles") or {}).items():
+            sys.stderr.write(f"\n=== {role} profile (top cumulative, "
+                             f"{workdir}/profile_{role}.txt) ===\n{text}\n")
+        result.pop("profiles", None)
     print(json.dumps(result))
 
     if args.publish:

@@ -167,6 +167,12 @@ def _add_daemon(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--device-sink", action="store_true",
                    help="enable the TPU HBM sink (tasks with --device tpu "
                         "land verified pieces in device memory)")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="fixed port for /metrics + /debug endpoints "
+                        "(0 = ephemeral, -1 = disabled)")
+    p.add_argument("--piece-concurrency", type=int, default=0,
+                   help="concurrent origin range streams for back-to-source "
+                        "(0 = config default; caps origin request fan-in)")
     p.set_defaults(func=_run_daemon)
 
 
@@ -216,6 +222,10 @@ def _run_daemon(args: argparse.Namespace) -> int:
         cfg.download.prefetch = True
     if args.device_sink:
         cfg.tpu_sink.enabled = True
+    if args.metrics_port:
+        cfg.metrics_port = args.metrics_port
+    if args.piece_concurrency > 0:
+        cfg.download.piece_concurrency = args.piece_concurrency
     if args.hijack_https:
         cfg.proxy.enabled = True
         cfg.proxy.hijack_https = True

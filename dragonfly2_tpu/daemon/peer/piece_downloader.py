@@ -51,7 +51,9 @@ class PieceDownloader:
                 if resp.status == 429:
                     raise DfError(Code.ClientRequestLimitFail,
                                   f"parent {parent_ip}:{parent_upload_port} throttled")
-                if resp.status != 200:
+                # 206: the upload server serves pieces as sendfile'd byte
+                # ranges (Partial Content) — equally complete payloads.
+                if resp.status not in (200, 206):
                     raise DfError(Code.ClientPieceRequestFail,
                                   f"parent returned {resp.status} for piece {piece_num}")
                 data = await resp.read()
@@ -97,6 +99,9 @@ async def pull_one_piece(downloader: PieceDownloader, store, dispatcher,
         assignment.parent.ip, assignment.parent.upload_port,
         task_id, assignment.piece_num,
         src_peer_id=peer_id, expected_size=assignment.expected_size)
-    return store.write_piece(assignment.piece_num, data,
-                             expected_digest=assignment.digest,
-                             cost_ms=cost_ms)
+    # Thread offload: the fused crc+pwrite is a GIL-releasing native call;
+    # inline it would block the event loop (and this daemon's own upload
+    # serving) for the disk write of every 4 MiB piece.
+    return await asyncio.to_thread(
+        store.write_piece, assignment.piece_num, data,
+        expected_digest=assignment.digest, cost_ms=cost_ms)

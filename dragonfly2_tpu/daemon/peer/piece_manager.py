@@ -244,8 +244,14 @@ class PieceManager:
         cost_ms = int((time.monotonic() - started_at) * 1000)
         if store.has_piece(num):
             return
-        rec = store.write_piece(num, data, cost_ms=cost_ms) if self.opt.compute_digest \
-            else store.write_piece(num, data, expected_digest="", cost_ms=cost_ms)
+        # Thread offload: the fused crc+pwrite releases the GIL; writing
+        # inline would block the loop (and upload serving) per 4 MiB piece.
+        if self.opt.compute_digest:
+            rec = await asyncio.to_thread(store.write_piece, num, data,
+                                          cost_ms=cost_ms)
+        else:
+            rec = await asyncio.to_thread(store.write_piece, num, data,
+                                          expected_digest="", cost_ms=cost_ms)
         if on_piece is not None:
             await on_piece(store, rec)
 

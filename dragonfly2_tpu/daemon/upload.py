@@ -30,6 +30,42 @@ UPLOAD_REQUESTS = metrics.counter("upload_requests_total", "Piece upload request
 CONCURRENT_UPLOADS = metrics.gauge("upload_concurrency", "In-flight piece uploads")
 
 
+class _PieceFileResponse(web.FileResponse):
+    """FileResponse serving exactly one byte window of the task data file
+    via sendfile. The window rides a synthesized Range header injected at
+    prepare time (FileResponse reads the REQUEST's Range), and prepare is
+    made idempotent — aiohttp's finish_response prepares again after the
+    handler returns, and the base class asserts on the second call.
+
+    The transfer happens AFTER the handler returns (aiohttp prepares the
+    response in finish_response), so this response owns the store pin and
+    the upload-concurrency slot and releases them when the send is done —
+    releasing in the handler would let GC rmtree the data file mid-
+    sendfile."""
+
+    def __init__(self, path, range_header: str, release):
+        super().__init__(path)
+        self._df_range = range_header
+        self._df_prepared = False
+        self._df_release = release
+
+    def _df_done(self) -> None:
+        release, self._df_release = self._df_release, None
+        if release is not None:
+            release()
+
+    async def prepare(self, request):
+        if self._df_prepared:
+            return self._payload_writer
+        self._df_prepared = True
+        try:
+            cloned = request.clone(headers={**request.headers,
+                                            "Range": self._df_range})
+            return await super().prepare(cloned)
+        finally:
+            self._df_done()
+
+
 class UploadManager:
     def __init__(self, storage: StorageManager, *, rate_limit: int = 0,
                  concurrent_limit: int = 0, ssl_context=None):
@@ -77,14 +113,29 @@ class UploadManager:
         self.concurrent += 1
         CONCURRENT_UPLOADS.inc()
         store.pin()
+        released = False
+
+        def release() -> None:
+            nonlocal released
+            if not released:
+                released = True
+                store.unpin()
+                self.concurrent -= 1
+                CONCURRENT_UPLOADS.dec()
+
         try:
             piece_num = request.query.get("pieceNum")
             if piece_num is not None:
                 try:
-                    data = store.read_piece(int(piece_num))
-                except Exception:
+                    rec = store.metadata.pieces.get(int(piece_num))
+                except ValueError:
+                    UPLOAD_REQUESTS.labels("bad_request").inc()
+                    raise web.HTTPBadRequest(
+                        text=f"bad pieceNum {piece_num!r}")
+                if rec is None:
                     UPLOAD_REQUESTS.labels("piece_missing").inc()
                     raise web.HTTPNotFound(text=f"piece {piece_num} not found")
+                start, length = rec.offset, rec.size
             else:
                 rng_header = request.headers.get("Range")
                 if not rng_header:
@@ -95,18 +146,24 @@ class UploadManager:
                 except ValueError as e:
                     UPLOAD_REQUESTS.labels("bad_request").inc()
                     raise web.HTTPBadRequest(text=str(e))
-                data = store.read_range(rng.start, rng.length)
-                if len(data) != rng.length:
+                if not store.covers_range(rng.start, rng.length):
                     UPLOAD_REQUESTS.labels("piece_missing").inc()
                     raise web.HTTPRequestRangeNotSatisfiable()
-            await self.limiter.wait(len(data))
-            UPLOAD_BYTES.inc(len(data))
+                start, length = rng.start, rng.length
+            await self.limiter.wait(length)
+            UPLOAD_BYTES.inc(length)
             UPLOAD_REQUESTS.labels("ok").inc()
-            return web.Response(body=data, status=200)
-        finally:
-            store.unpin()
-            self.concurrent -= 1
-            CONCURRENT_UPLOADS.dec()
+            # sendfile the byte range straight from the page cache — the
+            # hot single-core cost in profiles was pread into Python bytes
+            # plus the user→kernel copy in sendmsg (benchmarks/fanout_bench
+            # --profile showed the serving side dominated by exactly that).
+            # Pin + slot transfer to the response (released after the send).
+            return _PieceFileResponse(
+                store.data_path, f"bytes={start}-{start + length - 1}",
+                release)
+        except BaseException:
+            release()
+            raise
 
     async def _healthy(self, request: web.Request) -> web.Response:
         return web.Response(text="ok")

@@ -8,6 +8,7 @@ peer accounting.
 
 from __future__ import annotations
 
+import asyncio
 import time
 
 from dragonfly2_tpu.pkg.dag import DAG
@@ -56,6 +57,26 @@ class Task:
         self.back_to_source_peers: set[str] = set()
         self.created_at = time.time()
         self.updated_at = time.time()
+        # Parent-availability wakeup: schedulers waiting for a usable
+        # parent block on this instead of poll-sleeping (reference polls
+        # at RetryInterval=500ms — scheduler/config/constants.go:68-70;
+        # event-driven cuts first-piece latency to the actual arrival).
+        self._parents_event = asyncio.Event()
+
+    def notify_parents_changed(self) -> None:
+        """Wake every scheduler retry-loop waiting on this task: a peer
+        gained its first piece, finished, or released upload slots."""
+        event, self._parents_event = self._parents_event, asyncio.Event()
+        event.set()
+
+    async def wait_parents_changed(self, timeout: float) -> None:
+        """Wait until parent availability may have changed, at most
+        ``timeout`` seconds (the poll interval becomes an upper bound)."""
+        event = self._parents_event
+        try:
+            await asyncio.wait_for(event.wait(), timeout)
+        except (asyncio.TimeoutError, TimeoutError):
+            pass
 
     # -- state -------------------------------------------------------------
 

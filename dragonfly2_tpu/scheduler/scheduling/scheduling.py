@@ -65,17 +65,33 @@ class Scheduling:
         cfg = self.config
         task = peer.task
 
-        for attempt in range(cfg.retry_limit):
+        # Event-driven retry with a TIME-based budget: each wakeup
+        # (a parent's first piece, a finish, freed slots) re-checks
+        # immediately, but demotion thresholds stay measured in elapsed
+        # retry intervals — a burst of unrelated notifies must not burn
+        # the back-to-source budget in milliseconds.
+        loop = asyncio.get_running_loop()
+        start = loop.time()
+        back_source_after = (cfg.retry_back_to_source_limit - 1) * cfg.retry_interval
+        give_up_after = (cfg.retry_limit - 1) * cfg.retry_interval
+        while True:
             parents = self.find_candidate_parents(peer, blocklist)
             if parents:
                 return ScheduleResult(ScheduleResult.CANDIDATES, parents)
+            elapsed = loop.time() - start
             if (allow_back_source
-                    and attempt + 1 >= cfg.retry_back_to_source_limit
+                    and elapsed >= back_source_after
                     and task.can_back_to_source()
                     and peer.fsm.can("download_back_to_source")):
-                return ScheduleResult(ScheduleResult.NEED_BACK_SOURCE,
-                                      reason=f"no parents after {attempt + 1} tries")
-            await asyncio.sleep(cfg.retry_interval)
+                return ScheduleResult(
+                    ScheduleResult.NEED_BACK_SOURCE,
+                    reason=f"no parents after {elapsed:.1f}s")
+            if elapsed >= give_up_after:
+                break
+            # Sleep to the end of the current interval slice unless a
+            # parent-availability event wakes us first.
+            remaining = cfg.retry_interval - (elapsed % cfg.retry_interval)
+            await task.wait_parents_changed(remaining)
 
         if allow_back_source and task.can_back_to_source() \
                 and peer.fsm.can("download_back_to_source"):

@@ -366,9 +366,14 @@ class SchedulerService:
     def _handle_piece_finished(self, msg: dict, task: Task, peer: Peer) -> None:
         p = msg.get("piece") or {}
         info = PieceInfo.from_wire(p)
+        first_piece = not peer.finished_pieces
         peer.add_finished_piece(info.piece_num, info.download_cost_ms)
         task.store_piece(info)
         task.touch()
+        if first_piece:
+            # The peer just became a usable parent: wake schedule loops
+            # instead of letting them poll out their retry interval.
+            task.notify_parents_changed()
         parent_id = p.get("dst_peer_id", "")
         if parent_id:
             parent = self.peers.load(parent_id)
@@ -395,6 +400,8 @@ class SchedulerService:
         for pid in msg.get("blocklist") or []:
             peer.block_parents.add(pid)
         task.delete_peer_in_edges(peer.id)
+        # The dropped edges freed upload slots on the old parents.
+        task.notify_parents_changed()
         patience = 30.0 if self._seed_active(task) else 0.0
         await self._schedule_and_send(task, peer, patience=patience)
 
@@ -422,6 +429,9 @@ class SchedulerService:
             pass
         if task.fsm.can("download_succeeded"):
             task.fsm.event("download_succeeded")
+        # Finished peer = SUCCEEDED parent + freed upload slots on its old
+        # parents: both change candidacy for waiting schedule loops.
+        task.notify_parents_changed()
         log.info("peer finished", peer=peer.id[:24], task=task.id[:16])
         # Tiny tasks: pull the content off the finisher's upload server so
         # later registrants get it inlined (reference service_v1.go:1196-1210
@@ -681,7 +691,8 @@ class SchedulerService:
                     timeout=aiohttp.ClientTimeout(total=10)) as sess:
                 async with sess.get(url, params={"peerId": peer.id,
                                                  "pieceNum": "0"}) as resp:
-                    if resp.status != 200:
+                    # 206: upload servers serve pieces as sendfile'd ranges.
+                    if resp.status not in (200, 206):
                         return
                     data = await resp.read()
         except aiohttp.ClientError:
@@ -741,6 +752,8 @@ class SchedulerService:
             task.fsm.event("download")
         if task.fsm.can("download_succeeded"):
             task.fsm.event("download_succeeded")
+        # A complete local task just became a parent candidate.
+        task.notify_parents_changed()
         log.info("task announced", task=task.id[:16], host=host.id,
                  pieces=len(peer.finished_pieces))
         return {"ok": True}

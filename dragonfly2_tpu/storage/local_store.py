@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import threading
 import time
 from dataclasses import asdict, dataclass, field
 
@@ -115,6 +116,10 @@ class LocalTaskStore:
         self._data_path = os.path.join(self.dir, DATA_FILE)
         self._fd: int | None = None
         self._pins = 0
+        # Piece writes are thread-offloaded (daemon/peer paths): the
+        # native crc+pwrite runs GIL-free and offset-disjoint, but fd
+        # creation and metadata record/serialize must serialize.
+        self._meta_lock = threading.Lock()
 
     # -- pinning: GC must not reclaim a store mid-download/upload ----------
 
@@ -152,7 +157,10 @@ class LocalTaskStore:
 
     def _ensure_fd(self) -> int:
         if self._fd is None:
-            self._fd = os.open(self._data_path, os.O_RDWR | os.O_CREAT, 0o644)
+            with self._meta_lock:
+                if self._fd is None:
+                    self._fd = os.open(self._data_path,
+                                       os.O_RDWR | os.O_CREAT, 0o644)
         return self._fd
 
     def close(self) -> None:
@@ -167,10 +175,11 @@ class LocalTaskStore:
     # -- metadata ----------------------------------------------------------
 
     def save_metadata(self) -> None:
-        tmp = os.path.join(self.dir, METADATA_FILE + ".tmp")
-        with open(tmp, "w") as f:
-            json.dump(self.metadata.to_json(), f)
-        os.replace(tmp, os.path.join(self.dir, METADATA_FILE))
+        with self._meta_lock:
+            tmp = os.path.join(self.dir, METADATA_FILE + ".tmp")
+            with open(tmp, "w") as f:
+                json.dump(self.metadata.to_json(), f)
+            os.replace(tmp, os.path.join(self.dir, METADATA_FILE))
 
     def touch(self) -> None:
         self.metadata.last_access = time.time()
@@ -253,9 +262,10 @@ class LocalTaskStore:
             while written < len(data):
                 written += os.pwrite(fd, data[written:], offset + written)
         rec = PieceRecord(num=num, offset=offset, size=len(data), digest=digest_str, cost_ms=cost_ms)
-        existing = m.pieces.get(num)
-        m.pieces[num] = rec
-        self.touch()
+        with self._meta_lock:
+            existing = m.pieces.get(num)
+            m.pieces[num] = rec
+            self.touch()
         if existing is None:
             # Persist piece map incrementally so a daemon restart resumes
             # from the bitmap (reference: checkpoint/resume of downloads).
@@ -281,18 +291,25 @@ class LocalTaskStore:
         """Contiguous-known pieces from start_num (upload-server listing —
         reference local_storage.go:434 GetPieces)."""
         out = []
-        nums = sorted(n for n in self.metadata.pieces if n >= start_num)
-        for n in nums:
-            out.append(self.metadata.pieces[n])
-            if limit and len(out) >= limit:
-                break
+        with self._meta_lock:  # writers mutate from worker threads
+            nums = sorted(n for n in self.metadata.pieces if n >= start_num)
+            for n in nums:
+                out.append(self.metadata.pieces[n])
+                if limit and len(out) >= limit:
+                    break
         return out
 
     def has_piece(self, num: int) -> bool:
         return num in self.metadata.pieces
 
+    @property
+    def data_path(self) -> str:
+        """Path of the on-disk data file (upload server sendfile source)."""
+        return self._data_path
+
     def downloaded_bytes(self) -> int:
-        return sum(p.size for p in self.metadata.pieces.values())
+        with self._meta_lock:  # writers mutate from worker threads
+            return sum(p.size for p in self.metadata.pieces.values())
 
     def disk_usage(self) -> int:
         try:
@@ -389,7 +406,8 @@ class LocalTaskStore:
             return False
         first = start // m.piece_size
         last = (start + length - 1) // m.piece_size
-        return all(n in m.pieces for n in range(first, last + 1))
+        with self._meta_lock:  # writers mutate from worker threads
+            return all(n in m.pieces for n in range(first, last + 1))
 
     def export_range(self, dest: str, start: int, length: int) -> None:
         """Write the byte range [start, start+length) to ``dest`` from the

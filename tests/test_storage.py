@@ -174,3 +174,51 @@ class TestGCPinning:
         fresh = sm.register_task(meta())
         assert not fresh.metadata.invalid
         assert not fresh.metadata.pieces  # clean slate, no poisoned pieces
+
+
+def test_concurrent_writes_and_reads_threadsafe(tmp_path):
+    """write_piece runs on worker threads (asyncio.to_thread in the piece
+    paths) while the event loop reads the piece map — no 'dict changed
+    size during iteration', no lost pieces (code-review regression r3)."""
+    import threading
+
+    from dragonfly2_tpu.storage.local_store import (
+        LocalTaskStore,
+        TaskStoreMetadata,
+    )
+
+    piece = 4096
+    total = 64
+    store = LocalTaskStore(
+        str(tmp_path / "t"),
+        TaskStoreMetadata(task_id="t-threads", content_length=piece * total,
+                          piece_size=piece, total_piece_count=total))
+    blob = b"\xab" * piece
+    errors = []
+
+    def writer(nums):
+        try:
+            for n in nums:
+                store.write_piece(n, blob)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def reader():
+        try:
+            for _ in range(300):
+                store.get_pieces()
+                store.covers_range(0, piece * total)
+                store.downloaded_bytes()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(range(i, total, 4),))
+               for i in range(4)] + [threading.Thread(target=reader)
+                                     for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert len(store.metadata.pieces) == total
+    assert store.is_complete()
