@@ -207,10 +207,13 @@ async def run_bench(total_mb: int, n_peers: int, workdir: str,
 
             sampler = asyncio.ensure_future(sample())
             await clients
+            # Wall stops at transfer completion — the profiler's remaining
+            # sampling window must not dilute aggregate_gbps.
+            wall = time.perf_counter() - t0
             await sampler
         else:
             await clients
-        wall = time.perf_counter() - t0
+            wall = time.perf_counter() - t0
 
         total_bytes = n_peers * len(content)
         result = {

@@ -1,0 +1,225 @@
+"""BASELINE config #3 bench: OCI registry pull-through via the proxy.
+
+A real dfdaemon process runs the HTTP proxy in registry-mirror mode; a
+plain HTTP client (what containerd's hosts.toml mirror config amounts to)
+pulls an image manifest and its layer blobs THROUGH the proxy twice.
+Reports:
+
+  - cold_gbps        first pull (origin → P2P piece store → client)
+  - warm_gbps        second pull (served from the local piece store)
+  - origin_ratio     origin blob bytes served / image size (≈1.0 = the
+                     warm pull never touched the registry)
+
+Usage: python benchmarks/registry_bench.py [--layers 4] [--layer-mb 32]
+Writes a JSON line to stdout and (with --publish) updates
+BASELINE.json["published"]["config3_registry"].
+
+Reference yardstick: test/e2e/v2/containerd_test.go (image pull through
+dfdaemon, repeat pull served from cache); the reference publishes no
+numbers (BASELINE.md), so these become the numbers to beat.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import hashlib
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from aiohttp import web  # noqa: E402
+
+from dragonfly2_tpu.pkg.piece import Range  # noqa: E402
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn(args: list[str], log_path: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    logf = open(log_path, "w")
+    return subprocess.Popen(
+        [sys.executable, "-m", "dragonfly2_tpu.cli.main", *args],
+        stdout=logf, stderr=subprocess.STDOUT, env=env)
+
+
+def _wait_port(host: str, port: int, timeout: float = 120.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        s = socket.socket()
+        s.settimeout(1.0)
+        try:
+            s.connect((host, port))
+            return True
+        except OSError:
+            time.sleep(0.2)
+        finally:
+            s.close()
+    return False
+
+
+async def _start_registry(layers: list[bytes]):
+    """Fake OCI registry: manifest + content-addressed layer blobs with
+    origin accounting."""
+    stats = {"blob_bytes": 0, "blob_gets": 0, "manifest_gets": 0}
+    by_digest = {hashlib.sha256(b).hexdigest(): b for b in layers}
+
+    async def blob(request: web.Request) -> web.Response:
+        digest = request.match_info["digest"]
+        body = by_digest.get(digest.removeprefix("sha256:"))
+        if body is None:
+            raise web.HTTPNotFound()
+        stats["blob_gets"] += 1
+        rng = request.headers.get("Range")
+        if rng:
+            r = Range.parse_http(rng, len(body))
+            data = body[r.start:r.start + r.length]
+            stats["blob_bytes"] += len(data)
+            return web.Response(status=206, body=data, headers={
+                "Accept-Ranges": "bytes",
+                "Content-Range":
+                    f"bytes {r.start}-{r.start + r.length - 1}/{len(body)}"})
+        stats["blob_bytes"] += len(body)
+        return web.Response(body=body, headers={"Accept-Ranges": "bytes"})
+
+    async def manifest(request: web.Request) -> web.Response:
+        stats["manifest_gets"] += 1
+        return web.json_response({
+            "schemaVersion": 2,
+            "mediaType": "application/vnd.oci.image.manifest.v1+json",
+            "layers": [{"digest": "sha256:" + hashlib.sha256(b).hexdigest(),
+                        "size": len(b)} for b in layers],
+        })
+
+    app = web.Application()
+    app.router.add_get("/v2/library/model/blobs/{digest}", blob)
+    app.router.add_get("/v2/library/model/manifests/{ref}", manifest)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    return runner, site._server.sockets[0].getsockname()[1], stats
+
+
+async def _pull_image(http, proxy_base: str) -> tuple[int, float]:
+    """Pull manifest + all layers through the proxy; returns (bytes, s)."""
+    import aiohttp
+
+    t0 = time.perf_counter()
+    total = 0
+    async with http.get(f"{proxy_base}/v2/library/model/manifests/latest",
+                        timeout=aiohttp.ClientTimeout(total=600)) as r:
+        assert r.status == 200, await r.text()
+        doc = await r.json(content_type=None)
+    for layer in doc["layers"]:
+        async with http.get(
+                f"{proxy_base}/v2/library/model/blobs/{layer['digest']}",
+                timeout=aiohttp.ClientTimeout(total=600)) as r:
+            assert r.status == 200, r.status
+            data = await r.read()
+        assert len(data) == layer["size"]
+        assert ("sha256:" + hashlib.sha256(data).hexdigest()
+                == layer["digest"]), "layer digest mismatch"
+        total += len(data)
+    return total, time.perf_counter() - t0
+
+
+async def run_bench(n_layers: int, layer_mb: int, workdir: str) -> dict:
+    rng = random.Random(31)
+    layers = [rng.randbytes(layer_mb << 20) for _ in range(n_layers)]
+    registry, reg_port, stats = await _start_registry(layers)
+    proxy_port = _free_port()
+    daemon = _spawn(
+        ["daemon", "--work-home", os.path.join(workdir, "daemon"),
+         "--proxy-port", str(proxy_port),
+         "--registry-mirror", f"http://127.0.0.1:{reg_port}"],
+        os.path.join(workdir, "daemon.log"))
+    try:
+        # The proxy binds the daemon's detected host IP, not loopback —
+        # use the same detection the daemon does.
+        from dragonfly2_tpu.daemon.config import _local_ip
+
+        host_ip = _local_ip()
+        if not _wait_port(host_ip, proxy_port):
+            raise RuntimeError(
+                "proxy did not come up; tail: " + open(
+                    os.path.join(workdir, "daemon.log")).read()[-1500:])
+
+        import aiohttp
+
+        proxy_base = f"http://{host_ip}:{proxy_port}"
+        image_bytes = sum(len(b) for b in layers)
+        async with aiohttp.ClientSession() as http:
+            cold_bytes, cold_s = await _pull_image(http, proxy_base)
+            origin_after_cold = stats["blob_bytes"]
+            warm_bytes, warm_s = await _pull_image(http, proxy_base)
+        assert cold_bytes == warm_bytes == image_bytes
+        # The warm pull must be served from the piece store, not origin.
+        assert stats["blob_bytes"] == origin_after_cold, (
+            "warm pull hit the origin")
+        return {
+            "config": "registry-pull-through",
+            "layers": n_layers,
+            "layer_mb": layer_mb,
+            "image_mb": image_bytes >> 20,
+            "cold_gbps": round(image_bytes / cold_s / 1e9, 3),
+            "warm_gbps": round(image_bytes / warm_s / 1e9, 3),
+            "cold_s": round(cold_s, 2),
+            "warm_s": round(warm_s, 2),
+            "origin_ratio": round(origin_after_cold / image_bytes, 3),
+            "origin_blob_gets": stats["blob_gets"],
+            "host_cores": os.cpu_count(),
+        }
+    finally:
+        daemon.send_signal(signal.SIGTERM)
+        try:
+            daemon.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            daemon.kill()
+        await registry.cleanup()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--layer-mb", type=int, default=32)
+    ap.add_argument("--publish", action="store_true")
+    ap.add_argument("--workdir", default="")
+    args = ap.parse_args()
+
+    import tempfile
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="df-registry-")
+    os.makedirs(workdir, exist_ok=True)
+    result = asyncio.run(run_bench(args.layers, args.layer_mb, workdir))
+    print(json.dumps(result))
+
+    if args.publish:
+        path = os.path.join(REPO, "BASELINE.json")
+        doc = json.load(open(path))
+        doc.setdefault("published", {})["config3_registry"] = result
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

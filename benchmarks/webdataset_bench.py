@@ -1,0 +1,203 @@
+"""BASELINE config #4 bench: webdataset tar-shard streaming through dfstore.
+
+A real dfdaemon process runs the S3-like object gateway (fs backend holding
+webdataset-style tar shards); the client streams the shards through
+``Dfstore.stream_object`` — ordered bytes delivered as pieces land, the way
+a training input pipeline consumes them. Reports:
+
+  - ttfb_s           time to the FIRST streamed chunk of a cold shard
+  - cold_mbps        sustained streaming rate, cold (origin → pieces → client)
+  - warm_mbps        repeat read (served from the local piece store)
+
+Usage: python benchmarks/webdataset_bench.py [--shards 4] [--shard-mb 64]
+Writes a JSON line to stdout and (with --publish) updates
+BASELINE.json["published"]["config4_webdataset"].
+
+Reference yardstick: the object-storage gateway + stream-task path
+(objectstorage.go:253 getObject); the reference publishes no numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import hashlib
+import io
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import tarfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _spawn(args: list[str], log_path: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    logf = open(log_path, "w")
+    return subprocess.Popen(
+        [sys.executable, "-m", "dragonfly2_tpu.cli.main", *args],
+        stdout=logf, stderr=subprocess.STDOUT, env=env)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_port(host: str, port: int, timeout: float = 120.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        s = socket.socket()
+        s.settimeout(1.0)
+        try:
+            s.connect((host, port))
+            return True
+        except OSError:
+            time.sleep(0.2)
+        finally:
+            s.close()
+    return False
+
+
+def _make_shard(rng: random.Random, shard_mb: int, index: int) -> bytes:
+    """A webdataset-style tar shard: numbered samples of (jpg, cls) pairs."""
+    buf = io.BytesIO()
+    sample_kb = 256
+    n_samples = shard_mb * 1024 // sample_kb
+    with tarfile.open(fileobj=buf, mode="w") as tar:
+        for i in range(n_samples):
+            payload = rng.randbytes(sample_kb * 1024 - 128)
+            info = tarfile.TarInfo(name=f"{index:03d}/{i:06d}.jpg")
+            info.size = len(payload)
+            tar.addfile(info, io.BytesIO(payload))
+            label = str(rng.randrange(1000)).encode()
+            info = tarfile.TarInfo(name=f"{index:03d}/{i:06d}.cls")
+            info.size = len(label)
+            tar.addfile(info, io.BytesIO(label))
+    return buf.getvalue()
+
+
+async def _stream_shard(store, bucket: str, key: str,
+                        want_sha: str) -> tuple[float, float, int]:
+    """Stream one shard; returns (ttfb_s, total_s, nbytes)."""
+    h = hashlib.sha256()
+    total = 0
+    ttfb = None
+    t0 = time.perf_counter()
+    async for chunk in await store.stream_object(bucket, key):
+        if ttfb is None:
+            ttfb = time.perf_counter() - t0
+        h.update(chunk)
+        total += len(chunk)
+    assert h.hexdigest() == want_sha, f"{key} sha mismatch"
+    return ttfb, time.perf_counter() - t0, total
+
+
+async def run_bench(n_shards: int, shard_mb: int, workdir: str) -> dict:
+    rng = random.Random(17)
+    bucket_root = os.path.join(workdir, "buckets")
+    shard_dir = os.path.join(bucket_root, "webdataset")
+    os.makedirs(shard_dir, exist_ok=True)
+    shas = {}
+    for i in range(n_shards):
+        shard = _make_shard(rng, shard_mb, i)
+        key = f"train-{i:05d}.tar"
+        with open(os.path.join(shard_dir, key), "wb") as f:
+            f.write(shard)
+        shas[key] = hashlib.sha256(shard).hexdigest()
+
+    gw_port = _free_port()
+    daemon = _spawn(
+        ["daemon", "--work-home", os.path.join(workdir, "daemon"),
+         "--object-storage-port", str(gw_port),
+         "--object-storage-backend", "fs",
+         "--object-storage-option", f"root={bucket_root}"],
+        os.path.join(workdir, "daemon.log"))
+    try:
+        # The gateway binds the daemon's detected host IP, not loopback.
+        from dragonfly2_tpu.daemon.config import _local_ip
+
+        host_ip = _local_ip()
+        if not _wait_port(host_ip, gw_port):
+            raise RuntimeError(
+                "gateway did not come up; tail: " + open(
+                    os.path.join(workdir, "daemon.log")).read()[-1500:])
+
+        from dragonfly2_tpu.client.dfstore import Dfstore
+
+        store = Dfstore(f"http://{host_ip}:{gw_port}")
+        try:
+            ttfbs, cold_bytes, cold_s = [], 0, 0.0
+            for key, sha in shas.items():
+                ttfb, took, n = await _stream_shard(
+                    store, "webdataset", key, sha)
+                ttfbs.append(ttfb)
+                cold_bytes += n
+                cold_s += took
+            warm_bytes, warm_s = 0, 0.0
+            for key, sha in shas.items():
+                _, took, n = await _stream_shard(
+                    store, "webdataset", key, sha)
+                warm_bytes += n
+                warm_s += took
+        finally:
+            await store.close()
+        return {
+            "config": "webdataset-streaming",
+            "shards": n_shards,
+            "shard_mb": shard_mb,
+            "total_mb": cold_bytes >> 20,
+            "ttfb_s": round(sorted(ttfbs)[len(ttfbs) // 2], 3),
+            "cold_mbps": round(cold_bytes / cold_s / 1e6, 1),
+            "warm_mbps": round(warm_bytes / warm_s / 1e6, 1),
+            "cold_s": round(cold_s, 2),
+            "warm_s": round(warm_s, 2),
+            "host_cores": os.cpu_count(),
+        }
+    finally:
+        daemon.send_signal(signal.SIGTERM)
+        try:
+            daemon.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            daemon.kill()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shards", type=int, default=4)
+    ap.add_argument("--shard-mb", type=int, default=64)
+    ap.add_argument("--publish", action="store_true")
+    ap.add_argument("--workdir", default="")
+    args = ap.parse_args()
+
+    import tempfile
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="df-webdataset-")
+    os.makedirs(workdir, exist_ok=True)
+    result = asyncio.run(run_bench(args.shards, args.shard_mb, workdir))
+    print(json.dumps(result))
+
+    if args.publish:
+        path = os.path.join(REPO, "BASELINE.json")
+        doc = json.load(open(path))
+        doc.setdefault("published", {})["config4_webdataset"] = result
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -241,6 +241,10 @@ class Daemon:
 
     async def _gc_storage(self) -> None:
         self.storage.gc()
+        if self.task_manager.device_sinks is not None:
+            # TTL sweep of unclaimed device sinks: content-sized HBM must
+            # not stay resident for the daemon's lifetime.
+            self.task_manager.device_sinks.gc()
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -115,6 +115,9 @@ class DeviceSinkManager:
         self.ttl = ttl
         self._device = device
         self._sinks: dict[str, TaskDeviceSink] = {}
+        # Tasks whose sink hit a device error mid-download: disk-only for
+        # the rest of this attempt (cleared on discard → retry is fresh).
+        self._degraded: set[str] = set()
         # Single worker: serializes sink mutation (HBMSink is not
         # thread-safe) and keeps device copies off the event loop.
         self._exec = ThreadPoolExecutor(
@@ -136,6 +139,8 @@ class DeviceSinkManager:
         await self._run(self._land_sync, task_id, store, rec)
 
     def _land_sync(self, task_id: str, store, rec) -> None:
+        if task_id in self._degraded:
+            return
         sink = self._sinks.get(task_id)
         if sink is None:
             m = store.metadata
@@ -150,7 +155,17 @@ class DeviceSinkManager:
             log.warning("piece out of sink range, skipped",
                         task=task_id[:16], piece=rec.num)
             return
-        sink.land(rec.num, store.read_piece(rec.num), rec.digest)
+        try:
+            sink.land(rec.num, store.read_piece(rec.num), rec.digest)
+        except Exception as e:
+            # Device trouble mid-stream (HBM OOM in the staging device_put,
+            # runtime errors): degrade THIS task to disk-only — the
+            # download itself must not fail, and later pieces must not
+            # retry a doomed sink.
+            log.warning("device landing failed; degrading to disk-only",
+                        task=task_id[:16], error=str(e)[:200])
+            self._sinks.pop(task_id, None)
+            self._degraded.add(task_id)
 
     def _create(self, task_id: str, content_length: int,
                 piece_size: int) -> TaskDeviceSink | None:
@@ -185,6 +200,24 @@ class DeviceSinkManager:
         return await self._run(self._finalize_sync, task_id, store)
 
     def _finalize_sync(self, task_id: str, store) -> TaskDeviceSink | None:
+        if task_id in self._degraded:
+            self._degraded.discard(task_id)  # next attempt starts fresh
+            return None
+        try:
+            return self._finalize_inner(task_id, store)
+        except DeviceSinkError:
+            raise  # device-copy corruption: surfaced to the caller
+        except Exception as e:
+            # Environment failures (OOM during backfill staging, assembly
+            # dispatch errors, store read races) degrade to disk-only —
+            # the digest-verified disk result must not be discarded over a
+            # device-side hiccup.
+            log.warning("device finalize failed; disk-only result",
+                        task=task_id[:16], error=str(e)[:200])
+            self._sinks.pop(task_id, None)
+            return None
+
+    def _finalize_inner(self, task_id: str, store) -> TaskDeviceSink | None:
         m = store.metadata
         sink = self._sinks.get(task_id)
         if sink is not None and self._stale(sink, store):
@@ -227,6 +260,12 @@ class DeviceSinkManager:
 
     def discard(self, task_id: str) -> None:
         self._sinks.pop(task_id, None)
+        self._degraded.discard(task_id)
+
+    def gc(self) -> None:
+        """Periodic TTL sweep (daemon GC hook) — unclaimed sinks must not
+        hold content-sized HBM for the daemon's lifetime."""
+        self._expire()
 
     def _expire(self) -> None:
         now = time.time()

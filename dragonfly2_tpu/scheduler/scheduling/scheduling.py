@@ -65,28 +65,34 @@ class Scheduling:
         cfg = self.config
         task = peer.task
 
-        # Event-driven retry with a TIME-based budget: each wakeup
-        # (a parent's first piece, a finish, freed slots) re-checks
-        # immediately, but demotion thresholds stay measured in elapsed
-        # retry intervals — a burst of unrelated notifies must not burn
-        # the back-to-source budget in milliseconds.
+        # Event-driven retry with a DUAL budget: each wakeup (a parent's
+        # first piece, a finish, freed slots) re-checks immediately, but
+        # demotion needs BOTH enough elapsed retry intervals (a burst of
+        # unrelated notifies must not burn the budget in milliseconds) AND
+        # enough actual find attempts (a stalled event loop accumulates
+        # wall time without ever really looking for parents — premature
+        # origin demotions showed up as 18 fetches in the churn test).
         loop = asyncio.get_running_loop()
         start = loop.time()
         back_source_after = (cfg.retry_back_to_source_limit - 1) * cfg.retry_interval
         give_up_after = (cfg.retry_limit - 1) * cfg.retry_interval
+        attempts = 0
         while True:
             parents = self.find_candidate_parents(peer, blocklist)
+            attempts += 1
             if parents:
                 return ScheduleResult(ScheduleResult.CANDIDATES, parents)
             elapsed = loop.time() - start
             if (allow_back_source
                     and elapsed >= back_source_after
+                    and attempts >= cfg.retry_back_to_source_limit
                     and task.can_back_to_source()
                     and peer.fsm.can("download_back_to_source")):
                 return ScheduleResult(
                     ScheduleResult.NEED_BACK_SOURCE,
-                    reason=f"no parents after {elapsed:.1f}s")
-            if elapsed >= give_up_after:
+                    reason=f"no parents after {elapsed:.1f}s"
+                           f"/{attempts} attempts")
+            if elapsed >= give_up_after and attempts >= cfg.retry_limit:
                 break
             # Sleep to the end of the current interval slice unless a
             # parent-availability event wakes us first.
