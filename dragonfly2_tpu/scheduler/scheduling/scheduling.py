@@ -111,10 +111,32 @@ class Scheduling:
     def find_candidate_parents(self, peer: Peer, blocklist: set[str] | None = None) -> list[Peer]:
         task = peer.task
         blocklist = blocklist or set()
-        sample = task.dag.random_vertices(self.config.filter_parent_limit)
+        sample = {v.id: v.value
+                  for v in task.dag.random_vertices(
+                      self.config.filter_parent_limit)}
+        # ICI locality: merge same-slice peers into the sample so the
+        # evaluator's slice-affinity term has intra-slice candidates to
+        # prefer — a uniform random sample of a 256-host pod rarely
+        # contains one (~6% per candidate at 16 hosts/slice), which caps
+        # intra-slice scheduling no matter how the scorer weighs it.
+        my_slice = peer.host.tpu_slice
+        if my_slice:
+            added = 0
+            for pid in task.slice_index.get(my_slice, ()):
+                if added >= self.config.filter_parent_limit:
+                    break
+                # Cap AFTER skipping self/duplicates/blocked — truncating
+                # the raw member list could drop the one same-slice peer
+                # that actually has pieces.
+                if pid == peer.id or pid in sample or pid in blocklist:
+                    continue
+                v = task.load_peer(pid)
+                if v is not None:
+                    sample[pid] = v
+                    added += 1
         candidates = [
-            v.value for v in sample
-            if self._is_candidate(v.value, peer, blocklist)
+            p for p in sample.values()
+            if self._is_candidate(p, peer, blocklist)
         ]
         if not candidates:
             return []

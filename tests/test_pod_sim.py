@@ -1,0 +1,24 @@
+"""Pod-scale scheduler simulation (BASELINE config #5 at test scale).
+
+96 simulated hosts across 6 slices with real topology labels drive one
+task through the scheduler; asserts origin economy (~1 fetch), engaged
+ICI locality (same-slice parent picks far above the random base rate —
+benchmarks/pod_sim_bench.py publishes the 256-host numbers), schedule
+latency, and event-loop stall bounds.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from benchmarks.pod_sim_bench import check, run_sim
+
+
+def test_pod_sim_96_hosts(run_async):
+    async def body():
+        result = await run_sim(96, piece_latency_s=0.001,
+                               arrival_window_s=0.5)
+        check(result)
+        assert result["schedule_p99_ms"] < 1000, result
+
+    run_async(body(), timeout=120)
