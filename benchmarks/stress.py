@@ -1,0 +1,105 @@
+"""Load generator for the daemon's HTTP surfaces.
+
+Reference: test/tools/stress/main.go — a concurrent GET hammer with
+latency statistics, pointed at dfdaemon's proxy/upload/object-gateway
+endpoints. Same role here: N workers hit one URL for a duration (or a
+fixed request count) and report throughput + latency percentiles + error
+taxonomy, so daemon HTTP surfaces can be load-tested without a cluster.
+
+Usage:
+  python benchmarks/stress.py URL [--concurrency 16] [--duration 10]
+                                  [--requests 0] [--proxy http://host:port]
+Prints one JSON line: {rps, mbps, p50_ms, p95_ms, p99_ms, errors, ...}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+
+async def _worker(session, url: str, stop_at: float, counter,
+                  latencies: list[float], errors: dict[str, int],
+                  max_requests: int, proxy: str | None) -> None:
+    while time.monotonic() < stop_at:
+        if max_requests and counter["sent"] >= max_requests:
+            return
+        counter["sent"] += 1
+        t0 = time.monotonic()
+        try:
+            async with session.get(url, proxy=proxy) as resp:
+                body = await resp.read()
+                if resp.status in (200, 206):
+                    counter["ok"] += 1
+                    counter["bytes"] += len(body)
+                    latencies.append(time.monotonic() - t0)
+                else:
+                    errors[f"http_{resp.status}"] = (
+                        errors.get(f"http_{resp.status}", 0) + 1)
+        except Exception as e:  # noqa: BLE001 - taxonomy, not control flow
+            key = type(e).__name__
+            errors[key] = errors.get(key, 0) + 1
+
+
+async def run_stress(url: str, concurrency: int, duration: float,
+                     max_requests: int = 0,
+                     proxy: str | None = None) -> dict:
+    import aiohttp
+
+    latencies: list[float] = []
+    errors: dict[str, int] = {}
+    counter = {"sent": 0, "ok": 0, "bytes": 0}
+    stop_at = time.monotonic() + duration
+    t0 = time.monotonic()
+    async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=60),
+            connector=aiohttp.TCPConnector(limit=concurrency * 2)) as session:
+        await asyncio.gather(*[
+            _worker(session, url, stop_at, counter, latencies, errors,
+                    max_requests, proxy)
+            for _ in range(concurrency)])
+    wall = time.monotonic() - t0
+    latencies.sort()
+
+    def pct(p: float) -> float:
+        if not latencies:
+            return 0.0
+        return latencies[min(len(latencies) - 1, int(len(latencies) * p))]
+
+    return {
+        "url": url,
+        "concurrency": concurrency,
+        "wall_s": round(wall, 2),
+        "requests": counter["sent"],
+        "ok": counter["ok"],
+        "rps": round(counter["ok"] / wall, 1) if wall else 0.0,
+        "mbps": round(counter["bytes"] / wall / 1e6, 1) if wall else 0.0,
+        "p50_ms": round(pct(0.50) * 1000, 1),
+        "p95_ms": round(pct(0.95) * 1000, 1),
+        "p99_ms": round(pct(0.99) * 1000, 1),
+        "errors": errors,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("url")
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--requests", type=int, default=0,
+                    help="stop after N requests (0 = duration only)")
+    ap.add_argument("--proxy", default="",
+                    help="route through this HTTP proxy (daemon proxy test)")
+    args = ap.parse_args()
+    result = asyncio.run(run_stress(
+        args.url, args.concurrency, args.duration,
+        max_requests=args.requests, proxy=args.proxy or None))
+    print(json.dumps(result))
+    return 0 if result["ok"] > 0 and not result["errors"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,7 +4,9 @@ Reference: the manager serves the dragonflyoss/console frontend submodule
 from manager/dist (manager.go New). A full SPA is out of scope for a
 fabric whose operators live in terminals; this single-file console covers
 the same read surface — clusters, schedulers, seed peers, peers, jobs —
-against the REST API with token sign-in, so the inventory item is real
+plus the core operator WRITE workflows (create scheduler clusters,
+trigger preheat jobs, create users and grant/revoke roles) against the
+RBAC-gated REST API with token sign-in, so the inventory item is real
 and usable rather than a submodule pointer.
 """
 
@@ -37,10 +39,32 @@ INDEX_HTML = """<!doctype html>
 </div>
 <div id="main" style="display:none">
   <h2>scheduler clusters</h2><table id="scheduler-clusters"></table>
+  <form onsubmit="return createCluster(this)">
+    <input name="name" placeholder="new cluster name" required>
+    <button>create cluster</button> <span class="err" id="cluster-msg"></span>
+  </form>
   <h2>schedulers</h2><table id="schedulers"></table>
   <h2>seed peers</h2><table id="seed-peers"></table>
   <h2>peers</h2><table id="peers"></table>
   <h2>jobs</h2><table id="jobs"></table>
+  <form onsubmit="return createPreheat(this)">
+    <select name="ptype"><option>file</option><option>image</option></select>
+    <input name="url" placeholder="preheat url" size="40" required>
+    <button>trigger preheat</button> <span class="err" id="job-msg"></span>
+  </form>
+  <h2>users &amp; roles</h2>
+  <form onsubmit="return createUser(this)">
+    <input name="name" placeholder="new user" required>
+    <input name="password" placeholder="password" type="password" required>
+    <button>create user</button> <span class="err" id="user-msg"></span>
+  </form>
+  <form onsubmit="return grantRole(this, event)">
+    <input name="uid" placeholder="user id" size="6" required>
+    <input name="role" placeholder="role" required>
+    <button name="verb" value="grant">grant</button>
+    <button name="verb" value="revoke">revoke</button>
+    <span class="err" id="role-msg"></span>
+  </form>
 </div>
 <script>
 let token = "";
@@ -50,16 +74,61 @@ async function api(path) {
   if (!r.ok) throw new Error(path + ": " + r.status);
   return await r.json();
 }
+async function post(path, body, method) {
+  const r = await fetch("/api/v1/" + path, {
+    method: method || "POST",
+    headers: {Authorization: "Bearer " + token,
+              "Content-Type": "application/json"},
+    body: body === undefined ? undefined : JSON.stringify(body)});
+  if (!r.ok) throw new Error(path + ": " + r.status + " " + await r.text());
+  return r.status === 204 ? {} : await r.json();
+}
+function formAction(msgId, fn) {
+  const el = document.getElementById(msgId);
+  el.textContent = "";
+  fn().then(refresh).catch(e => { el.textContent = e.message; });
+  return false;
+}
+function createCluster(f) {
+  return formAction("cluster-msg",
+      () => post("scheduler-clusters", {name: f.name.value}));
+}
+function createPreheat(f) {
+  return formAction("job-msg", () => post("jobs",
+      {type: "preheat", args: {type: f.ptype.value, url: f.url.value}}));
+}
+function createUser(f) {
+  return formAction("user-msg", () => post("users/signup",
+      {name: f.name.value, password: f.password.value}));
+}
+function grantRole(f, ev) {
+  // event.submitter is the reliable clicked-button source; activeElement
+  // is wrong on Safari and on Enter-key submits — defaulting a REVOKE to
+  // a grant would invert a privileged operation.
+  const verb = ev && ev.submitter ? ev.submitter.value : "grant";
+  const path = "users/" + encodeURIComponent(f.uid.value)
+             + "/roles/" + encodeURIComponent(f.role.value);
+  return formAction("role-msg",
+      () => post(path, undefined, verb === "revoke" ? "DELETE" : "PUT"));
+}
+function esc(v) {
+  // Every rendered value is attacker-influenced once write paths exist
+  // (cluster/user names): escape before the innerHTML sink or a stored
+  // name like <img onerror=...> runs in every signed-in console.
+  return String(v).replace(/[&<>"']/g, c => ({
+    "&": "&amp;", "<": "&lt;", ">": "&gt;",
+    '"': "&quot;", "'": "&#39;"}[c]));
+}
 function render(id, rows, cols) {
   const t = document.getElementById(id);
   if (!rows || !rows.length) { t.innerHTML = "<tr><td>none</td></tr>"; return; }
   cols = cols || Object.keys(rows[0]).filter(
       k => typeof rows[0][k] !== "object").slice(0, 8);
-  t.innerHTML = "<tr>" + cols.map(c => "<th>" + c + "</th>").join("") + "</tr>"
+  t.innerHTML = "<tr>" + cols.map(c => "<th>" + esc(c) + "</th>").join("") + "</tr>"
     + rows.map(r => "<tr>" + cols.map(c => {
         let v = r[c] == null ? "" : r[c];
-        const cls = c === "state" ? ' class="state-' + v + '"' : "";
-        return "<td" + cls + ">" + v + "</td>";
+        const cls = c === "state" ? ' class="state-' + esc(v) + '"' : "";
+        return "<td" + cls + ">" + esc(v) + "</td>";
       }).join("") + "</tr>").join("");
 }
 async function refresh() {

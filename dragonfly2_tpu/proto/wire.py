@@ -1,0 +1,306 @@
+"""The drpc wire contract: every method's request shape, in one module.
+
+Reference: the entire RPC surface of Dragonfly2 is a single versioned
+protobuf module (``d7y.io/api/v2`` — /root/reference/go.mod:6) that every
+role compiles against. This module plays that role for the msgpack drpc
+surface: a declarative schema per method (unary requests, stream opens,
+and client→server stream messages), validated at the SERVER boundary
+(rpc/server.py) so malformed or mistyped bodies fail fast with
+Code.BadRequest instead of surfacing as deep KeyErrors/TypeErrors — the
+class of bug per-handler tests can't exhaustively cover.
+
+Semantics follow protobuf's spirit: unknown fields pass through
+(forward compatibility), missing optional fields take their defaults,
+required fields and type mismatches reject the call. Handlers keep
+reading plain dicts — the schema is enforcement, not a codegen layer.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+__all__ = [
+    "F", "Msg", "SchemaError",
+    "validate_unary", "validate_stream_open", "validate_stream_msg",
+    "UNARY", "STREAM_OPEN", "STREAM_MSGS",
+]
+
+
+class SchemaError(ValueError):
+    """A body failed validation; message names the method+field."""
+
+
+class F:
+    """One field: type, requiredness, optional nested/list schema."""
+
+    __slots__ = ("type", "required", "spec", "item")
+
+    def __init__(self, type_: type | tuple, required: bool = False,
+                 spec: "Msg | None" = None, item: "F | None" = None):
+        self.type = type_
+        self.required = required
+        self.spec = spec      # nested Msg for dict fields
+        self.item = item      # element spec for list fields
+
+
+class Msg:
+    """A message shape: field name → F. Unknown fields are allowed."""
+
+    __slots__ = ("name", "fields")
+
+    def __init__(self, name: str, **fields: F):
+        self.name = name
+        self.fields = fields
+
+    def validate(self, body: Any, where: str) -> None:
+        if body is None:
+            body = {}
+        if not isinstance(body, dict):
+            raise SchemaError(f"{where}: body must be a map, got "
+                              f"{type(body).__name__}")
+        for fname, f in self.fields.items():
+            if fname not in body:
+                if f.required:
+                    raise SchemaError(f"{where}: missing required field "
+                                      f"{fname!r}")
+                continue
+            value = body[fname]
+            if value is None and not f.required:
+                continue
+            self._check(fname, f, value, where)
+
+    def _check(self, fname: str, f: F, value: Any, where: str) -> None:
+        ok = isinstance(value, f.type)
+        # bools are ints in Python; don't let a bool satisfy an int field
+        # unless the field is bool itself.
+        if ok and isinstance(value, bool) and f.type is not bool:
+            types = f.type if isinstance(f.type, tuple) else (f.type,)
+            ok = bool in types
+        # ints satisfy float fields (msgpack preserves the distinction).
+        if not ok and f.type is float and isinstance(value, int):
+            ok = True
+        if not ok:
+            raise SchemaError(
+                f"{where}: field {fname!r} must be "
+                f"{getattr(f.type, '__name__', f.type)}, got "
+                f"{type(value).__name__}")
+        if f.spec is not None and isinstance(value, dict):
+            f.spec.validate(value, f"{where}.{fname}")
+        if f.item is not None and isinstance(value, (list, tuple)):
+            for i, item in enumerate(value):
+                self._check(f"{fname}[{i}]", f.item, item, where)
+
+
+# --------------------------------------------------------------------- #
+# Shared shapes
+# --------------------------------------------------------------------- #
+
+HOST = Msg(
+    "Host",
+    id=F(str), hostname=F(str), ip=F(str), port=F(int), upload_port=F(int),
+    type=F(int), idc=F(str), location=F(str), tpu_slice=F(str),
+    tpu_worker_index=F(int), telemetry=F(dict),
+)
+
+URL_META = Msg(
+    "UrlMeta",
+    digest=F(str), tag=F(str), range=F(str), filter=F(str),
+    header=F(dict), application=F(str), priority=F(int),
+)
+
+PIECE = Msg(
+    "Piece",
+    piece_num=F(int, required=True), range_start=F(int), range_size=F(int),
+    digest=F(str), download_cost_ms=F(int), dst_peer_id=F(str),
+)
+
+_PERSISTENT_COMMON = dict(
+    task_id=F(str, required=True), peer_id=F(str), host=F(dict, spec=HOST),
+)
+
+# --------------------------------------------------------------------- #
+# Unary request schemas, keyed by method
+# --------------------------------------------------------------------- #
+
+UNARY: dict[str, Msg] = {
+    # Scheduler (reference schedulerv2 + persistent-cache family)
+    "Scheduler.AnnounceHost": Msg(
+        "AnnounceHost",
+        id=F(str, required=True), hostname=F(str), ip=F(str), port=F(int),
+        upload_port=F(int), type=F(int), idc=F(str), location=F(str),
+        tpu_slice=F(str), tpu_worker_index=F(int), telemetry=F(dict)),
+    "Scheduler.LeaveHost": Msg("LeaveHost", id=F(str, required=True)),
+    "Scheduler.LeavePeer": Msg("LeavePeer", id=F(str, required=True)),
+    "Scheduler.AnnounceTask": Msg(
+        "AnnounceTask",
+        task_id=F(str, required=True), peer_id=F(str, required=True),
+        url=F(str), tag=F(str), application=F(str),
+        host=F(dict, required=True, spec=HOST),
+        content_length=F(int), piece_size=F(int), total_piece_count=F(int),
+        piece_nums=F(list, item=F(int))),
+    "Scheduler.StatTask": Msg("StatTask", task_id=F(str, required=True)),
+    "Scheduler.StatPeer": Msg("StatPeer", peer_id=F(str, required=True)),
+    "Scheduler.ListHosts": Msg("ListHosts"),
+    "Scheduler.UploadPersistentCacheTaskStarted": Msg(
+        "UploadPersistentCacheTaskStarted",
+        **_PERSISTENT_COMMON,
+        url=F(str), tag=F(str), application=F(str), piece_size=F(int),
+        content_length=F(int), total_piece_count=F(int),
+        replica_count=F(int), ttl=F(float), digest=F(str)),
+    "Scheduler.UploadPersistentCacheTaskFinished": Msg(
+        "UploadPersistentCacheTaskFinished",
+        **_PERSISTENT_COMMON,
+        content_length=F(int), piece_size=F(int), total_piece_count=F(int)),
+    "Scheduler.UploadPersistentCacheTaskFailed": Msg(
+        "UploadPersistentCacheTaskFailed", **_PERSISTENT_COMMON),
+    "Scheduler.StatPersistentCacheTask": Msg(
+        "StatPersistentCacheTask", task_id=F(str, required=True)),
+    "Scheduler.ListPersistentCacheTasks": Msg("ListPersistentCacheTasks"),
+    "Scheduler.DeletePersistentCacheTask": Msg(
+        "DeletePersistentCacheTask", task_id=F(str, required=True)),
+
+    # Daemon download service (unix socket — dfget/dfcache attach)
+    "Daemon.StatTask": Msg("DaemonStatTask", task_id=F(str, required=True)),
+    "Daemon.ImportTask": Msg(
+        "ImportTask",
+        path=F(str, required=True), cache_id=F(str, required=True),
+        tag=F(str), application=F(str), digest=F(str),
+        persistent=F(bool), replica_count=F(int), ttl=F(float)),
+    "Daemon.DeleteTask": Msg("DeleteTask", task_id=F(str, required=True)),
+    "Daemon.Health": Msg("Health"),
+
+    # Peer service (TCP — other daemons + scheduler triggers)
+    "Peer.GetPieceTasks": Msg(
+        "GetPieceTasks", task_id=F(str, required=True)),
+    "Peer.TriggerDownloadTask": Msg(
+        "TriggerDownloadTask",
+        url=F(str, required=True), task_id=F(str), tag=F(str),
+        application=F(str), digest=F(str), header=F(dict),
+        filters=F(list, item=F(str)), seed=F(bool),
+        disable_back_source=F(bool)),
+    "Peer.StatTask": Msg("PeerStatTask", task_id=F(str, required=True)),
+    "Peer.DeleteTask": Msg("PeerDeleteTask", task_id=F(str, required=True)),
+
+    # Manager (reference managerv2)
+    "Manager.GetScheduler": Msg(
+        "GetScheduler", hostname=F(str), ip=F(str),
+        scheduler_cluster_id=F(int)),
+    "Manager.ListSchedulers": Msg(
+        "ListSchedulers", hostname=F(str), ip=F(str), idc=F(str),
+        location=F(str)),
+    "Manager.UpdateScheduler": Msg(
+        "UpdateScheduler",
+        hostname=F(str, required=True), ip=F(str, required=True),
+        scheduler_cluster_id=F(int),   # omitted → seeded default cluster
+        port=F(int), idc=F(str), location=F(str), state=F(str),
+        features=F(list)),
+    "Manager.GetSchedulerClusterConfig": Msg(
+        "GetSchedulerClusterConfig",
+        scheduler_cluster_id=F(int, required=True)),
+    "Manager.ListSeedPeers": Msg(
+        "ListSeedPeers", scheduler_cluster_id=F(int, required=True)),
+    "Manager.UpdateSeedPeer": Msg(
+        "UpdateSeedPeer",
+        hostname=F(str, required=True), ip=F(str, required=True),
+        seed_peer_cluster_id=F(int),   # omitted → seeded default cluster
+        port=F(int), download_port=F(int), object_storage_port=F(int),
+        type=F(str), idc=F(str), location=F(str), state=F(str)),
+    "Manager.DeleteSeedPeer": Msg(
+        "DeleteSeedPeer", hostname=F(str), ip=F(str),
+        seed_peer_cluster_id=F(int)),
+    "Manager.ListApplications": Msg("ListApplications"),
+    "Manager.ListBuckets": Msg("ListBuckets"),
+    "Manager.UpsertPeer": Msg(
+        "UpsertPeer", hostname=F(str), ip=F(str), port=F(int),
+        idc=F(str), location=F(str), state=F(str)),
+    "Manager.PollJob": Msg(
+        "PollJob", queue=F(str, required=True), timeout=F(float)),
+    "Manager.CompleteJob": Msg(
+        "CompleteJob",
+        group_id=F(str, required=True), task_uuid=F(str, required=True),
+        state=F(str), result=F(dict)),
+}
+
+# --------------------------------------------------------------------- #
+# Stream open schemas
+# --------------------------------------------------------------------- #
+
+STREAM_OPEN: dict[str, Msg] = {
+    "Scheduler.AnnouncePeer": Msg(
+        "AnnouncePeerOpen",
+        host=F(dict, required=True, spec=HOST),
+        peer_id=F(str, required=True), task_id=F(str, required=True),
+        url=F(str), tag=F(str), application=F(str), digest=F(str),
+        filters=F(list, item=F(str)), header=F(dict), priority=F(int),
+        range=F(str), is_seed=F(bool), disable_back_source=F(bool)),
+    "Daemon.Download": Msg(
+        "DownloadOpen",
+        url=F(str, required=True), output=F(str),
+        meta=F(dict, spec=URL_META), disable_back_source=F(bool),
+        device=F(str)),
+    "Daemon.ExportTask": Msg(
+        "ExportTaskOpen",
+        cache_id=F(str, required=True), output=F(str, required=True),
+        tag=F(str), application=F(str), digest=F(str)),
+    "Peer.SyncPieceTasks": Msg(
+        "SyncPieceTasksOpen",
+        task_id=F(str, required=True), peer_id=F(str)),
+    "Manager.KeepAlive": Msg(
+        "KeepAliveOpen",
+        source_type=F(str), hostname=F(str), ip=F(str), cluster_id=F(int)),
+}
+
+# --------------------------------------------------------------------- #
+# Client→server stream message schemas, by method and "type" discriminator
+# --------------------------------------------------------------------- #
+
+STREAM_MSGS: dict[str, dict[str, Msg]] = {
+    "Scheduler.AnnouncePeer": {
+        "register": Msg("Register"),
+        "download_started": Msg(
+            "DownloadStarted", content_length=F(int), piece_size=F(int),
+            total_piece_count=F(int)),
+        "piece_finished": Msg(
+            "PieceFinished", piece=F(dict, required=True, spec=PIECE)),
+        "piece_failed": Msg(
+            "PieceFailed", piece_num=F(int), parent_id=F(str),
+            temporary=F(bool)),
+        "reschedule": Msg(
+            "Reschedule", blocklist=F(list, item=F(str)),
+            description=F(str)),
+        "download_finished": Msg(
+            "DownloadFinished", content_length=F(int), piece_size=F(int),
+            total_piece_count=F(int)),
+        "download_failed": Msg("DownloadFailed", reason=F(str)),
+    },
+}
+
+
+# --------------------------------------------------------------------- #
+# Boundary hooks (called by rpc/server.py)
+# --------------------------------------------------------------------- #
+
+def validate_unary(method: str, body: Any) -> None:
+    """Raises SchemaError when ``body`` violates the method's schema.
+    Unknown methods pass (plugins can register methods the core schema
+    does not know — same posture as proto unknown fields)."""
+    schema = UNARY.get(method)
+    if schema is not None:
+        schema.validate(body, method)
+
+
+def validate_stream_open(method: str, body: Any) -> None:
+    schema = STREAM_OPEN.get(method)
+    if schema is not None:
+        schema.validate(body, method)
+
+
+def validate_stream_msg(method: str, body: Any) -> None:
+    """Validate one client→server stream message. Messages without a
+    known discriminator pass (server dispatch already warns)."""
+    kinds = STREAM_MSGS.get(method)
+    if kinds is None or not isinstance(body, dict):
+        return
+    schema = kinds.get(body.get("type", ""))
+    if schema is not None:
+        schema.validate(body, f"{method}/{body.get('type')}")

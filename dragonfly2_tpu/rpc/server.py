@@ -14,6 +14,7 @@ from typing import Any, Awaitable, Callable
 
 from dragonfly2_tpu.pkg import dflog, tracing
 from dragonfly2_tpu.pkg.errors import Code, DfError
+from dragonfly2_tpu.proto import wire
 from dragonfly2_tpu.pkg.types import NetAddr
 from dragonfly2_tpu.rpc.framing import (
     CALL,
@@ -80,7 +81,10 @@ class ServerStream:
         self._inbox.put_nowait(body)
 
     def _on_close(self, error: DfError | None) -> None:
-        self._error = error
+        # First close wins: a later benign CLOSE must not clobber an
+        # already-recorded failure (e.g. a wire-contract breach).
+        if self._error is None:
+            self._error = error
         self._closed_by_peer.set()
 
 
@@ -181,6 +185,16 @@ class Server:
                                   error=DfError(Code.BadRequest, f"unknown stream {frame.method}").to_wire())
                         )
                         continue
+                    # Wire-contract enforcement (proto/wire.py — the
+                    # d7y.io/api analog): malformed opens fail fast here,
+                    # not as deep KeyErrors inside the handler.
+                    try:
+                        wire.validate_stream_open(frame.method, frame.body)
+                    except wire.SchemaError as e:
+                        await fw.write(
+                            Frame(ERR, frame.call_id,
+                                  error=DfError(Code.BadRequest, str(e)).to_wire()))
+                        continue
                     stream = ServerStream(frame.call_id, fw, frame.body)
                     stream.md = frame.md
                     stream.method = frame.method
@@ -193,6 +207,19 @@ class Server:
                 elif frame.type == MSG:
                     s = streams.get(frame.call_id)
                     if s is not None:
+                        try:
+                            wire.validate_stream_msg(s.method or "", frame.body)
+                        except wire.SchemaError as e:
+                            # Contract breach mid-stream: fail the stream
+                            # both ways — the client gets an ERR frame and
+                            # the handler a BadRequest close — and stop
+                            # routing further frames to it.
+                            err = DfError(Code.BadRequest, str(e))
+                            streams.pop(frame.call_id, None)
+                            s._on_close(err)
+                            await fw.write(Frame(ERR, frame.call_id,
+                                                 error=err.to_wire()))
+                            continue
                         s._on_msg(frame.body)
                 elif frame.type in (CLOSE, ERR):
                     s = streams.get(frame.call_id)
@@ -219,10 +246,14 @@ class Server:
             )
             return
         try:
+            wire.validate_unary(frame.method, frame.body)
             with tracing.extract(frame.md, f"rpc.{frame.method}",
                                  peer=ctx.peer_addr):
                 result = await handler(frame.body, ctx)
             await fw.write(Frame(RESULT, frame.call_id, body=result))
+        except wire.SchemaError as e:
+            await fw.write(Frame(ERR, frame.call_id,
+                                 error=DfError(Code.BadRequest, str(e)).to_wire()))
         except DfError as e:
             await fw.write(Frame(ERR, frame.call_id, error=e.to_wire()))
         except asyncio.CancelledError:

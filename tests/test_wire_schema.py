@@ -1,0 +1,159 @@
+"""Wire-contract enforcement at the drpc server boundary.
+
+proto/wire.py plays the role of the reference's d7y.io/api/v2 protobuf
+module: one typed schema per method, validated in rpc/server.py before
+any handler runs. Malformed bodies must fail with Code.BadRequest naming
+the field — not as deep KeyErrors — and unknown fields must pass
+(forward compatibility).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from dragonfly2_tpu.pkg.errors import Code, DfError
+from dragonfly2_tpu.pkg.types import NetAddr
+from dragonfly2_tpu.proto import wire
+from dragonfly2_tpu.rpc import Client, Server
+
+
+class TestSchemas:
+    def test_missing_required_field(self):
+        with pytest.raises(wire.SchemaError, match="task_id"):
+            wire.validate_unary("Scheduler.StatTask", {})
+
+    def test_type_mismatch(self):
+        with pytest.raises(wire.SchemaError, match="task_id"):
+            wire.validate_unary("Scheduler.StatTask", {"task_id": 7})
+
+    def test_bool_does_not_satisfy_int(self):
+        with pytest.raises(wire.SchemaError, match="priority"):
+            wire.validate_stream_open("Scheduler.AnnouncePeer", {
+                "host": {"id": "h"}, "peer_id": "p", "task_id": "t",
+                "priority": True})
+
+    def test_int_satisfies_float(self):
+        wire.validate_unary("Manager.PollJob", {"queue": "q", "timeout": 5})
+
+    def test_nested_message(self):
+        with pytest.raises(wire.SchemaError, match="host.*port|port"):
+            wire.validate_stream_open("Scheduler.AnnouncePeer", {
+                "host": {"id": "h", "port": "not-a-port"},
+                "peer_id": "p", "task_id": "t"})
+
+    def test_unknown_fields_pass(self):
+        wire.validate_unary("Scheduler.StatTask",
+                            {"task_id": "t", "future_field": {"x": 1}})
+
+    def test_unknown_method_passes(self):
+        wire.validate_unary("Plugin.CustomMethod", {"anything": object()})
+
+    def test_list_item_type(self):
+        with pytest.raises(wire.SchemaError, match="blocklist"):
+            wire.validate_stream_msg("Scheduler.AnnouncePeer", {
+                "type": "reschedule", "blocklist": ["ok", 42]})
+
+    def test_every_registered_schema_accepts_empty_optional(self):
+        # Optional-only messages validate {} (no accidental requireds).
+        for method, msg in wire.UNARY.items():
+            required = [n for n, f in msg.fields.items() if f.required]
+            body = {}
+            for n in required:
+                f = msg.fields[n]
+                body[n] = ({} if f.type is dict else
+                           [] if f.type is list else
+                           0 if f.type in (int, float) else "x")
+            wire.validate_unary(method, body)
+
+
+class TestServerBoundary:
+    def test_unary_bad_body_rejected(self, run_async):
+        async def body():
+            server = Server("test")
+
+            async def handler(b, ctx):  # must never run
+                raise AssertionError("handler ran on invalid body")
+
+            server.register_unary("Scheduler.StatTask", handler)
+            await server.serve(NetAddr.tcp("127.0.0.1", 0))
+            cli = Client(NetAddr.tcp("127.0.0.1", server.port()))
+            try:
+                with pytest.raises(DfError) as ei:
+                    await cli.call("Scheduler.StatTask", {"task_id": 123})
+                assert ei.value.code == Code.BadRequest
+                assert "task_id" in str(ei.value)
+            finally:
+                await cli.close()
+                await server.close()
+
+        run_async(body())
+
+    def test_stream_bad_open_rejected(self, run_async):
+        async def body():
+            server = Server("test")
+
+            async def handler(stream, ctx):
+                raise AssertionError("handler ran on invalid open")
+
+            server.register_stream("Scheduler.AnnouncePeer", handler)
+            await server.serve(NetAddr.tcp("127.0.0.1", 0))
+            cli = Client(NetAddr.tcp("127.0.0.1", server.port()))
+            try:
+                stream = await cli.open_stream(
+                    "Scheduler.AnnouncePeer", {"peer_id": "p"})  # no task_id
+                with pytest.raises(DfError) as ei:
+                    await stream.recv(timeout=10)
+                assert ei.value.code == Code.BadRequest
+            finally:
+                await cli.close()
+                await server.close()
+
+        run_async(body())
+
+    def test_stream_bad_msg_fails_stream(self, run_async):
+        """A contract breach mid-stream fails the stream BOTH ways: the
+        handler's recv raises BadRequest (a later benign close must not
+        clobber it) and the client receives an ERR frame — a handler must
+        never record success off a stream that dropped messages."""
+        async def body():
+            import asyncio
+
+            server = Server("test")
+            got: list = []
+            handler_error: list = []
+
+            async def handler(stream, ctx):
+                try:
+                    while True:
+                        msg = await stream.recv()
+                        if msg is None:
+                            return
+                        got.append(msg)
+                except DfError as e:
+                    handler_error.append(e)
+
+            server.register_stream("Scheduler.AnnouncePeer", handler)
+            await server.serve(NetAddr.tcp("127.0.0.1", 0))
+            cli = Client(NetAddr.tcp("127.0.0.1", server.port()))
+            try:
+                stream = await cli.open_stream(
+                    "Scheduler.AnnouncePeer",
+                    {"host": {"id": "h"}, "peer_id": "p", "task_id": "t"})
+                await stream.send({"type": "register"})
+                # piece_finished without the required piece map.
+                await stream.send({"type": "piece_finished"})
+                # A later valid message + close must not mask the breach.
+                await stream.send({"type": "download_finished"})
+                with pytest.raises(DfError) as ei:
+                    while True:
+                        if await stream.recv(timeout=10) is None:
+                            break
+                assert ei.value.code == Code.BadRequest
+                await asyncio.sleep(0.2)
+                assert got == [{"type": "register"}]
+                assert handler_error and handler_error[0].code == Code.BadRequest
+            finally:
+                await cli.close()
+                await server.close()
+
+        run_async(body())
