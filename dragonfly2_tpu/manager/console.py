@@ -103,9 +103,15 @@ function createUser(f) {
 }
 function grantRole(f, ev) {
   // event.submitter is the reliable clicked-button source; activeElement
-  // is wrong on Safari and on Enter-key submits — defaulting a REVOKE to
-  // a grant would invert a privileged operation.
-  const verb = ev && ev.submitter ? ev.submitter.value : "grant";
+  // is wrong on Safari and on Enter-key submits. With no submitter info
+  // ABORT — silently defaulting would risk inverting a privileged
+  // revoke into a grant.
+  if (!ev || !ev.submitter || !ev.submitter.value) {
+    document.getElementById("role-msg").textContent =
+        "use the grant/revoke buttons";
+    return false;
+  }
+  const verb = ev.submitter.value;
   const path = "users/" + encodeURIComponent(f.uid.value)
              + "/roles/" + encodeURIComponent(f.role.value);
   return formAction("role-msg",

@@ -149,6 +149,13 @@ def _assemble_checksum_jit(batches: tuple, plan: tuple, piece_words: int):
     return flat, sums, xors
 
 
+@jax.jit
+def _merge_jit(arrs: tuple):
+    """Consolidate equal-shaped staged batches into one superbatch (all
+    groups are _MERGE_GROUP × (batch_pieces, piece_words): one compile)."""
+    return jnp.concatenate(list(arrs), axis=0)
+
+
 @functools.partial(jax.jit, static_argnames=("piece_words",))
 def _gather_checksum_jit(batches: tuple, perm, piece_words: int):
     """Fragmented-arrival fallback: stack the staged batches, reorder the
@@ -218,6 +225,14 @@ class HBMSink:
         if len(self._pending) >= self.batch_pieces:
             self.flush()
 
+    # Every _MERGE_GROUP full batches consolidate into one superbatch
+    # (single fixed-shape concat jit, compiled once): a 70B-scale task is
+    # ~1200 staged batches, and assembling over 1200 concat operands
+    # costs minutes of XLA compile — consolidation bounds the operand
+    # count at ~_MERGE_GROUP + B/_MERGE_GROUP for one extra read+write
+    # of the content (device-side, ~free next to the transport).
+    _MERGE_GROUP = 32
+
     def flush(self) -> None:
         """Move pending pieces to device as one batch. Pure staging — the
         single assembly dispatch checksums everything later (a tunneled
@@ -237,8 +252,28 @@ class HBMSink:
         self._batches.append((slots, batch))
         for i, n in enumerate(slots):
             self._slot_to_batch[int(n)] = (bi, i)
+        self._maybe_consolidate()
         self._assembled = None
         self._dev_sums = self._dev_xors = None
+
+    def _maybe_consolidate(self) -> None:
+        """Merge the trailing _MERGE_GROUP equal-shaped batches into one
+        superbatch. Only ever merges ORIGINAL full batches (all shapes
+        (batch_pieces, piece_words)), so the concat jit compiles once."""
+        group = self._MERGE_GROUP
+        if len(self._batches) < group:
+            return
+        tail = self._batches[-group:]
+        if any(arr.shape[0] != self.batch_pieces for _, arr in tail):
+            return  # irregular flush in the window: leave as-is
+        merged_arr = _merge_jit(tuple(arr for _, arr in tail))
+        merged_slots = np.concatenate([s for s, _ in tail])
+        self._batches = self._batches[:-group] + [(merged_slots, merged_arr)]
+        # Rebuild the slot map (indices after the merge point shifted).
+        self._slot_to_batch = {
+            int(n): (bi, i)
+            for bi, (slots, _) in enumerate(self._batches)
+            for i, n in enumerate(slots)}
 
     def complete(self) -> bool:
         return len(self.landed) >= self.total_pieces

@@ -76,8 +76,10 @@ class Msg:
         if ok and isinstance(value, bool) and f.type is not bool:
             types = f.type if isinstance(f.type, tuple) else (f.type,)
             ok = bool in types
-        # ints satisfy float fields (msgpack preserves the distinction).
-        if not ok and f.type is float and isinstance(value, int):
+        # ints satisfy float fields (msgpack preserves the distinction) —
+        # but bools, despite being ints, satisfy neither.
+        if (not ok and f.type is float and isinstance(value, int)
+                and not isinstance(value, bool)):
             ok = True
         if not ok:
             raise SchemaError(
@@ -296,11 +298,16 @@ def validate_stream_open(method: str, body: Any) -> None:
 
 
 def validate_stream_msg(method: str, body: Any) -> None:
-    """Validate one client→server stream message. Messages without a
-    known discriminator pass (server dispatch already warns)."""
+    """Validate one client→server stream message. Messages with an
+    unknown discriminator pass (server dispatch already warns), but on a
+    schema'd method the body must at least be a map — a raw scalar would
+    otherwise surface as an AttributeError deep in the handler."""
     kinds = STREAM_MSGS.get(method)
-    if kinds is None or not isinstance(body, dict):
+    if kinds is None:
         return
+    if not isinstance(body, dict):
+        raise SchemaError(f"{method}: stream message must be a map, got "
+                          f"{type(body).__name__}")
     schema = kinds.get(body.get("type", ""))
     if schema is not None:
         schema.validate(body, f"{method}/{body.get('type')}")

@@ -265,3 +265,22 @@ def test_hbm_sink_gather_path_with_missing_pieces():
             assert got == content[n * piece:(n + 1) * piece], n
         else:
             assert got == b"\x00" * piece, n
+
+
+def test_hbm_sink_consolidates_batches_at_scale():
+    """Checkpoint-scale staging (many batches) consolidates into
+    superbatches so assembly never compiles a 1000-operand concat —
+    content and verification stay exact."""
+    rng = np.random.RandomState(11)
+    piece = 1024
+    n_batches = 80            # > 2 merge groups of 32
+    total_pieces = n_batches * 4
+    content = rng.bytes(piece * total_pieces - 77)   # tail piece
+    sink = HBMSink(len(content), piece, batch_pieces=4)
+    for n in range(total_pieces):
+        sink.land_piece(n, content[n * piece:(n + 1) * piece])
+    # 2 supers (64 batches) + 16 recent fulls.
+    assert len(sink._batches) <= 2 + 16
+    assert sink.complete()
+    assert sink.verify()
+    assert np.asarray(sink.as_bytes_array()).tobytes() == content

@@ -157,3 +157,17 @@ class TestServerBoundary:
                 await server.close()
 
         run_async(body())
+
+
+class TestReviewRegressions:
+    def test_bool_does_not_satisfy_float(self):
+        with pytest.raises(wire.SchemaError, match="timeout"):
+            wire.validate_unary("Manager.PollJob",
+                                {"queue": "q", "timeout": True})
+
+    def test_non_map_stream_msg_rejected(self):
+        with pytest.raises(wire.SchemaError, match="must be a map"):
+            wire.validate_stream_msg("Scheduler.AnnouncePeer", "x")
+
+    def test_non_map_on_unschemad_method_passes(self):
+        wire.validate_stream_msg("Plugin.CustomStream", "anything")
