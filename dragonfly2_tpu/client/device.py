@@ -45,6 +45,16 @@ class DeviceResult:
     def shard_to_mesh(self, mesh, axis_name: str = "d"):
         return self.sink.shard_to_mesh(mesh, axis_name)
 
+    def load_safetensors(self, *, names: list[str] | None = None,
+                         shardings: dict | None = None):
+        """The landed content as named checkpoint tensors (the content
+        must be a safetensors file): bitcast views of the HBM buffer,
+        optionally device_put to per-tensor shardings."""
+        from dragonfly2_tpu.ops import safetensors as st
+
+        return st.load_from_sink(self.sink, names=names,
+                                 shardings=shardings)
+
 
 async def download_to_device(daemon, url: str, *, digest: str = "",
                              tag: str = "", application: str = "",

@@ -1,0 +1,141 @@
+"""Zero-extra-copy safetensors views over the device sink's landed bytes.
+
+The north-star payload is a sharded safetensors checkpoint
+(BASELINE.json: Llama-3-70B to every host). Once the P2P fabric lands the
+file in HBM (ops/hbm_sink.py), this module turns it into named tensors
+WITHOUT a host round trip: the 8-byte header length and the JSON header
+are fetched to host (tiny), and each tensor is a bitcast slice of the
+device-resident byte buffer.
+
+Format (https://github.com/huggingface/safetensors — stable, public):
+  [u64 little-endian header_len][header_len bytes of JSON][tensor data]
+  header: {"tensor.name": {"dtype": "BF16", "shape": [..],
+                           "data_offsets": [begin, end]}, ...}
+  offsets are relative to the end of the header.
+
+No reference analog: Dragonfly2 moves opaque bytes; the TPU build knows
+what a checkpoint is.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_DTYPES = {
+    "F64": jnp.float64, "F32": jnp.float32, "F16": jnp.float16,
+    "BF16": jnp.bfloat16, "I64": jnp.int64, "I32": jnp.int32,
+    "I16": jnp.int16, "I8": jnp.int8, "U8": jnp.uint8, "BOOL": jnp.bool_,
+    "U16": jnp.uint16, "U32": jnp.uint32, "U64": jnp.uint64,
+}
+
+
+class SafetensorsError(ValueError):
+    pass
+
+
+def parse_header(head: bytes) -> tuple[dict, int]:
+    """(header dict, data_start_offset) from the file's first bytes."""
+    if len(head) < 8:
+        raise SafetensorsError("file shorter than the length prefix")
+    n = int.from_bytes(head[:8], "little")
+    if n > len(head) - 8:
+        raise SafetensorsError(
+            f"header ({n} bytes) longer than provided prefix")
+    try:
+        header = json.loads(head[8:8 + n])
+    except json.JSONDecodeError as e:
+        raise SafetensorsError(f"bad header JSON: {e}") from e
+    return header, 8 + n
+
+
+def tensor_views(u8: jax.Array, header: dict, data_start: int,
+                 names: list[str] | None = None) -> dict[str, jax.Array]:
+    """Named device tensors as bitcast slices of the landed u8 buffer.
+    Slices fuse into the consuming computation — no materialized copy
+    until a tensor is actually used (or device_put to a sharding)."""
+    out: dict[str, jax.Array] = {}
+    total = int(u8.shape[0])
+    for name, meta in header.items():
+        if name == "__metadata__":
+            continue
+        if names is not None and name not in names:
+            continue
+        dtype = _DTYPES.get(meta.get("dtype", ""))
+        if dtype is None:
+            raise SafetensorsError(
+                f"{name}: unsupported dtype {meta.get('dtype')!r}")
+        shape = tuple(meta["shape"])
+        begin, end = meta["data_offsets"]
+        itemsize = np.dtype(dtype).itemsize    # FILE item size
+        count = int(np.prod(shape)) if shape else 1
+        if end - begin != count * itemsize:
+            raise SafetensorsError(
+                f"{name}: data span {end - begin} != "
+                f"{count}x{itemsize} for shape {shape}")
+        # Bounds: jax slicing CLAMPS, so an out-of-range (or negative)
+        # offset would otherwise read wrong bytes or fail opaquely.
+        if begin < 0 or data_start + end > total:
+            raise SafetensorsError(
+                f"{name}: data_offsets [{begin}, {end}] outside content "
+                f"({total - data_start} data bytes)")
+        raw = u8[data_start + begin: data_start + end]
+        canon = jax.dtypes.canonicalize_dtype(dtype)
+        if np.dtype(canon) == np.bool_:
+            # bitcast_convert_type refuses bool targets; BOOL is one
+            # byte of 0/1 — compare instead.
+            t = (raw != 0)
+        elif canon.itemsize != itemsize:
+            # jax x64 disabled: 64-bit dtypes canonicalize to 32-bit.
+            # Keeping the low word is exact for the integer counters/id
+            # arrays 64-bit entries usually hold, but float64 low words
+            # are mantissa garbage — refuse rather than corrupt.
+            if meta["dtype"] == "F64":
+                raise SafetensorsError(
+                    f"{name}: F64 requires jax x64 mode "
+                    "(jax.config.update('jax_enable_x64', True))")
+            t = jax.lax.bitcast_convert_type(
+                raw.reshape(count, itemsize // canon.itemsize,
+                            canon.itemsize), canon)[:, 0]
+        elif itemsize == 1:
+            t = jax.lax.bitcast_convert_type(raw, dtype)
+        else:
+            t = jax.lax.bitcast_convert_type(
+                raw.reshape(count, itemsize), dtype)
+        out[name] = t.reshape(shape)
+    if names is not None:
+        missing = [n for n in names if n not in out]
+        if missing:
+            raise SafetensorsError(
+                f"tensors not in checkpoint: {missing}")
+    return out
+
+
+def load_from_sink(sink, *, names: list[str] | None = None,
+                   shardings: dict | None = None) -> dict[str, jax.Array]:
+    """Named tensors from a completed, verified HBM sink. ``shardings``
+    maps tensor name → jax.sharding.Sharding; matching tensors are
+    device_put to their sharding (device-to-device over ICI on a slice),
+    the rest stay on the sink's device."""
+    u8 = sink.as_bytes_array()
+    # Header prefix to host: 8 bytes, then exactly the header. Two tiny
+    # fetches instead of guessing a prefix size.
+    n = int.from_bytes(np.asarray(u8[:8]).tobytes(), "little")
+    if 8 + n > u8.shape[0]:
+        raise SafetensorsError("header length exceeds content")
+    head = np.asarray(u8[: 8 + n]).tobytes()
+    header, data_start = parse_header(head)
+    tensors = tensor_views(u8, header, data_start, names)
+    if shardings:
+        unknown = [n for n in shardings if n not in tensors]
+        if unknown:
+            # A typo'd sharding would silently leave the tensor the
+            # caller believes is mesh-sharded on a single device.
+            raise SafetensorsError(
+                f"shardings reference tensors not loaded: {unknown}")
+        for name, sharding in shardings.items():
+            tensors[name] = jax.device_put(tensors[name], sharding)
+    return tensors
