@@ -54,8 +54,9 @@ log = dflog.get("ops.hbm_sink")
 
 # ---------------------------------------------------------------------- #
 # Fused scatter+checksum op (kept for single-dispatch batch landing into
-# an existing flat buffer — bench comparisons, __graft_entry__, and
-# callers that need in-place semantics; see ops/checksum.py kernels).
+# an existing flat buffer — kernel comparisons and callers that need
+# in-place semantics; the production sink and __graft_entry__ use the
+# assemble+checksum path below; see ops/checksum.py kernels).
 # ---------------------------------------------------------------------- #
 
 @functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("piece_words",))

@@ -59,17 +59,36 @@ def tensor_views(u8: jax.Array, header: dict, data_start: int,
     until a tensor is actually used (or device_put to a sharding)."""
     out: dict[str, jax.Array] = {}
     total = int(u8.shape[0])
+    if not isinstance(header, dict):
+        raise SafetensorsError(
+            f"header must be a JSON object, got {type(header).__name__}")
     for name, meta in header.items():
         if name == "__metadata__":
             continue
         if names is not None and name not in names:
             continue
+        # Structural validation first: this parses UNTRUSTED downloaded
+        # bytes, and every malformation must surface as SafetensorsError,
+        # not a raw KeyError/TypeError deep in jax.
+        if not isinstance(meta, dict):
+            raise SafetensorsError(f"{name}: entry must be an object")
         dtype = _DTYPES.get(meta.get("dtype", ""))
         if dtype is None:
             raise SafetensorsError(
                 f"{name}: unsupported dtype {meta.get('dtype')!r}")
-        shape = tuple(meta["shape"])
-        begin, end = meta["data_offsets"]
+        shape_raw = meta.get("shape")
+        offsets = meta.get("data_offsets")
+        if (not isinstance(shape_raw, list)
+                or not all(isinstance(d, int) and not isinstance(d, bool)
+                           and d >= 0 for d in shape_raw)):
+            raise SafetensorsError(f"{name}: bad shape {shape_raw!r}")
+        if (not isinstance(offsets, list) or len(offsets) != 2
+                or not all(isinstance(o, int) and not isinstance(o, bool)
+                           for o in offsets)):
+            raise SafetensorsError(
+                f"{name}: bad data_offsets {offsets!r}")
+        shape = tuple(shape_raw)
+        begin, end = offsets
         itemsize = np.dtype(dtype).itemsize    # FILE item size
         count = int(np.prod(shape)) if shape else 1
         if end - begin != count * itemsize:
@@ -90,16 +109,27 @@ def tensor_views(u8: jax.Array, header: dict, data_start: int,
             t = (raw != 0)
         elif canon.itemsize != itemsize:
             # jax x64 disabled: 64-bit dtypes canonicalize to 32-bit.
-            # Keeping the low word is exact for the integer counters/id
-            # arrays 64-bit entries usually hold, but float64 low words
-            # are mantissa garbage — refuse rather than corrupt.
+            # Keeping the low word is exact only when the high word is
+            # the sign/zero extension — float64 low words are mantissa
+            # garbage (refuse), and integer values beyond 32 bits are
+            # checked on device rather than silently truncated.
             if meta["dtype"] == "F64":
                 raise SafetensorsError(
                     f"{name}: F64 requires jax x64 mode "
                     "(jax.config.update('jax_enable_x64', True))")
-            t = jax.lax.bitcast_convert_type(
+            pair = jax.lax.bitcast_convert_type(
                 raw.reshape(count, itemsize // canon.itemsize,
-                            canon.itemsize), canon)[:, 0]
+                            canon.itemsize), canon)
+            t = pair[:, 0]
+            hi = pair[:, 1]
+            signed = np.issubdtype(np.dtype(canon), np.signedinteger)
+            expect_hi = (jnp.where(t < 0, jnp.asarray(-1, canon),
+                                   jnp.asarray(0, canon))
+                         if signed else jnp.zeros_like(hi))
+            if bool(jnp.any(hi != expect_hi)):
+                raise SafetensorsError(
+                    f"{name}: {meta['dtype']} values exceed 32 bits; "
+                    "enable jax x64 mode to load exactly")
         elif itemsize == 1:
             t = jax.lax.bitcast_convert_type(raw, dtype)
         else:

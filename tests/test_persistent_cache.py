@@ -24,7 +24,7 @@ from dragonfly2_tpu.scheduler.server import SchedulerServer
 from tests.test_p2p_e2e import start_daemon
 
 
-async def _wait(predicate, timeout: float = 15.0):
+async def _wait(predicate, timeout: float = 40.0):
     deadline = asyncio.get_running_loop().time() + timeout
     while asyncio.get_running_loop().time() < deadline:
         if predicate():

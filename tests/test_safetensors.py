@@ -208,3 +208,33 @@ class TestReviewRegressions:
         sink = _land(content, piece=256)
         with pytest.raises(st.SafetensorsError, match="not loaded"):
             st.load_from_sink(sink, shardings={"w_typo": None})
+
+    def test_structurally_malformed_headers_raise_schema_error(self):
+        cases = [
+            b"[1, 2]",                                       # header not object
+            b'{"t": "not-an-object"}',                       # entry not object
+            b'{"t": {"dtype": "F32", "data_offsets": [0, 4]}}',   # no shape
+            b'{"t": {"dtype": "F32", "shape": "x", "data_offsets": [0, 4]}}',
+            b'{"t": {"dtype": "F32", "shape": [1], "data_offsets": [0.0, 4]}}',
+            b'{"t": {"dtype": "F32", "shape": [-1], "data_offsets": [0, 4]}}',
+        ]
+        for hj in cases:
+            content = struct.pack("<Q", len(hj)) + hj + b"\x00" * 64
+            sink = _land(content, piece=256)
+            with pytest.raises(st.SafetensorsError):
+                st.load_from_sink(sink)
+
+    def test_i64_beyond_32_bits_refused(self):
+        arr = np.array([(1 << 40) + 7], dtype=np.int64)
+        content = make_safetensors({"big": arr}, {"big": "I64"})
+        sink = _land(content, piece=256)
+        with pytest.raises(st.SafetensorsError, match="exceed 32 bits"):
+            st.load_from_sink(sink)
+
+    def test_i64_negative_within_32_bits_exact(self):
+        arr = np.array([-5, 7, -1], dtype=np.int64)
+        content = make_safetensors({"ids": arr}, {"ids": "I64"})
+        sink = _land(content, piece=256)
+        loaded = st.load_from_sink(sink)
+        np.testing.assert_array_equal(
+            np.asarray(loaded["ids"]), arr.astype(np.int32))

@@ -151,12 +151,14 @@ def test_graft_entry_single_chip():
     import __graft_entry__
 
     fn, args = __graft_entry__.entry()
-    buffer, sums, xors = fn(*args)
-    assert buffer.shape[0] == 8 * 1024
+    flat, sums, xors = fn(*args)
+    all_pieces = np.concatenate([np.asarray(b) for b in args])
+    assert flat.shape[0] == all_pieces.size
+    np.testing.assert_array_equal(np.asarray(flat),
+                                  all_pieces.reshape(-1))
     # Checksums must match the host reference for each landed piece.
-    pieces = np.asarray(args[1])
-    for i in range(pieces.shape[0]):
-        want_s, want_x = checksum_numpy(pieces[i].tobytes())
+    for i in range(all_pieces.shape[0]):
+        want_s, want_x = checksum_numpy(all_pieces[i].tobytes())
         assert int(sums[i]) == want_s
         assert int(xors[i]) == want_x
 
