@@ -3,19 +3,151 @@
 Reference: client/daemon/peer/piece_downloader.go — DownloadPiece (:165),
 buildDownloadPieceHTTPRequest (:204): GET
 http://{parent}/download/{taskPrefix}/{taskID}?peerId=...&pieceNum=N.
+
+Fast path: when the native engine (native/src/dfhttp.cc) is available and
+the parent-advertised digest is crc32c, piece bodies flow socket→crc32c→
+pwrite inside one GIL-free native call — no Python byte handling, no
+event-loop copies. The aiohttp path remains for everything else and as the
+fallback (mirrors how the reference keeps its data plane fully native).
 """
 
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
+import functools
+import os
 import time
 
 import aiohttp
 
 from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg import digest as pkgdigest
 from dragonfly2_tpu.pkg.errors import Code, DfError
+from dragonfly2_tpu.storage.local_store import _native
 
 log = dflog.get("peer.piece_downloader")
+
+_NATIVE_EXECUTOR: concurrent.futures.ThreadPoolExecutor | None = None
+
+
+def _native_executor() -> concurrent.futures.ThreadPoolExecutor:
+    """Dedicated pool for blocking native-engine calls. MUST NOT be the
+    loop's default executor: a native fetch blocks its thread on recv until
+    the peer's upload server responds, and that server (aiohttp
+    FileResponse) needs a default-executor slot to open/stat the file —
+    sharing one small pool deadlocks them (piece fetches hold every slot,
+    the server can't serve, fetches time out). Threads here spend their
+    life in GIL-free recv/pwrite, so a generous cap costs ~nothing."""
+    global _NATIVE_EXECUTOR
+    if _NATIVE_EXECUTOR is None:
+        _NATIVE_EXECUTOR = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(32, (os.cpu_count() or 1) * 4),
+            thread_name_prefix="dfnative-io")
+    return _NATIVE_EXECUTOR
+
+
+def run_native(fn, *args) -> asyncio.Future:
+    """Schedule a blocking native call on the dedicated executor."""
+    loop = asyncio.get_running_loop()
+    return loop.run_in_executor(_native_executor(),
+                                functools.partial(fn, *args))
+
+
+async def abandonable_native_call(fn, *args, on_abandon=None):
+    """Run a blocking native call in a worker thread; if this coroutine is
+    cancelled mid-call, the thread cannot be interrupted (SO_RCVTIMEO bounds
+    it), so `on_abandon` is deferred to its completion — the caller hands
+    over cleanup of any resources (connection handle, dup'd fd) the thread
+    is still using."""
+    fut = asyncio.ensure_future(run_native(fn, *args))
+    try:
+        return await asyncio.shield(fut)
+    except asyncio.CancelledError:
+        if on_abandon is not None:
+            def _done(f: asyncio.Future) -> None:
+                if not f.cancelled():
+                    f.exception()  # consume: abandoned errors are expected
+                on_abandon()
+
+            fut.add_done_callback(_done)
+        raise
+
+
+async def native_connect(nb, host: str, port: int, timeout_ms: int) -> int:
+    """Cancel-safe fresh connect: if the caller is cancelled while the
+    executor thread is still connecting, the handle the thread creates
+    would otherwise be orphaned in the native table — a done callback
+    closes it."""
+    fut = asyncio.ensure_future(
+        run_native(nb.http_connect, host, port, timeout_ms))
+    try:
+        return await asyncio.shield(fut)
+    except asyncio.CancelledError:
+        def _done(f: asyncio.Future) -> None:
+            if not f.cancelled() and f.exception() is None:
+                nb.http_close(f.result())
+            elif not f.cancelled():
+                f.exception()  # consume
+        fut.add_done_callback(_done)
+        raise
+
+
+class NativeConnPool:
+    """Keep-alive pool over native HTTP connections, keyed by (host, port).
+    Event-loop-confined: list ops are synchronous; only the blocking connect
+    runs in a worker thread (after the free list came up empty). Parked
+    handles expire after IDLE_TTL_S so connections to parents that left the
+    swarm don't leak fds until shutdown — expiry is swept on every release."""
+
+    MAX_FREE_PER_HOST = 8
+    IDLE_TTL_S = 60.0
+
+    def __init__(self, timeout_ms: int = 30000):
+        self._timeout_ms = timeout_ms
+        self._free: dict[tuple[str, int], list[tuple[int, float]]] = {}
+
+    async def acquire(self, nb, host: str, port: int) -> tuple[int, bool]:
+        """Returns (handle, from_pool). from_pool=True means the connection
+        is a reused keep-alive — callers should retry a transport failure
+        once on a fresh connection before blaming the parent (the server
+        may have idle-closed it between the liveness probe and the send)."""
+        free = self._free.get((host, port))
+        while free:
+            h, _parked = free.pop()
+            if nb.http_reusable(h):
+                return h, True
+            nb.http_close(h)
+        return await native_connect(nb, host, port, self._timeout_ms), False
+
+    def release(self, nb, host: str, port: int, h: int, reusable: bool) -> None:
+        self._sweep_idle(nb)
+        if reusable and nb.http_reusable(h):
+            free = self._free.setdefault((host, port), [])
+            if len(free) < self.MAX_FREE_PER_HOST:
+                free.append((h, time.monotonic()))
+                return
+        nb.http_close(h)
+
+    def _sweep_idle(self, nb) -> None:
+        cutoff = time.monotonic() - self.IDLE_TTL_S
+        for key in list(self._free):
+            kept = []
+            for h, parked in self._free[key]:
+                if parked < cutoff:
+                    nb.http_close(h)
+                else:
+                    kept.append((h, parked))
+            if kept:
+                self._free[key] = kept
+            else:
+                del self._free[key]
+
+    def close_all(self, nb) -> None:
+        for free in self._free.values():
+            for h, _parked in free:
+                nb.http_close(h)
+        self._free.clear()
 
 
 class PieceDownloader:
@@ -23,6 +155,7 @@ class PieceDownloader:
         self._timeout = timeout
         self._session: aiohttp.ClientSession | None = None
         self._session_loop = None
+        self._pool = NativeConnPool(int(timeout * 1000))
 
     async def _sess(self) -> aiohttp.ClientSession:
         loop = asyncio.get_running_loop()
@@ -66,9 +199,107 @@ class PieceDownloader:
         cost_ms = int((time.monotonic() - start) * 1000)
         return data, cost_ms
 
+    async def download_piece_to_store(self, parent_ip: str,
+                                      parent_upload_port: int, task_id: str,
+                                      piece_num: int, store, *,
+                                      src_peer_id: str = "",
+                                      expected_size: int,
+                                      expected_digest: str = "") -> "object | None":
+        """Native fast path: land the piece straight into the store's data
+        file (socket→crc32c→pwrite, GIL-free) and commit its record.
+        Returns the PieceRecord, or None when this piece is ineligible (no
+        native engine, unknown size, non-crc32c digest) and the caller must
+        use the aiohttp + write_piece path. Registration only happens after
+        the crc check, so a bad body leaves no visible trace."""
+        nb = _native()
+        piece_size = store.metadata.piece_size
+        if (nb is None or expected_size < 0 or piece_size <= 0
+                or expected_size > piece_size or store.has_piece(piece_num)):
+            return None
+        want_crc = -1
+        if expected_digest:
+            d = pkgdigest.parse(expected_digest)
+            if d.algorithm != pkgdigest.ALGORITHM_CRC32C:
+                return None
+            try:
+                want_crc = int(d.encoded, 16)
+            except ValueError:
+                # Malformed parent-advertised digest can never match any
+                # body: the same per-piece failure the in-memory path's
+                # hex-string comparison produces, without fetching first.
+                raise DfError(Code.ClientPieceDownloadFail,
+                              f"piece {piece_num}: malformed digest {expected_digest!r}")
+
+        head = (
+            f"GET /download/{task_id[:3]}/{task_id}"
+            f"?peerId={src_peer_id}&pieceNum={piece_num} HTTP/1.1\r\n"
+            f"Host: {parent_ip}:{parent_upload_port}\r\n"
+            "Accept-Encoding: identity\r\nConnection: keep-alive\r\n\r\n"
+        ).encode("latin-1")
+        start = time.monotonic()
+        while True:
+            try:
+                h, from_pool = await self._pool.acquire(
+                    nb, parent_ip, parent_upload_port)
+            except nb.NativeHttpError as e:
+                raise DfError(Code.ClientPieceRequestFail,
+                              f"piece {piece_num} from {parent_ip}:{parent_upload_port}: {e}")
+            dup_fd = os.dup(store.data_fd())
+
+            def abandon(h=h, dup_fd=dup_fd) -> None:
+                nb.http_close(h)
+                os.close(dup_fd)
+
+            try:
+                status, n, crc, keep = await abandonable_native_call(
+                    nb.http_fetch_to_file, h, head, dup_fd,
+                    piece_num * piece_size, expected_size, on_abandon=abandon)
+            except asyncio.CancelledError:
+                raise  # abandon() deferred to the worker thread's completion
+            except nb.NativeHttpError as e:
+                abandon()
+                if from_pool:
+                    # Stale keep-alive (server idle-closed between the
+                    # liveness probe and the send): the GET is idempotent
+                    # and nothing was recorded — retry on a fresh/next
+                    # connection instead of blaming a healthy parent. The
+                    # pool drains closed handles, so this terminates.
+                    continue
+                if e.code == nb.HTTP_E_LENMISMATCH:
+                    # Wrong-size body is a per-piece data failure (matches
+                    # the aiohttp path), not grounds to evict the parent.
+                    raise DfError(Code.ClientPieceDownloadFail,
+                                  f"piece {piece_num} from {parent_ip}:{parent_upload_port}: {e}")
+                raise DfError(Code.ClientPieceRequestFail,
+                              f"piece {piece_num} from {parent_ip}:{parent_upload_port}: {e}")
+            os.close(dup_fd)
+            self._pool.release(nb, parent_ip, parent_upload_port, h, keep)
+            break
+        if status == 404:
+            raise DfError(Code.ClientPieceNotFound,
+                          f"parent {parent_ip}:{parent_upload_port} lacks piece {piece_num}")
+        if status == 429:
+            raise DfError(Code.ClientRequestLimitFail,
+                          f"parent {parent_ip}:{parent_upload_port} throttled")
+        if status not in (200, 206):
+            raise DfError(Code.ClientPieceRequestFail,
+                          f"parent returned {status} for piece {piece_num}")
+        if want_crc >= 0 and crc != want_crc:
+            raise DfError(Code.ClientPieceDownloadFail,
+                          f"piece {piece_num} digest mismatch: want {want_crc:08x}, got {crc:08x}")
+        cost_ms = int((time.monotonic() - start) * 1000)
+        # Off-loop: the batched metadata save inside record_piece json-dumps
+        # the whole accumulated piece map — a repeated loop stall on
+        # many-piece tasks if run inline.
+        return await asyncio.to_thread(store.record_piece, piece_num, n, crc,
+                                       cost_ms)
+
     async def close(self) -> None:
         if self._session is not None and not self._session.closed:
             await self._session.close()
+        nb = _native()
+        if nb is not None:
+            self._pool.close_all(nb)
 
 
 def is_parent_gone(e: DfError) -> bool:
@@ -95,6 +326,15 @@ async def pull_one_piece(downloader: PieceDownloader, store, dispatcher,
         )
     await limiter.wait(max(assignment.expected_size, 1)
                        if assignment.expected_size > 0 else 1)
+    # Native fast path: body lands socket→crc32c→pwrite without entering
+    # Python; returns None when ineligible (falls through to aiohttp).
+    rec = await downloader.download_piece_to_store(
+        assignment.parent.ip, assignment.parent.upload_port,
+        task_id, assignment.piece_num, store,
+        src_peer_id=peer_id, expected_size=assignment.expected_size,
+        expected_digest=assignment.digest)
+    if rec is not None:
+        return rec
     data, cost_ms = await downloader.download_piece(
         assignment.parent.ip, assignment.parent.upload_port,
         task_id, assignment.piece_num,

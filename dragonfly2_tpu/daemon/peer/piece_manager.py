@@ -11,10 +11,15 @@ piece_downloader.py; this module owns origin fetches and storage writes.
 from __future__ import annotations
 
 import asyncio
+import os
 import time
 from dataclasses import dataclass
 from typing import Awaitable, Callable
 
+from dragonfly2_tpu.daemon.peer.piece_downloader import (
+    abandonable_native_call,
+    native_connect,
+)
 from dragonfly2_tpu.pkg import dflog
 from dragonfly2_tpu.pkg import digest as pkgdigest
 from dragonfly2_tpu.pkg.errors import Code, SourceError
@@ -22,7 +27,7 @@ from dragonfly2_tpu.pkg.piece import Range, compute_piece_count, compute_piece_s
 from dragonfly2_tpu.pkg.ratelimit import Limiter
 from dragonfly2_tpu.source import Request as SourceRequest
 from dragonfly2_tpu.source import get_client
-from dragonfly2_tpu.storage.local_store import LocalTaskStore, PieceRecord
+from dragonfly2_tpu.storage.local_store import LocalTaskStore, PieceRecord, _native
 
 log = dflog.get("peer.piece_manager")
 
@@ -116,6 +121,115 @@ class PieceManager:
                 f"source download incomplete: {len(store.metadata.pieces)}/"
                 f"{store.metadata.total_piece_count} pieces", Code.BackToSourceAborted)
 
+    # -- native-engine span fetch (no Python byte handling) ----------------
+
+    @staticmethod
+    def _span_status_error(client, status: int, req: SourceRequest) -> SourceError:
+        mapper = getattr(client, "status_error", None)
+        if mapper is not None:
+            return mapper(status, req.url)
+        return SourceError(f"origin {status}: {req.url}", Code.BackToSourceAborted,
+                           temporary=status in (408, 429, 500, 502, 503, 504))
+
+    async def _native_fetch_span(
+        self,
+        store: LocalTaskStore,
+        client,
+        req: SourceRequest,
+        first: int,
+        last: int,
+        byte_len: int,
+        on_piece: PieceCallback | None,
+        limiter: Limiter,
+        *,
+        ranged: bool,
+    ) -> bool:
+        """Fetch pieces [first, last) over one native-engine connection:
+        the body streams socket→crc32c→pwrite (native/src/dfhttp.cc) and
+        Python sees only per-piece records. Returns False when ineligible
+        (https, no native lib, client without a plan) so the caller falls
+        back to the aiohttp path; raises coded SourceErrors on failures,
+        matching the Python path's semantics."""
+        nb = _native()
+        plan_fn = getattr(client, "native_fetch_plan", None)
+        if nb is None or plan_fn is None:
+            return False
+        plan = plan_fn(req)
+        if plan is None:
+            return False
+        host, port, head = plan
+        m = store.metadata
+        try:
+            h = await native_connect(nb, host, port, 60000)
+        except nb.NativeHttpError:
+            return False  # let the aiohttp path produce its own coded error
+        dup_fd = os.dup(store.data_fd())
+        abandoned = False
+
+        def cleanup() -> None:
+            nb.http_close(h)
+            os.close(dup_fd)
+
+        async def ncall(fn, *args):
+            nonlocal abandoned
+            try:
+                return await abandonable_native_call(fn, *args,
+                                                     on_abandon=cleanup)
+            except asyncio.CancelledError:
+                abandoned = True  # the worker thread now owns cleanup()
+                raise
+
+        try:
+            try:
+                status, clen, _keep = await ncall(nb.http_start, h, head)
+            except nb.NativeHttpError:
+                # Start-phase failure (chunked origin, odd framing, stalled
+                # connect): no body consumed, nothing recorded — let the
+                # aiohttp path take over and produce its own coded errors.
+                return False
+            if 300 <= status < 400:
+                # aiohttp follows redirects (CDN/presigned handoffs); the
+                # native engine doesn't — hand the request back to it.
+                return False
+            if ranged and status == 200:
+                raise SourceError("origin ignored range request",
+                                  Code.SourceRangeUnsupported, temporary=True)
+            if status != (206 if ranged else 200):
+                raise self._span_status_error(client, status, req)
+            if clen < 0:
+                # Identity body without Content-Length (read-until-close):
+                # only the streaming Python path can delimit it.
+                return False
+            if clen != byte_len:
+                raise SourceError(
+                    f"origin returned {clen} bytes, expected {byte_len}",
+                    Code.BackToSourceAborted, temporary=True)
+            for num in range(first, last):
+                take = min(m.piece_size, m.content_length - num * m.piece_size)
+                await limiter.wait(take)
+                t0 = time.monotonic()
+                if store.has_piece(num):
+                    # Resume overlap: the bytes still arrive on this stream;
+                    # drain without touching the already-verified piece.
+                    await ncall(nb.http_read_to_file, h, -1, 0, take)
+                    continue
+                crc = await ncall(nb.http_read_to_file, h, dup_fd,
+                                  num * m.piece_size, take)
+                # Off-loop: record_piece's batched metadata save serializes
+                # the whole piece map — a loop stall if run inline.
+                rec = await asyncio.to_thread(
+                    store.record_piece, num, take, crc,
+                    int((time.monotonic() - t0) * 1000))
+                if on_piece is not None:
+                    await on_piece(store, rec)
+            return True
+        except nb.NativeHttpError as e:
+            raise SourceError(f"origin {host}:{port} native fetch: {e}",
+                              Code.BackToSourceAborted, temporary=True)
+        finally:
+            if not abandoned:
+                cleanup()
+
     # -- sequential / unknown-length (reference :481,:539) -----------------
 
     async def _download_streaming(
@@ -131,6 +245,12 @@ class PieceManager:
         req = request
         if content_range is not None:
             req = request.with_range(content_range.to_http())
+        if (known_length >= 0 and store.metadata.total_piece_count >= 0
+                and await self._native_fetch_span(
+                    store, client, req, 0, store.metadata.total_piece_count,
+                    known_length, on_piece, limiter,
+                    ranged=content_range is not None)):
+            return
         resp = await client.download(req)
         piece_size = store.metadata.piece_size
         num = 0
@@ -192,6 +312,10 @@ class PieceManager:
             byte_start = base_offset + first * m.piece_size
             byte_len = min(last * m.piece_size, m.content_length) - first * m.piece_size
             req = request.with_range(Range(byte_start, byte_len).to_http())
+            if await self._native_fetch_span(store, client, req, first, last,
+                                             byte_len, on_piece, limiter,
+                                             ranged=True):
+                return
             resp = await client.download(req)
             if resp.status != 206:
                 await resp.close()

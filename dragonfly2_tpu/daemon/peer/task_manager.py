@@ -362,7 +362,10 @@ class TaskManager:
                 try:
                     await self.piece_manager.import_file(store, path)
                     if req.meta.digest:
-                        store.validate_digest(req.meta.digest)
+                        # Whole-content hash: off the loop (hashlib releases
+                        # the GIL; inline it stalls every active transfer).
+                        await asyncio.to_thread(
+                            store.validate_digest, req.meta.digest)
                         store.metadata.digest = req.meta.digest
                     store.mark_done()
                     self._pex_announce(task_id)
@@ -413,7 +416,10 @@ class TaskManager:
         if reused is not None:
             log.info("reusing completed task", task_id=task_id[:16])
             if req.output:
-                reused.store_to(req.output)
+                # Pin across the off-loop copy: the await yields, and an
+                # unpinned store can be GC-reclaimed mid-hardlink.
+                with reused:
+                    await asyncio.to_thread(reused.store_to, req.output)
             try:
                 dev = await self._finalize_device(req, task_id, reused)
             except DfError as e:
@@ -440,7 +446,9 @@ class TaskManager:
                 log.info("reusing ranged slice from parent task",
                          parent=parent_id[:16], start=rng.start,
                          length=rng.length)
-                parent.export_range(req.output, rng.start, rng.length)
+                with parent:
+                    await asyncio.to_thread(parent.export_range, req.output,
+                                            rng.start, rng.length)
                 yield FileTaskProgress(
                     state="done", task_id=task_id, peer_id=peer_id,
                     content_length=rng.length, completed_length=rng.length,
@@ -468,7 +476,8 @@ class TaskManager:
                     error=DfError(Code.UnknownError, "dedup race: no store").to_wire())
                 return
             if req.output:
-                store.store_to(req.output)
+                with store:
+                    await asyncio.to_thread(store.store_to, req.output)
             try:
                 dev = await self._finalize_device(req, task_id, store)
             except DfError as e:
@@ -502,12 +511,14 @@ class TaskManager:
             from_p2p = download.result()
             # Verify + land output inside the same failure envelope.
             if req.meta.digest:
-                store.validate_digest(req.meta.digest)
+                # Off-loop: a whole-content sha256 of a multi-GB task would
+                # otherwise freeze this daemon's serving for seconds.
+                await asyncio.to_thread(store.validate_digest, req.meta.digest)
                 store.metadata.digest = req.meta.digest
             store.mark_done()
             self._pex_announce(task_id)
             if req.output:
-                store.store_to(req.output)
+                await asyncio.to_thread(store.store_to, req.output)
         except DfError as e:
             self._discard_sink(req, task_id)
             store.mark_invalid()
@@ -760,7 +771,7 @@ class TaskManager:
         try:
             await self._run_download(task_id, peer_id, req, store, None)
             if req.meta.digest:
-                store.validate_digest(req.meta.digest)
+                await asyncio.to_thread(store.validate_digest, req.meta.digest)
                 store.metadata.digest = req.meta.digest
             store.mark_done()
             self._pex_announce(task_id)

@@ -47,6 +47,34 @@ _lib.df_copy_range.restype = ctypes.c_int
 _lib.df_has_hw_crc.argtypes = []
 _lib.df_has_hw_crc.restype = ctypes.c_int
 
+_lib.df_http_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+_lib.df_http_connect.restype = ctypes.c_int64
+
+_lib.df_http_start.argtypes = [
+    ctypes.c_int64, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+    ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int),
+]
+_lib.df_http_start.restype = ctypes.c_int64
+
+_lib.df_http_read_to_file.argtypes = [
+    ctypes.c_int64, ctypes.c_int, ctypes.c_uint64, ctypes.c_uint64,
+    ctypes.POINTER(ctypes.c_uint32),
+]
+_lib.df_http_read_to_file.restype = ctypes.c_int64
+
+_lib.df_http_fetch_to_file.argtypes = [
+    ctypes.c_int64, ctypes.c_char_p, ctypes.c_int, ctypes.c_uint64,
+    ctypes.c_int64, ctypes.POINTER(ctypes.c_int),
+    ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int),
+]
+_lib.df_http_fetch_to_file.restype = ctypes.c_int64
+
+_lib.df_http_reusable.argtypes = [ctypes.c_int64]
+_lib.df_http_reusable.restype = ctypes.c_int
+
+_lib.df_http_close.argtypes = [ctypes.c_int64]
+_lib.df_http_close.restype = None
+
 
 def crc32c(data: bytes, crc: int = 0) -> int:
     return _lib.df_crc32c(data, len(data), crc)
@@ -97,3 +125,91 @@ def copy_range(in_fd: int, out_fd: int, length: int) -> None:
     rc = _lib.df_copy_range(in_fd, out_fd, length)
     if rc < 0:
         raise OSError(-rc, os.strerror(-rc))
+
+
+# -- native HTTP engine (src/dfhttp.cc) -------------------------------------
+
+HTTP_E_RESOLVE = -100001
+HTTP_E_TIMEOUT = -100002
+HTTP_E_CLOSED = -100003
+HTTP_E_PROTO = -100004
+HTTP_E_UNSUPPORTED = -100005
+HTTP_E_BADHANDLE = -100006
+HTTP_E_TOOBIG = -100007
+HTTP_E_LENMISMATCH = -100008
+
+_HTTP_E_NAMES = {
+    HTTP_E_RESOLVE: "resolve failed",
+    HTTP_E_TIMEOUT: "timed out",
+    HTTP_E_CLOSED: "connection closed",
+    HTTP_E_PROTO: "malformed response",
+    HTTP_E_UNSUPPORTED: "unsupported encoding",
+    HTTP_E_BADHANDLE: "bad handle",
+    HTTP_E_TOOBIG: "response head too large",
+    HTTP_E_LENMISMATCH: "length mismatch",
+}
+
+
+class NativeHttpError(OSError):
+    """A df_http_* call failed; .code is the DF_HTTP_E_* or -errno value."""
+
+    def __init__(self, code: int, where: str):
+        self.code = code
+        detail = _HTTP_E_NAMES.get(code) or os.strerror(-code)
+        super().__init__(-code, f"native http {where}: {detail}")
+
+
+def _http_check(rc: int, where: str) -> int:
+    if rc < 0:
+        raise NativeHttpError(rc, where)
+    return rc
+
+
+def http_connect(host: str, port: int, timeout_ms: int = 30000) -> int:
+    """TCP connect; returns a connection handle for the df_http_* calls."""
+    return _http_check(
+        _lib.df_http_connect(host.encode(), port, timeout_ms), "connect")
+
+
+def http_start(handle: int, head: bytes) -> tuple[int, int, bool]:
+    """Send a request head, parse the response head; body left unread.
+    Returns (status, content_length, keep_alive); content_length -1 means
+    read-until-close (the handle is then single-use)."""
+    status = ctypes.c_int(0)
+    clen = ctypes.c_int64(-1)
+    keep = ctypes.c_int(0)
+    _http_check(_lib.df_http_start(handle, head, ctypes.byref(status),
+                                   ctypes.byref(clen), ctypes.byref(keep)),
+                "start")
+    return status.value, clen.value, bool(keep.value)
+
+
+def http_read_to_file(handle: int, fd: int, offset: int, length: int) -> int:
+    """Land exactly `length` body bytes at fd/offset, crc32c fused into the
+    single memory walk. Returns the crc."""
+    crc = ctypes.c_uint32(0)
+    _http_check(_lib.df_http_read_to_file(handle, fd, offset, length,
+                                          ctypes.byref(crc)), "read")
+    return crc.value
+
+
+def http_fetch_to_file(handle: int, head: bytes, fd: int, offset: int,
+                       expected_len: int = -1) -> tuple[int, int, int, bool]:
+    """One request→file exchange. Returns (status, body_len, crc,
+    keep_alive); body_len is 0 (nothing landed) for non-200/206 statuses."""
+    status = ctypes.c_int(0)
+    crc = ctypes.c_uint32(0)
+    keep = ctypes.c_int(0)
+    n = _http_check(
+        _lib.df_http_fetch_to_file(handle, head, fd, offset, expected_len,
+                                   ctypes.byref(status), ctypes.byref(crc),
+                                   ctypes.byref(keep)), "fetch")
+    return status.value, n, crc.value, bool(keep.value)
+
+
+def http_reusable(handle: int) -> bool:
+    return bool(_lib.df_http_reusable(handle))
+
+
+def http_close(handle: int) -> None:
+    _lib.df_http_close(handle)

@@ -1,0 +1,377 @@
+// dfhttp — native HTTP/1.1 range-fetch engine for the piece data plane.
+//
+// The reference moves piece payloads as plain HTTP range GETs (Go
+// client/daemon/peer/piece_downloader.go:165-226 against the parent upload
+// server, and piece_manager.go:796-1000 concurrent range groups against the
+// origin) — compiled-native byte handling end to end. This is our
+// equivalent: the Python daemon builds the request head and owns retries /
+// scheduling, while every body byte flows socket → crc32c → pwrite inside
+// one GIL-free native call, never surfacing into Python. Pairs with
+// df_write_piece_crc (dfnative.cc): same fused one-memory-walk discipline.
+//
+// Scope: HTTP/1.1, identity encoding, Content-Length-delimited bodies —
+// exactly what the upload server and ranged origin responses speak. Anything
+// else (chunked, compressed, https) returns DF_HTTP_E_UNSUPPORTED and the
+// Python aiohttp path takes over.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+extern "C" uint32_t df_crc32c(const uint8_t* data, size_t len, uint32_t init);
+
+namespace {
+
+constexpr int64_t E_RESOLVE = -100001;
+constexpr int64_t E_TIMEOUT = -100002;
+constexpr int64_t E_CLOSED = -100003;      // peer closed mid-head/body
+constexpr int64_t E_PROTO = -100004;       // malformed response head
+constexpr int64_t E_UNSUPPORTED = -100005; // chunked / compressed / no clen
+constexpr int64_t E_BADHANDLE = -100006;
+constexpr int64_t E_TOOBIG = -100007;      // response head over 64 KiB
+constexpr int64_t E_LENMISMATCH = -100008; // body length != expected
+
+constexpr size_t HEAD_MAX = 64 << 10;
+constexpr size_t IO_BLOCK = 1 << 20;
+constexpr int64_t DRAIN_MAX = 256 << 10; // error bodies worth keeping a conn for
+
+struct Conn {
+  int fd = -1;
+  std::string leftover;      // bytes read past the parsed response head
+  int64_t body_remaining = 0; // unread body bytes of the started response
+  bool usable = true;         // false once the stream state is unknown
+  bool keep_alive = false;    // server allows reuse after current body
+};
+
+std::mutex g_mu;
+std::unordered_map<int64_t, Conn> g_conns;
+int64_t g_next_id = 1;
+
+Conn* get_conn(int64_t h) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_conns.find(h);
+  return it == g_conns.end() ? nullptr : &it->second;
+}
+
+int64_t sys_err() {
+  if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINPROGRESS)
+    return E_TIMEOUT;
+  return errno ? -(int64_t)errno : E_CLOSED;
+}
+
+// recv that retries EINTR; returns >0 bytes, 0 on orderly close, negative code.
+int64_t do_recv(int fd, uint8_t* buf, size_t n) {
+  for (;;) {
+    ssize_t r = recv(fd, buf, n, 0);
+    if (r >= 0) return r;
+    if (errno == EINTR) continue;
+    return sys_err();
+  }
+}
+
+int64_t send_all(int fd, const char* data, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t r = send(fd, data + off, n - off, MSG_NOSIGNAL);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return sys_err();
+    }
+    off += (size_t)r;
+  }
+  return 0;
+}
+
+bool iequal(const std::string& a, const char* b) {
+  size_t n = strlen(b);
+  if (a.size() != n) return false;
+  for (size_t i = 0; i < n; i++)
+    if (tolower((unsigned char)a[i]) != tolower((unsigned char)b[i])) return false;
+  return true;
+}
+
+std::string lstrip(const std::string& s) {
+  size_t i = 0;
+  while (i < s.size() && (s[i] == ' ' || s[i] == '\t')) i++;
+  return s.substr(i);
+}
+
+// Parse the response head in `head` (without the final CRLFCRLF).
+// Returns 0 or a negative code.
+int64_t parse_head(const std::string& head, int* status_out, int64_t* clen_out,
+                   bool* keep_out, bool* delimited_out) {
+  size_t line_end = head.find("\r\n");
+  std::string status_line = head.substr(0, line_end == std::string::npos ? head.size() : line_end);
+  // "HTTP/1.x NNN reason"
+  if (status_line.size() < 12 || status_line.compare(0, 5, "HTTP/") != 0)
+    return E_PROTO;
+  int minor = status_line[7] - '0';
+  int status = atoi(status_line.c_str() + 9);
+  if (status < 100 || status > 599) return E_PROTO;
+
+  int64_t clen = -1;
+  bool keep = minor >= 1; // HTTP/1.1 defaults to keep-alive
+  bool chunked = false, encoded = false;
+  size_t pos = line_end == std::string::npos ? head.size() : line_end + 2;
+  while (pos < head.size()) {
+    size_t eol = head.find("\r\n", pos);
+    std::string line = head.substr(pos, (eol == std::string::npos ? head.size() : eol) - pos);
+    pos = eol == std::string::npos ? head.size() : eol + 2;
+    size_t colon = line.find(':');
+    if (colon == std::string::npos) continue;
+    std::string name = line.substr(0, colon);
+    std::string value = lstrip(line.substr(colon + 1));
+    if (iequal(name, "content-length")) {
+      errno = 0;
+      char* end = nullptr;
+      clen = strtoll(value.c_str(), &end, 10);
+      // Reject non-numeric / overflowing values outright: silently reading
+      // clen=0 would desync the keep-alive stream (body bytes parsed as the
+      // next response head).
+      if (errno != 0 || end == value.c_str() || clen < 0) return E_PROTO;
+      while (*end == ' ' || *end == '\t') end++;
+      if (*end != '\0') return E_PROTO;
+    } else if (iequal(name, "transfer-encoding")) {
+      if (!iequal(value, "identity")) chunked = true;
+    } else if (iequal(name, "content-encoding")) {
+      if (!iequal(value, "identity")) encoded = true;
+    } else if (iequal(name, "connection")) {
+      if (iequal(value, "close")) keep = false;
+      else if (iequal(value, "keep-alive")) keep = true;
+    }
+  }
+  if (chunked || encoded) return E_UNSUPPORTED;
+  bool bodyless = status < 200 || status == 204 || status == 304;
+  if (bodyless) clen = 0;
+  *status_out = status;
+  *clen_out = clen;
+  *keep_out = keep;
+  *delimited_out = bodyless || clen >= 0;
+  return 0;
+}
+
+// Consume exactly `len` body bytes: leftover first, then the socket, fused
+// crc32c while pwrite()ing at fd/offset (fd < 0 = discard). Updates
+// conn->body_remaining. Returns bytes landed (== len) or a negative code.
+int64_t read_body_to_file(Conn* c, int fd, uint64_t offset, uint64_t len,
+                          uint32_t* crc_out) {
+  uint32_t crc = 0;
+  uint64_t done = 0;
+  std::vector<uint8_t> buf;
+  while (done < len) {
+    const uint8_t* src;
+    size_t n;
+    if (!c->leftover.empty()) {
+      n = c->leftover.size() < len - done ? c->leftover.size() : (size_t)(len - done);
+      src = (const uint8_t*)c->leftover.data();
+    } else {
+      if (buf.empty()) buf.resize(IO_BLOCK);
+      size_t want = len - done < IO_BLOCK ? (size_t)(len - done) : IO_BLOCK;
+      int64_t r = do_recv(c->fd, buf.data(), want);
+      if (r < 0) { c->usable = false; return r; }
+      if (r == 0) { c->usable = false; return E_CLOSED; }
+      n = (size_t)r;
+      src = buf.data();
+    }
+    crc = df_crc32c(src, n, crc);
+    if (fd >= 0) {
+      size_t w = 0;
+      while (w < n) {
+        ssize_t r = pwrite(fd, src + w, n - w, (off_t)(offset + done + w));
+        if (r < 0) {
+          if (errno == EINTR) continue;
+          c->usable = false; // stream position now unknown to the caller
+          return -(int64_t)errno;
+        }
+        w += (size_t)r;
+      }
+    }
+    if (!c->leftover.empty()) c->leftover.erase(0, n);
+    done += n;
+    c->body_remaining -= (int64_t)n;
+  }
+  if (crc_out) *crc_out = crc;
+  return (int64_t)done;
+}
+
+} // namespace
+
+extern "C" {
+
+// Open a TCP connection. timeout_ms bounds connect and every subsequent
+// socket op (SO_RCVTIMEO/SO_SNDTIMEO). Returns a handle (>0) or a negative
+// code (-errno, E_RESOLVE, E_TIMEOUT).
+int64_t df_http_connect(const char* host, int port, int timeout_ms) {
+  struct addrinfo hints;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  char portbuf[16];
+  snprintf(portbuf, sizeof(portbuf), "%d", port);
+  struct addrinfo* res = nullptr;
+  if (getaddrinfo(host, portbuf, &hints, &res) != 0 || res == nullptr)
+    return E_RESOLVE;
+  int fd = -1;
+  int64_t err = E_RESOLVE;
+  for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) { err = -(int64_t)errno; continue; }
+    struct timeval tv;
+    tv.tv_sec = timeout_ms / 1000;
+    tv.tv_usec = (timeout_ms % 1000) * 1000;
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) { err = 0; break; }
+    err = sys_err();
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) return err ? err : E_RESOLVE;
+  std::lock_guard<std::mutex> lk(g_mu);
+  int64_t h = g_next_id++;
+  Conn c;
+  c.fd = fd;
+  g_conns[h] = c;
+  return h;
+}
+
+// Send a full request head and parse the response head; the body is left
+// unread (stream it with df_http_read_to_file). Returns 0 or a negative
+// code. clen_out = -1 means no Content-Length (read-until-close body):
+// the connection is marked unusable for further requests.
+int64_t df_http_start(int64_t h, const char* head, int* status_out,
+                      int64_t* clen_out, int* keep_alive_out) {
+  Conn* c = get_conn(h);
+  if (c == nullptr || c->fd < 0) return E_BADHANDLE;
+  if (!c->usable || c->body_remaining != 0) return E_BADHANDLE;
+  int64_t rc = send_all(c->fd, head, strlen(head));
+  if (rc < 0) { c->usable = false; return rc; }
+
+  std::string hd;
+  hd.reserve(1024);
+  size_t scanned = 0;
+  uint8_t buf[4096];
+  for (;;) {
+    // leftover can hold a prior response's tail only if the server over-sent;
+    // consume it first for protocol correctness.
+    if (!c->leftover.empty()) {
+      hd.append(c->leftover);
+      c->leftover.clear();
+    } else {
+      int64_t r = do_recv(c->fd, buf, sizeof(buf));
+      if (r < 0) { c->usable = false; return r; }
+      if (r == 0) { c->usable = false; return E_CLOSED; }
+      hd.append((const char*)buf, (size_t)r);
+    }
+    size_t mark = hd.find("\r\n\r\n", scanned == 0 ? 0 : scanned - 3);
+    if (mark != std::string::npos) {
+      c->leftover = hd.substr(mark + 4);
+      hd.resize(mark);
+      break;
+    }
+    scanned = hd.size();
+    if (hd.size() > HEAD_MAX) { c->usable = false; return E_TOOBIG; }
+  }
+
+  int status = 0;
+  int64_t clen = -1;
+  bool keep = false, delimited = false;
+  rc = parse_head(hd, &status, &clen, &keep, &delimited);
+  if (rc < 0) { c->usable = false; return rc; }
+  c->body_remaining = delimited ? clen : -1;
+  c->keep_alive = keep && delimited;
+  if (!delimited) c->usable = false;
+  *status_out = status;
+  *clen_out = clen;
+  *keep_alive_out = c->keep_alive ? 1 : 0;
+  return 0;
+}
+
+// Read exactly `len` body bytes of the started response into fd at
+// `offset`, computing crc32c on the way (one memory walk). Returns bytes
+// landed or a negative code; E_LENMISMATCH if fewer remain.
+int64_t df_http_read_to_file(int64_t h, int fd, uint64_t offset, uint64_t len,
+                             uint32_t* crc_out) {
+  Conn* c = get_conn(h);
+  if (c == nullptr || c->fd < 0) return E_BADHANDLE;
+  if (c->body_remaining >= 0 && (int64_t)len > c->body_remaining)
+    return E_LENMISMATCH;
+  return read_body_to_file(c, fd, offset, len, crc_out);
+}
+
+// One full exchange: request + response head + body straight to file.
+// 200/206 with Content-Length == expected_len (when expected_len >= 0):
+// lands the body, returns its length, sets *crc_out. Any other status:
+// drains small bodies to preserve keep-alive, returns 0 with *status_out
+// set (the caller maps 404/429/…). Content-Length mismatch → E_LENMISMATCH.
+int64_t df_http_fetch_to_file(int64_t h, const char* head, int fd,
+                              uint64_t offset, int64_t expected_len,
+                              int* status_out, uint32_t* crc_out,
+                              int* keep_alive_out) {
+  int status = 0, keep = 0;
+  int64_t clen = -1;
+  int64_t rc = df_http_start(h, head, &status, &clen, &keep);
+  if (rc < 0) return rc;
+  *status_out = status;
+  *keep_alive_out = keep;
+  Conn* c = get_conn(h);
+  if (c == nullptr) return E_BADHANDLE;
+  if (status == 200 || status == 206) {
+    if (clen < 0) { c->usable = false; return E_UNSUPPORTED; }
+    if (expected_len >= 0 && clen != expected_len) {
+      c->usable = false;
+      return E_LENMISMATCH;
+    }
+    return read_body_to_file(c, fd, offset, (uint64_t)clen, crc_out);
+  }
+  // Non-payload status: keep the connection when the error body is small.
+  if (clen >= 0 && clen <= DRAIN_MAX) {
+    int64_t d = read_body_to_file(c, -1, 0, (uint64_t)clen, nullptr);
+    if (d < 0) return 0; // status still useful; conn already marked unusable
+  } else {
+    c->usable = false;
+  }
+  return 0;
+}
+
+// 1 = the connection finished its body, the server allows reuse, and the
+// socket still looks alive (a non-blocking MSG_PEEK sees EAGAIN — an
+// idle-closed keep-alive shows EOF or stray bytes and is rejected here
+// instead of surfacing as a mid-request failure).
+int df_http_reusable(int64_t h) {
+  Conn* c = get_conn(h);
+  if (c == nullptr || c->fd < 0 || !c->usable || !c->keep_alive ||
+      c->body_remaining != 0 || !c->leftover.empty())
+    return 0;
+  uint8_t probe;
+  ssize_t r = recv(c->fd, &probe, 1, MSG_PEEK | MSG_DONTWAIT);
+  if (r == 0) return 0;                                  // server sent FIN
+  if (r > 0) return 0;                                   // unexpected bytes
+  return (errno == EAGAIN || errno == EWOULDBLOCK) ? 1 : 0;
+}
+
+void df_http_close(int64_t h) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_conns.find(h);
+  if (it == g_conns.end()) return;
+  if (it->second.fd >= 0) close(it->second.fd);
+  g_conns.erase(it);
+}
+
+} // extern "C"

@@ -80,7 +80,7 @@ def _spawn(args: list[str], log_path: str) -> subprocess.Popen:
         stdout=logf, stderr=subprocess.STDOUT, env=env)
 
 
-def _wait_sock(path: str, timeout: float = 20.0) -> bool:
+def _wait_sock(path: str, timeout: float = 90.0) -> bool:
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if os.path.exists(path):

@@ -1,0 +1,293 @@
+"""Native HTTP engine (native/src/dfhttp.cc) and its data-plane seams.
+
+The reference moves piece payloads over plain HTTP with fully native byte
+handling (Go piece_downloader.go / piece_manager.go); our equivalent is the
+C++ engine where bodies flow socket→crc32c→pwrite without entering Python.
+These tests drive the ctypes surface directly against a live aiohttp origin,
+then the two integration seams: PieceDownloader.download_piece_to_store
+(parent pulls) and PieceManager._native_fetch_span (origin ingest).
+"""
+
+import asyncio
+import os
+
+import pytest
+from aiohttp import web
+
+from dragonfly2_tpu.pkg.piece import Range
+from dragonfly2_tpu.storage.local_store import LocalTaskStore, TaskStoreMetadata, _native
+
+nb = _native()
+pytestmark = pytest.mark.skipif(nb is None, reason="native library unavailable")
+
+T = asyncio.to_thread  # engine calls block; keep the test's loop free
+
+
+async def _serve(routes) -> tuple[web.AppRunner, int]:
+    app = web.Application()
+    for path, handler in routes.items():
+        app.router.add_get(path, handler)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    return runner, site._server.sockets[0].getsockname()[1]
+
+
+def _ranged(content: bytes):
+    async def handler(req: web.Request) -> web.Response:
+        rng = req.headers.get("Range")
+        if rng:
+            r = Range.parse_http(rng, len(content))
+            body = content[r.start:r.start + r.length]
+            return web.Response(status=206, body=body, headers={
+                "Accept-Ranges": "bytes",
+                "Content-Range":
+                    f"bytes {r.start}-{r.start + r.length - 1}/{len(content)}"})
+        return web.Response(body=content, headers={"Accept-Ranges": "bytes"})
+    return handler
+
+
+def _head(port: int, path: str = "/blob", rng: str = "") -> bytes:
+    lines = [f"GET {path} HTTP/1.1", f"Host: 127.0.0.1:{port}"]
+    if rng:
+        lines.append(f"Range: {rng}")
+    lines += ["Accept-Encoding: identity", "Connection: keep-alive"]
+    return ("\r\n".join(lines) + "\r\n\r\n").encode()
+
+
+class TestEngine:
+    def test_fetch_stream_reuse_and_status(self, run_async, tmp_path):
+        async def body():
+            content = os.urandom((5 << 20) + 777)
+            runner, port = await _serve({
+                "/blob": _ranged(content),
+                "/gone": lambda r: web.Response(status=404, text="nope"),
+            })
+            fd = os.open(tmp_path / "out", os.O_RDWR | os.O_CREAT)
+            try:
+                h = await T(nb.http_connect, "127.0.0.1", port, 5000)
+                # whole-body fetch lands bytes + crc in one call
+                status, n, crc, keep = await T(
+                    nb.http_fetch_to_file, h, _head(port), fd, 0, len(content))
+                assert (status, n) == (200, len(content)) and keep
+                assert os.pread(fd, len(content), 0) == content
+                assert crc == nb.crc32c(content)
+                # ranged fetch reuses the same connection
+                status, n, crc, _ = await T(
+                    nb.http_fetch_to_file, h,
+                    _head(port, rng="bytes=1000-2023"), fd, 0, 1024)
+                assert (status, n) == (206, 1024)
+                assert crc == nb.crc32c(content[1000:2024])
+                # streaming: head once, then piece-sized reads
+                status, clen, _ = await T(nb.http_start, h, _head(port))
+                assert (status, clen) == (200, len(content))
+                off, piece = 0, 1 << 20
+                while off < clen:
+                    take = min(piece, clen - off)
+                    c = await T(nb.http_read_to_file, h, fd, off, take)
+                    assert c == nb.crc32c(content[off:off + take])
+                    off += take
+                assert nb.http_reusable(h)
+                # non-2xx drains the small body and keeps the connection
+                status, n, _, _ = await T(
+                    nb.http_fetch_to_file, h, _head(port, "/gone"), fd, 0, -1)
+                assert (status, n) == (404, 0) and nb.http_reusable(h)
+                nb.http_close(h)
+            finally:
+                os.close(fd)
+                await runner.cleanup()
+
+        run_async(body())
+
+    def test_length_mismatch_and_chunked_rejected(self, run_async, tmp_path):
+        async def body():
+            content = os.urandom(1 << 20)
+
+            async def chunked(req: web.Request) -> web.StreamResponse:
+                resp = web.StreamResponse()  # no content-length → chunked
+                await resp.prepare(req)
+                await resp.write(content)
+                return resp
+
+            runner, port = await _serve({"/blob": _ranged(content),
+                                         "/chunked": chunked})
+            fd = os.open(tmp_path / "out", os.O_RDWR | os.O_CREAT)
+            try:
+                h = await T(nb.http_connect, "127.0.0.1", port, 5000)
+                with pytest.raises(nb.NativeHttpError) as ei:
+                    await T(nb.http_fetch_to_file, h, _head(port), fd, 0,
+                            len(content) + 1)
+                assert ei.value.code == nb.HTTP_E_LENMISMATCH
+                nb.http_close(h)
+
+                h = await T(nb.http_connect, "127.0.0.1", port, 5000)
+                with pytest.raises(nb.NativeHttpError) as ei:
+                    await T(nb.http_fetch_to_file, h, _head(port, "/chunked"),
+                            fd, 0, -1)
+                assert ei.value.code == nb.HTTP_E_UNSUPPORTED
+                nb.http_close(h)
+            finally:
+                os.close(fd)
+                await runner.cleanup()
+
+        run_async(body())
+
+    def test_stale_keepalive_detected(self, run_async, tmp_path):
+        async def body():
+            content = os.urandom(4096)
+            runner, port = await _serve({"/blob": _ranged(content)})
+            fd = os.open(tmp_path / "out", os.O_RDWR | os.O_CREAT)
+            try:
+                h = await T(nb.http_connect, "127.0.0.1", port, 5000)
+                status, n, _, keep = await T(
+                    nb.http_fetch_to_file, h, _head(port), fd, 0, len(content))
+                assert status == 200 and keep and nb.http_reusable(h)
+                # Server goes away: FIN arrives; the MSG_PEEK probe must
+                # reject the handle instead of letting a request fail.
+                await runner.cleanup()
+                await asyncio.sleep(0.1)
+                assert not nb.http_reusable(h)
+                nb.http_close(h)
+            finally:
+                os.close(fd)
+
+        run_async(body())
+
+
+def _store(tmp_path, name: str, content_len: int, piece_size: int) -> LocalTaskStore:
+    return LocalTaskStore.create(
+        str(tmp_path / name),
+        TaskStoreMetadata(task_id="t" * 16, peer_id=name,
+                          content_length=content_len, piece_size=piece_size,
+                          total_piece_count=-(-content_len // piece_size)))
+
+
+class TestDownloadToStore:
+    def test_parent_pull_lands_and_verifies(self, run_async, tmp_path):
+        from dragonfly2_tpu.daemon.peer.piece_downloader import PieceDownloader
+
+        async def body():
+            ps = 1 << 20
+            content = os.urandom(3 * ps + 123)
+            src = _store(tmp_path, "src", len(content), ps)
+            recs = [src.write_piece(n, content[n * ps:(n + 1) * ps])
+                    for n in range(4)]
+
+            async def piece(req: web.Request) -> web.Response:
+                n = int(req.query["pieceNum"])
+                return web.Response(body=src.read_piece(n))
+
+            runner, port = await _serve(
+                {"/download/{p}/{t}": piece})
+            dst = _store(tmp_path, "dst", len(content), ps)
+            dl = PieceDownloader()
+            try:
+                for n in range(4):
+                    rec = await dl.download_piece_to_store(
+                        "127.0.0.1", port, "t" * 16, n, dst,
+                        expected_size=recs[n].size,
+                        expected_digest=recs[n].digest)
+                    assert rec is not None and rec.digest == recs[n].digest
+                got = b"".join(dst.read_piece(n) for n in range(4))
+                assert got == content
+            finally:
+                await dl.close()
+                await runner.cleanup()
+
+        run_async(body())
+
+    def test_corrupt_parent_body_not_recorded(self, run_async, tmp_path):
+        from dragonfly2_tpu.daemon.peer.piece_downloader import PieceDownloader
+        from dragonfly2_tpu.pkg.errors import Code, DfError
+
+        async def body():
+            ps = 1 << 20
+            content = os.urandom(ps)
+            src = _store(tmp_path, "src", ps, ps)
+            rec = src.write_piece(0, content)
+
+            async def evil(req: web.Request) -> web.Response:
+                return web.Response(body=os.urandom(ps))  # right size, bad bytes
+
+            runner, port = await _serve({"/download/{p}/{t}": evil})
+            dst = _store(tmp_path, "dst", ps, ps)
+            dl = PieceDownloader()
+            try:
+                with pytest.raises(DfError) as ei:
+                    await dl.download_piece_to_store(
+                        "127.0.0.1", port, "t" * 16, 0, dst,
+                        expected_size=ps, expected_digest=rec.digest)
+                assert ei.value.code == Code.ClientPieceDownloadFail
+                assert not dst.has_piece(0)  # bad bytes stay invisible
+            finally:
+                await dl.close()
+                await runner.cleanup()
+
+        run_async(body())
+
+    def test_non_crc_digest_falls_back(self, run_async, tmp_path):
+        from dragonfly2_tpu.daemon.peer.piece_downloader import PieceDownloader
+
+        async def body():
+            ps = 1 << 20
+            dst = _store(tmp_path, "dst", ps, ps)
+            dl = PieceDownloader()
+            rec = await dl.download_piece_to_store(
+                "127.0.0.1", 1, "t" * 16, 0, dst,
+                expected_size=ps,
+                expected_digest="sha256:" + "0" * 64)
+            assert rec is None  # ineligible → caller takes the aiohttp path
+            await dl.close()
+
+        run_async(body())
+
+
+class TestNativeSpan:
+    def test_origin_span_records_pieces_in_order(self, run_async, tmp_path):
+        from dragonfly2_tpu.daemon.peer.piece_manager import PieceManager
+        from dragonfly2_tpu.pkg.ratelimit import Limiter
+        from dragonfly2_tpu.source.clients.http import HTTPSourceClient
+        from dragonfly2_tpu.source.client import Request as SourceRequest
+
+        async def body():
+            ps = 1 << 20
+            content = os.urandom(2 * ps + 5)
+            runner, port = await _serve({"/blob": _ranged(content)})
+            store = _store(tmp_path, "dst", len(content), ps)
+            pm = PieceManager()
+            seen: list[int] = []
+
+            async def on_piece(s, rec):
+                seen.append(rec.num)
+
+            try:
+                ok = await pm._native_fetch_span(
+                    store, HTTPSourceClient(),
+                    SourceRequest(f"http://127.0.0.1:{port}/blob", {}),
+                    0, 3, len(content), on_piece, Limiter(), ranged=False)
+                assert ok and seen == [0, 1, 2]
+                assert store.is_complete()
+                got = b"".join(store.read_piece(n) for n in range(3))
+                assert got == content
+            finally:
+                await runner.cleanup()
+
+        run_async(body())
+
+    def test_https_plan_ineligible(self):
+        from dragonfly2_tpu.source.clients.http import HTTPSourceClient
+        from dragonfly2_tpu.source.client import Request as SourceRequest
+
+        c = HTTPSourceClient()
+        assert c.native_fetch_plan(
+            SourceRequest("https://secure.example/x", {})) is None
+        # non-latin-1 header values must fall back, not raise
+        assert c.native_fetch_plan(
+            SourceRequest("http://h/x", {"X-Meta": "café…"})) is None
+        # userinfo must not leak into Host
+        plan = c.native_fetch_plan(
+            SourceRequest("http://user:pw@origin:8080/f", {}))
+        assert plan is not None
+        host, port, head = plan
+        assert b"Host: origin:8080\r\n" in head and b"user:pw" not in head
