@@ -1,0 +1,143 @@
+"""BASELINE config #1 bench: dfget single-URL download, no P2P.
+
+One origin + one daemon (no scheduler, no seed): dfget -> daemon ->
+back-to-source -> piece store -> digest verify -> output. This is the
+minimum end-to-end slice (SURVEY §7 stage 2) and measures the native
+origin-ingest path (native/src/dfhttp.cc) plus the store/verify/land tail.
+
+Usage: python benchmarks/single_bench.py [--mb 256] [--runs 3] [--publish]
+Prints one JSON line; --publish records the median run under
+BASELINE.json["published"]["config1_single"].
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import hashlib
+import json
+import os
+import random
+import signal
+import statistics
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from aiohttp import web  # noqa: E402
+
+from dragonfly2_tpu.pkg.piece import Range  # noqa: E402
+from benchmarks.fanout_bench import _free_port, _spawn, _wait_sock  # noqa: E402
+
+
+async def run_bench(total_mb: int, runs: int, workdir: str) -> dict:
+    rng = random.Random(42)
+    content = b"".join(rng.randbytes(16 << 20)
+                       for _ in range(max(1, total_mb // 16)))
+    sha = hashlib.sha256(content).hexdigest()
+    stats = {"streams": 0, "bytes": 0}
+
+    async def blob(request: web.Request) -> web.Response:
+        stats["streams"] += 1
+        r = request.headers.get("Range")
+        if r:
+            rr = Range.parse_http(r, len(content))
+            data = content[rr.start:rr.start + rr.length]
+            stats["bytes"] += len(data)
+            return web.Response(status=206, body=data, headers={
+                "Accept-Ranges": "bytes",
+                "Content-Range":
+                    f"bytes {rr.start}-{rr.start + rr.length - 1}/{len(content)}"})
+        stats["bytes"] += len(content)
+        return web.Response(body=content, headers={"Accept-Ranges": "bytes"})
+
+    app = web.Application()
+    app.router.add_get("/blob", blob)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    origin_port = site._server.sockets[0].getsockname()[1]
+
+    home = os.path.join(workdir, "daemon")
+    proc = _spawn(["daemon", "--work-home", home],
+                  os.path.join(workdir, "daemon.log"))
+    try:
+        ok = await asyncio.to_thread(
+            _wait_sock, os.path.join(home, "run", "dfdaemon.sock"))
+        if not ok:
+            raise RuntimeError("daemon did not come up")
+
+        from dragonfly2_tpu.client import dfget as dfget_lib
+        from dragonfly2_tpu.proto.common import UrlMeta
+
+        walls: list[float] = []
+        for i in range(runs):
+            # Unique query per run defeats task reuse: every run measures
+            # the full back-to-source + verify + land path.
+            url = f"http://127.0.0.1:{origin_port}/blob?run={i}"
+            out = os.path.join(workdir, f"out{i}.bin")
+            t0 = time.perf_counter()
+            result = await dfget_lib.download(dfget_lib.DfgetConfig(
+                url=url, output=out,
+                daemon_sock=os.path.join(home, "run", "dfdaemon.sock"),
+                meta=UrlMeta(digest=f"sha256:{sha}"),
+                allow_source_fallback=False, timeout=600.0))
+            walls.append(time.perf_counter() - t0)
+            if result.get("state") != "done":
+                raise RuntimeError(f"run {i} failed: {result}")
+            with open(out, "rb") as f:
+                if hashlib.file_digest(f, "sha256").hexdigest() != sha:
+                    raise RuntimeError(f"run {i} sha mismatch")
+            os.unlink(out)
+
+        walls.sort()
+        med = walls[len(walls) // 2]
+        return {
+            "config": "single-url-no-p2p",
+            "content_mb": total_mb,
+            "runs": runs,
+            "wall_s": round(med, 3),
+            "gbps": round(len(content) / med / 1e9, 3),
+            "mbps": round(len(content) / med / 1e6, 1),
+            "wall_all_s": [round(w, 3) for w in walls],
+            "origin_ratio": round(stats["bytes"] / (len(content) * runs), 3),
+            "host_cores": os.cpu_count(),
+        }
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        await runner.cleanup()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=int, default=256)
+    ap.add_argument("--runs", type=int, default=3)
+    ap.add_argument("--publish", action="store_true")
+    ap.add_argument("--workdir", default="")
+    args = ap.parse_args()
+
+    import tempfile
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="df-single-")
+    result = asyncio.run(run_bench(args.mb, args.runs, workdir))
+    print(json.dumps(result))
+    if args.publish:
+        path = os.path.join(REPO, "BASELINE.json")
+        doc = json.load(open(path))
+        doc.setdefault("published", {})["config1_single"] = result
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

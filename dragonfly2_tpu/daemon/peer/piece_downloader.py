@@ -230,6 +230,14 @@ class PieceDownloader:
                 raise DfError(Code.ClientPieceDownloadFail,
                               f"piece {piece_num}: malformed digest {expected_digest!r}")
 
+        # task_id/src_peer_id are spliced verbatim into the raw request
+        # head: a CR/LF or control char would smuggle extra headers, and
+        # non-latin-1 won't encode (same guard as native_fetch_plan).
+        # Externally-supplied ids (seed trigger specs) make this reachable
+        # — fall back to the aiohttp path, which quotes them safely.
+        if any(ord(c) < 0x20 or c == "\x7f" or ord(c) > 0xff or c in " ?&#"
+               for c in f"{task_id}{src_peer_id}"):
+            return None
         head = (
             f"GET /download/{task_id[:3]}/{task_id}"
             f"?peerId={src_peer_id}&pieceNum={piece_num} HTTP/1.1\r\n"

@@ -71,13 +71,43 @@ class UploadManager:
                  concurrent_limit: int = 0, ssl_context=None):
         self.storage = storage
         self._ssl = ssl_context   # optional (m)TLS — reference WithTLS/certify
+        self._rate_limit = rate_limit
         self.limiter = Limiter(rate_limit if rate_limit > 0 else float("inf"))
         self.concurrent_limit = concurrent_limit
         self.concurrent = 0
         self._runner: web.AppRunner | None = None
+        self._native_srv: int | None = None
         self._port = 0
 
+    def _native_eligible(self, host: str):
+        """The C++ server (native/src/dfupload.cc) serves plaintext HTTP
+        only and has no token-bucket limiter: (m)TLS and rate-limited
+        configs stay on the aiohttp path. Returns the binding or None."""
+        import ipaddress
+
+        if self._ssl is not None or self._rate_limit > 0:
+            return None
+        try:
+            ipaddress.IPv4Address(host)
+        except ValueError:
+            return None
+        from dragonfly2_tpu.storage.local_store import _native
+
+        return _native()
+
     async def serve(self, host: str, port: int = 0) -> int:
+        nb = self._native_eligible(host)
+        if nb is not None:
+            srv = nb.upload_start(host, port,
+                                  concurrent_limit=self.concurrent_limit)
+            self._native_srv = srv
+            self._port = nb.upload_port(srv)
+            # Mirror the piece map into the serving registry: replay what
+            # exists (reloaded tasks), then stay current via observer
+            # callbacks — requests never consult Python.
+            self.storage.set_observer(_NativeServingIndex(nb, srv))
+            log.info("upload server up (native)", port=self._port)
+            return self._port
         app = web.Application()
         app.router.add_get("/download/{task_prefix}/{task_id}", self._download)
         app.router.add_get("/healthy", self._healthy)
@@ -94,9 +124,45 @@ class UploadManager:
     def port(self) -> int:
         return self._port
 
+    def native_counters(self) -> dict | None:
+        if self._native_srv is None:
+            return None
+        from dragonfly2_tpu.storage.local_store import _native
+
+        return _native().upload_counters(self._native_srv)
+
     async def close(self) -> None:
+        if self._native_srv is not None:
+            from dragonfly2_tpu.storage.local_store import _native
+
+            srv, self._native_srv = self._native_srv, None
+            # stop() joins serving threads; keep the event loop free.
+            await asyncio.to_thread(_native().upload_stop, srv)
+            self.storage.observer = None
         if self._runner is not None:
             await self._runner.cleanup()
+
+
+class _NativeServingIndex:
+    """StorageManager observer mirroring task/piece state into the native
+    upload server's registry. Pure ctypes calls guarded by the C side's
+    mutex — safe from any thread (piece commits arrive from workers)."""
+
+    def __init__(self, nb, srv: int):
+        self._nb = nb
+        self._srv = srv
+
+    def task_updated(self, store) -> None:
+        m = store.metadata
+        self._nb.upload_register_task(self._srv, m.task_id, store.data_path,
+                                      m.content_length, m.piece_size)
+
+    def piece_recorded(self, task_id: str, rec) -> None:
+        self._nb.upload_register_piece(self._srv, task_id, rec.num,
+                                       rec.offset, rec.size)
+
+    def task_deleted(self, task_id: str) -> None:
+        self._nb.upload_unregister_task(self._srv, task_id)
 
     # -- handlers ----------------------------------------------------------
 

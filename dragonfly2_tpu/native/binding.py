@@ -75,6 +75,35 @@ _lib.df_http_reusable.restype = ctypes.c_int
 _lib.df_http_close.argtypes = [ctypes.c_int64]
 _lib.df_http_close.restype = None
 
+_lib.df_upload_start.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+                                 ctypes.c_int]
+_lib.df_upload_start.restype = ctypes.c_int64
+
+_lib.df_upload_port.argtypes = [ctypes.c_int64]
+_lib.df_upload_port.restype = ctypes.c_int
+
+_lib.df_upload_register_task.argtypes = [
+    ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+    ctypes.c_uint64,
+]
+_lib.df_upload_register_task.restype = ctypes.c_int
+
+_lib.df_upload_register_piece.argtypes = [
+    ctypes.c_int64, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint64,
+    ctypes.c_uint64,
+]
+_lib.df_upload_register_piece.restype = ctypes.c_int
+
+_lib.df_upload_unregister_task.argtypes = [ctypes.c_int64, ctypes.c_char_p]
+_lib.df_upload_unregister_task.restype = ctypes.c_int
+
+_lib.df_upload_counters.argtypes = [ctypes.c_int64,
+                                    ctypes.POINTER(ctypes.c_uint64)]
+_lib.df_upload_counters.restype = None
+
+_lib.df_upload_stop.argtypes = [ctypes.c_int64]
+_lib.df_upload_stop.restype = None
+
 
 def crc32c(data: bytes, crc: int = 0) -> int:
     return _lib.df_crc32c(data, len(data), crc)
@@ -213,3 +242,46 @@ def http_reusable(handle: int) -> bool:
 
 def http_close(handle: int) -> None:
     _lib.df_http_close(handle)
+
+
+# -- native upload server (src/dfupload.cc) ---------------------------------
+
+def upload_start(ip: str, port: int, workers: int = 32,
+                 concurrent_limit: int = 0) -> int:
+    """Start the native piece-serving HTTP server; returns a handle."""
+    h = _lib.df_upload_start(ip.encode(), port, workers, concurrent_limit)
+    if h < 0:
+        raise OSError(-h, os.strerror(-h))
+    return h
+
+
+def upload_port(handle: int) -> int:
+    return _lib.df_upload_port(handle)
+
+
+def upload_register_task(handle: int, task_id: str, data_path: str,
+                         content_length: int, piece_size: int) -> None:
+    _lib.df_upload_register_task(handle, task_id.encode(),
+                                 data_path.encode(), content_length,
+                                 piece_size)
+
+
+def upload_register_piece(handle: int, task_id: str, num: int, offset: int,
+                          size: int) -> None:
+    _lib.df_upload_register_piece(handle, task_id.encode(), num, offset, size)
+
+
+def upload_unregister_task(handle: int, task_id: str) -> None:
+    _lib.df_upload_unregister_task(handle, task_id.encode())
+
+
+def upload_counters(handle: int) -> dict:
+    out = (ctypes.c_uint64 * 6)()
+    _lib.df_upload_counters(handle, out)
+    return {"bytes_served": out[0], "ok": out[1], "not_found": out[2],
+            "piece_missing": out[3], "throttled": out[4],
+            "bad_request": out[5]}
+
+
+def upload_stop(handle: int) -> None:
+    _lib.df_upload_stop(handle)

@@ -118,6 +118,12 @@ class LocalTaskStore:
         self._pins = 0
         self._unsaved_pieces = 0
         self._last_meta_save = 0.0
+        self._output_lock = threading.Lock()
+        # Optional StorageObserver (see storage/manager.py): notified on
+        # piece commits and geometry updates so external indexes (the
+        # native upload server's serving registry) stay current. Called
+        # from worker threads — implementations must be thread-safe.
+        self.observer = None
         # Piece writes are thread-offloaded (daemon/peer paths): the
         # native crc+pwrite runs GIL-free and offset-disjoint, but fd
         # creation and metadata record/serialize must serialize.
@@ -219,6 +225,9 @@ class LocalTaskStore:
         if header is not None:
             m.header = header
         self.save_metadata()
+        obs = self.observer
+        if obs is not None:
+            obs.task_updated(self)
 
     # -- piece IO ----------------------------------------------------------
 
@@ -318,6 +327,9 @@ class LocalTaskStore:
                 self._unsaved_pieces += 1
         if existing is None:
             self._piece_recorded_save()
+        obs = self.observer
+        if obs is not None:
+            obs.piece_recorded(self.metadata.task_id, rec)
         return rec
 
     def read_piece(self, num: int) -> bytes:
@@ -473,38 +485,50 @@ class LocalTaskStore:
 
     def store_to(self, dest: str, *, hardlink: bool = True) -> None:
         """Land the completed content at ``dest``: hardlink when possible,
-        else copy (reference local_storage.go:353)."""
+        else copy (reference local_storage.go:353). Runs in worker threads
+        (task_manager offloads it), so it serializes on a per-store lock,
+        and the copy path writes a temp file + atomic rename — opening
+        ``dest`` with O_TRUNC in place could truncate the task's own data
+        file through a concurrently-created hardlink to the same inode."""
         if not self.is_complete():
             raise StorageError("task incomplete; refusing to store output")
-        dest_dir = os.path.dirname(os.path.abspath(dest))
-        os.makedirs(dest_dir, exist_ok=True)
-        try:
-            # Racy-delete tolerant: store_to now runs in worker threads, so
-            # two requests landing the same dest may interleave here.
-            os.unlink(dest)
-        except FileNotFoundError:
-            pass
-        # The data file is exactly the content when pieces are contiguous
-        # from offset 0; truncate to content length guards a sparse tail.
-        cl = self.metadata.content_length
-        if cl >= 0 and self.disk_usage() != cl:
-            with open(self._data_path, "r+b") as f:
-                f.truncate(cl)
-        if hardlink:
+        with self._output_lock:
+            dest_dir = os.path.dirname(os.path.abspath(dest))
+            os.makedirs(dest_dir, exist_ok=True)
             try:
-                os.link(self._data_path, dest)
-                return
-            except OSError:
+                os.unlink(dest)
+            except FileNotFoundError:
                 pass
-        native = _native()
-        if native is not None:
-            size = os.path.getsize(self._data_path)
-            in_fd = os.open(self._data_path, os.O_RDONLY)
-            out_fd = os.open(dest, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+            # The data file is exactly the content when pieces are contiguous
+            # from offset 0; truncate to content length guards a sparse tail.
+            cl = self.metadata.content_length
+            if cl >= 0 and self.disk_usage() != cl:
+                with open(self._data_path, "r+b") as f:
+                    f.truncate(cl)
+            if hardlink:
+                try:
+                    os.link(self._data_path, dest)
+                    return
+                except FileExistsError:
+                    return  # a concurrent lander won the race: same content
+                except OSError:
+                    pass
+            tmp = f"{dest}.df-tmp-{os.getpid()}-{threading.get_ident()}"
             try:
-                native.copy_range(in_fd, out_fd, size)
-                return
+                native = _native()
+                if native is not None:
+                    size = os.path.getsize(self._data_path)
+                    in_fd = os.open(self._data_path, os.O_RDONLY)
+                    out_fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_EXCL,
+                                     0o644)
+                    try:
+                        native.copy_range(in_fd, out_fd, size)
+                    finally:
+                        os.close(in_fd)
+                        os.close(out_fd)
+                else:
+                    shutil.copyfile(self._data_path, tmp)
+                os.replace(tmp, dest)
             finally:
-                os.close(in_fd)
-                os.close(out_fd)
-        shutil.copyfile(self._data_path, dest)
+                if os.path.exists(tmp):
+                    os.unlink(tmp)

@@ -35,7 +35,23 @@ class StorageManager:
     def __init__(self, opt: StorageOption):
         self.opt = opt
         self._stores: dict[str, LocalTaskStore] = {}
+        # Optional serving-index observer (duck-typed): task_updated(store),
+        # piece_recorded(task_id, rec), task_deleted(task_id). The native
+        # upload server mirrors the piece map through these callbacks so it
+        # can serve without consulting Python per request. piece_recorded
+        # arrives from worker threads; implementations must be thread-safe.
+        self.observer = None
         os.makedirs(opt.data_dir, exist_ok=True)
+
+    def set_observer(self, observer) -> None:
+        """Attach the observer and replay current state (tasks + pieces)
+        so an index attached after reload starts complete."""
+        self.observer = observer
+        for store in self._stores.values():
+            store.observer = observer
+            observer.task_updated(store)
+            for rec in store.metadata.pieces.values():
+                observer.piece_recorded(store.metadata.task_id, rec)
 
     # -- paths -------------------------------------------------------------
 
@@ -56,6 +72,9 @@ class StorageManager:
                 return store
         store = LocalTaskStore.create(self._task_dir(metadata.task_id), metadata)
         self._stores[metadata.task_id] = store
+        if self.observer is not None:
+            store.observer = self.observer
+            self.observer.task_updated(store)
         return store
 
     def get(self, task_id: str) -> LocalTaskStore:
@@ -71,6 +90,8 @@ class StorageManager:
         store = self._stores.pop(task_id, None)
         if store is not None:
             store.destroy()
+            if self.observer is not None:
+                self.observer.task_deleted(task_id)
 
     def tasks(self) -> list[LocalTaskStore]:
         return list(self._stores.values())

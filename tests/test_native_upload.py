@@ -1,0 +1,170 @@
+"""Native upload server (native/src/dfupload.cc) HTTP contract.
+
+Must mirror the aiohttp implementation it replaces (daemon/upload.py):
+pieceNum → 200 whole piece, Range → 206 window, unknown task/piece → 404,
+uncovered range → 416, malformed input → 400, /healthy, /metrics. Driven
+through UploadManager so the StorageManager observer plumbing (registry
+mirroring, replay on attach, unregister on delete) is covered too.
+"""
+
+import asyncio
+import os
+import random
+
+import aiohttp
+import pytest
+
+from dragonfly2_tpu.daemon.upload import UploadManager
+from dragonfly2_tpu.storage.local_store import TaskStoreMetadata, _native
+from dragonfly2_tpu.storage.manager import StorageManager, StorageOption
+
+nb = _native()
+pytestmark = pytest.mark.skipif(nb is None, reason="native library unavailable")
+
+PIECE = 256 * 1024
+
+
+async def _boot(tmp_path):
+    storage = StorageManager(StorageOption(data_dir=str(tmp_path / "d")))
+    content = random.Random(9).randbytes(3 * PIECE + 1000)
+    store = storage.register_task(TaskStoreMetadata(
+        task_id="nup-task", content_length=len(content), piece_size=PIECE,
+        total_piece_count=4))
+    for n in range(3):  # piece 3 (the tail) deliberately missing
+        store.write_piece(n, content[n * PIECE:(n + 1) * PIECE])
+    upload = UploadManager(storage)
+    port = await upload.serve("127.0.0.1", 0)
+    assert upload._native_srv is not None, "native path expected"
+    return storage, store, content, upload, port
+
+
+def test_contract(run_async, tmp_path):
+    async def body():
+        storage, store, content, upload, port = await _boot(tmp_path)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as http:
+                # whole piece by number
+                async with http.get(f"{base}/download/nup/nup-task",
+                                    params={"peerId": "p", "pieceNum": "1"}) as r:
+                    assert r.status == 200
+                    assert await r.read() == content[PIECE:2 * PIECE]
+                # byte window via Range (within covered pieces)
+                async with http.get(
+                        f"{base}/download/nup/nup-task",
+                        headers={"Range": f"bytes=1000-{PIECE + 999}"}) as r:
+                    assert r.status == 206
+                    assert await r.read() == content[1000:PIECE + 1000]
+                # range crossing into the missing tail piece → 416
+                async with http.get(
+                        f"{base}/download/nup/nup-task",
+                        headers={"Range": f"bytes={2 * PIECE}-{3 * PIECE + 500}"}) as r:
+                    assert r.status == 416
+                # unknown piece / unknown task → 404
+                async with http.get(f"{base}/download/nup/nup-task",
+                                    params={"pieceNum": "3"}) as r:
+                    assert r.status == 404
+                async with http.get(f"{base}/download/nup/ghost",
+                                    params={"pieceNum": "0"}) as r:
+                    assert r.status == 404
+                # malformed input → 400
+                async with http.get(f"{base}/download/nup/nup-task",
+                                    params={"pieceNum": "zebra"}) as r:
+                    assert r.status == 400
+                async with http.get(f"{base}/download/nup/nup-task") as r:
+                    assert r.status == 400
+                # late-landing piece becomes servable via the observer
+                store.write_piece(3, content[3 * PIECE:])
+                async with http.get(f"{base}/download/nup/nup-task",
+                                    params={"pieceNum": "3"}) as r:
+                    assert r.status == 200
+                    assert await r.read() == content[3 * PIECE:]
+                # aux endpoints
+                async with http.get(f"{base}/healthy") as r:
+                    assert r.status == 200 and await r.text() == "ok"
+                async with http.get(f"{base}/metrics") as r:
+                    assert r.status == 200
+                    assert "upload_bytes_total" in await r.text()
+                counters = upload.native_counters()
+                # ok counts served pieces only (health probes excluded)
+                assert counters["ok"] >= 3 and counters["bytes_served"] > 0
+                # label parity with the aiohttp server: unknown task →
+                # not_found, known task with absent piece / uncovered
+                # range → piece_missing
+                assert counters["not_found"] >= 1
+                assert counters["piece_missing"] >= 2
+                # task deletion unregisters it from the serving index
+                storage.delete_task("nup-task")
+                async with http.get(f"{base}/download/nup/nup-task",
+                                    params={"pieceNum": "0"}) as r:
+                    assert r.status == 404
+        finally:
+            await upload.close()
+            storage.close()
+
+    run_async(body(), timeout=60)
+
+
+def test_native_engine_pulls_from_native_server(run_async, tmp_path):
+    """Both ends native: dfhttp.cc client fetching from dfupload.cc server,
+    crc verified against the store-advertised digest."""
+    from dragonfly2_tpu.daemon.peer.piece_downloader import PieceDownloader
+    from dragonfly2_tpu.storage.local_store import LocalTaskStore
+
+    async def body():
+        storage, store, content, upload, port = await _boot(tmp_path)
+        dst = LocalTaskStore.create(
+            str(tmp_path / "dst"),
+            TaskStoreMetadata(task_id="nup-task", peer_id="dst",
+                              content_length=len(content), piece_size=PIECE,
+                              total_piece_count=4))
+        dl = PieceDownloader()
+        try:
+            for n in range(3):
+                rec = store.metadata.pieces[n]
+                got = await dl.download_piece_to_store(
+                    "127.0.0.1", port, "nup-task", n, dst,
+                    expected_size=rec.size, expected_digest=rec.digest)
+                assert got is not None and got.digest == rec.digest
+            assert b"".join(dst.read_piece(n) for n in range(3)) == \
+                content[:3 * PIECE]
+        finally:
+            await dl.close()
+            await upload.close()
+            storage.close()
+
+    run_async(body(), timeout=60)
+
+
+def test_reload_replay_serves_restored_tasks(run_async, tmp_path):
+    """A daemon restart (storage.reload) followed by upload.serve must
+    replay restored tasks+pieces into the fresh native registry."""
+
+    async def body():
+        opt = StorageOption(data_dir=str(tmp_path / "d"))
+        storage = StorageManager(opt)
+        content = random.Random(3).randbytes(2 * PIECE)
+        store = storage.register_task(TaskStoreMetadata(
+            task_id="reload-task", content_length=len(content),
+            piece_size=PIECE, total_piece_count=2))
+        for n in range(2):
+            store.write_piece(n, content[n * PIECE:(n + 1) * PIECE])
+        store.mark_done()
+        storage.close()
+
+        fresh = StorageManager(opt)
+        assert fresh.reload() == 1
+        upload = UploadManager(fresh)
+        port = await upload.serve("127.0.0.1", 0)
+        try:
+            async with aiohttp.ClientSession() as http:
+                async with http.get(
+                        f"http://127.0.0.1:{port}/download/rel/reload-task",
+                        params={"pieceNum": "1"}) as r:
+                    assert r.status == 200
+                    assert await r.read() == content[PIECE:]
+        finally:
+            await upload.close()
+            fresh.close()
+
+    run_async(body(), timeout=60)
