@@ -136,6 +136,63 @@ def test_native_engine_pulls_from_native_server(run_async, tmp_path):
     run_async(body(), timeout=60)
 
 
+def test_throttle_gate_under_concurrency(run_async, tmp_path):
+    """concurrent_limit=1 with many simultaneous requests: the fetch_add
+    reservation means at most one transfer is active at a time — some
+    requests 429, every 200 succeeds bytes-exact, and the gate never
+    wedges (post-storm requests still serve)."""
+
+    async def body():
+        storage = StorageManager(StorageOption(data_dir=str(tmp_path / "d")))
+        content = random.Random(1).randbytes(4 * PIECE)
+        store = storage.register_task(TaskStoreMetadata(
+            task_id="gate-task", content_length=len(content),
+            piece_size=PIECE, total_piece_count=4))
+        for n in range(4):
+            store.write_piece(n, content[n * PIECE:(n + 1) * PIECE])
+        upload = UploadManager(storage, concurrent_limit=1)
+        port = await upload.serve("127.0.0.1", 0)
+        assert upload._native_srv is not None
+        url = f"http://127.0.0.1:{port}/download/gat/gate-task"
+        try:
+            async with aiohttp.ClientSession(
+                    connector=aiohttp.TCPConnector(limit=32)) as http:
+                async def one(i: int) -> int:
+                    async with http.get(url, params={
+                            "pieceNum": str(i % 4)}) as r:
+                        body_bytes = await r.read()
+                        if r.status == 200:
+                            want = content[(i % 4) * PIECE:
+                                           (i % 4 + 1) * PIECE]
+                            assert body_bytes == want
+                        return r.status
+
+                statuses = await asyncio.gather(*[one(i) for i in range(32)])
+                assert all(s in (200, 429) for s in statuses), statuses
+                assert statuses.count(200) >= 1
+                # Gate must not wedge: a follow-up request serves. Retry
+                # briefly — the server releases its slot only after the
+                # last response byte, so a straggling worker can still
+                # hold it when the storm's awaits complete.
+                extra_429 = 0
+                for _ in range(50):
+                    async with http.get(url, params={"pieceNum": "0"}) as r:
+                        if r.status == 200:
+                            break
+                        assert r.status == 429
+                        extra_429 += 1
+                    await asyncio.sleep(0.05)
+                else:
+                    raise AssertionError("gate wedged: follow-up never served")
+                counters = upload.native_counters()
+                assert counters["throttled"] == statuses.count(429) + extra_429
+        finally:
+            await upload.close()
+            storage.close()
+
+    run_async(body(), timeout=60)
+
+
 def test_reload_replay_serves_restored_tasks(run_async, tmp_path):
     """A daemon restart (storage.reload) followed by upload.serve must
     replay restored tasks+pieces into the fresh native registry."""
