@@ -142,28 +142,6 @@ class UploadManager:
         if self._runner is not None:
             await self._runner.cleanup()
 
-
-class _NativeServingIndex:
-    """StorageManager observer mirroring task/piece state into the native
-    upload server's registry. Pure ctypes calls guarded by the C side's
-    mutex — safe from any thread (piece commits arrive from workers)."""
-
-    def __init__(self, nb, srv: int):
-        self._nb = nb
-        self._srv = srv
-
-    def task_updated(self, store) -> None:
-        m = store.metadata
-        self._nb.upload_register_task(self._srv, m.task_id, store.data_path,
-                                      m.content_length, m.piece_size)
-
-    def piece_recorded(self, task_id: str, rec) -> None:
-        self._nb.upload_register_piece(self._srv, task_id, rec.num,
-                                       rec.offset, rec.size)
-
-    def task_deleted(self, task_id: str) -> None:
-        self._nb.upload_unregister_task(self._srv, task_id)
-
     # -- handlers ----------------------------------------------------------
 
     async def _download(self, request: web.Request) -> web.StreamResponse:
@@ -237,3 +215,25 @@ class _NativeServingIndex:
     async def _metrics(self, request: web.Request) -> web.Response:
         body, ctype = metrics.render()
         return web.Response(body=body, content_type=ctype.split(";")[0])
+
+
+class _NativeServingIndex:
+    """StorageManager observer mirroring task/piece state into the native
+    upload server's registry. Pure ctypes calls guarded by the C side's
+    mutex — safe from any thread (piece commits arrive from workers)."""
+
+    def __init__(self, nb, srv: int):
+        self._nb = nb
+        self._srv = srv
+
+    def task_updated(self, store) -> None:
+        m = store.metadata
+        self._nb.upload_register_task(self._srv, m.task_id, store.data_path,
+                                      m.content_length, m.piece_size)
+
+    def piece_recorded(self, task_id: str, rec) -> None:
+        self._nb.upload_register_piece(self._srv, task_id, rec.num,
+                                       rec.offset, rec.size)
+
+    def task_deleted(self, task_id: str) -> None:
+        self._nb.upload_unregister_task(self._srv, task_id)

@@ -193,6 +193,39 @@ def test_throttle_gate_under_concurrency(run_async, tmp_path):
     run_async(body(), timeout=60)
 
 
+def test_aiohttp_fallback_path_serves(run_async, tmp_path):
+    """Rate-limited configs force the aiohttp server even with the native
+    library present — pin that branch working (a class-scoping slip once
+    made its handlers unreachable and only native-disabled runs caught
+    it)."""
+
+    async def body():
+        storage = StorageManager(StorageOption(data_dir=str(tmp_path / "d")))
+        content = random.Random(4).randbytes(2 * PIECE)
+        store = storage.register_task(TaskStoreMetadata(
+            task_id="fb-task", content_length=len(content),
+            piece_size=PIECE, total_piece_count=2))
+        for n in range(2):
+            store.write_piece(n, content[n * PIECE:(n + 1) * PIECE])
+        upload = UploadManager(storage, rate_limit=1 << 30)
+        port = await upload.serve("127.0.0.1", 0)
+        assert upload._native_srv is None, "aiohttp fallback expected"
+        try:
+            async with aiohttp.ClientSession() as http:
+                async with http.get(
+                        f"http://127.0.0.1:{port}/download/fb/fb-task",
+                        params={"pieceNum": "1"}) as r:
+                    assert r.status in (200, 206)
+                    assert await r.read() == content[PIECE:]
+                async with http.get(f"http://127.0.0.1:{port}/healthy") as r:
+                    assert r.status == 200
+        finally:
+            await upload.close()
+            storage.close()
+
+    run_async(body(), timeout=60)
+
+
 def test_reload_replay_serves_restored_tasks(run_async, tmp_path):
     """A daemon restart (storage.reload) followed by upload.serve must
     replay restored tasks+pieces into the fresh native registry."""
