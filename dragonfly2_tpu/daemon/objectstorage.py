@@ -29,6 +29,8 @@ import asyncio
 
 from aiohttp import web
 
+from dragonfly2_tpu.daemon.transport import P2PTransport
+from dragonfly2_tpu.daemon.upload import _PieceFileResponse
 from dragonfly2_tpu.pkg import dflog, idgen, metrics
 from dragonfly2_tpu.pkg import digest as pkgdigest
 from dragonfly2_tpu.pkg.errors import DfError
@@ -149,6 +151,25 @@ class ObjectStorageService:
             headers["Content-Type"] = meta.content_type
         return web.Response(status=200, headers=headers)
 
+    @staticmethod
+    def _try_sendfile(attrs: dict, rng, total: int):
+        """Warm-path fast exit: a COMPLETED local store whose data file is
+        exactly the content serves via sendfile (zero Python byte handling)
+        instead of the piece iterator; eligibility is the shared
+        P2PTransport.sendfile_window predicate (also used by the proxy).
+        Returns (response, byte_count) or (None, 0). The response owns a
+        store pin until the send finishes (upload-server discipline)."""
+        window = P2PTransport.sendfile_window(attrs, rng, total)
+        if window is None:
+            return None, 0
+        store, offset, count = window
+        store.pin()
+        range_header = None
+        if rng is not None:
+            range_header = f"bytes={offset}-{offset + count - 1}"
+        return (_PieceFileResponse(store.data_path, range_header, store.unpin),
+                count)
+
     async def _get_object(self, request: web.Request) -> web.StreamResponse:
         """GET via the P2P fabric (reference :253 getObject → stream task)."""
         bucket, key = request.match_info["bucket"], request.match_info["key"]
@@ -164,6 +185,12 @@ class ObjectStorageService:
             raise web.HTTPBadGateway(text=f"p2p fetch failed: {e}")
         rng = attrs.get("range")
         total = attrs.get("content_length", -1)
+        sendfile_resp, sendfile_count = self._try_sendfile(attrs, rng, total)
+        if sendfile_resp is not None:
+            await body_iter.aclose()  # unstarted generator: no pin taken yet
+            OBJ_BYTES.labels("out").inc(sendfile_count)
+            OBJ_REQUESTS.labels("GET", "ok").inc()
+            return sendfile_resp
         if rng is not None and total < 0:
             # Ranged GET against an unknown-length origin (chunked source):
             # the range resolved, so the slice is satisfiable — stream it

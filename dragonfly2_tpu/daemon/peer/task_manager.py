@@ -665,6 +665,10 @@ class TaskManager:
             attrs = self._stream_attrs(store, task_id, peer_id, from_reuse=True)
             rng = self._resolve_range(req.range, attrs["content_length"])
             attrs["range"] = rng
+            # Completed-store reuse: expose the store so HTTP gateways can
+            # sendfile the window instead of iterating bytes through Python
+            # (daemon/objectstorage.py warm path).
+            attrs["local_store"] = store
             return attrs, self._stream_from_store(store, rng)
 
         # Ranged stream against a partially-downloaded task: serve straight
@@ -693,6 +697,7 @@ class TaskManager:
                 attrs = self._stream_attrs(store, task_id, peer_id, from_reuse=True)
                 rng = self._resolve_range(req.range, attrs["content_length"])
                 attrs["range"] = rng
+                attrs["local_store"] = store
                 return attrs, self._stream_from_store(store, rng)
             file_req = FileTaskRequest(
                 url=req.url, output="", meta=req.meta, peer_id=peer_id,

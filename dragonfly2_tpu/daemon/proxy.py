@@ -489,6 +489,27 @@ class Proxy:
             status = 200
             resp_len = total
             extra = ""
+        # Warm fast path: a completed local store serves via
+        # loop.sendfile straight off the page cache (fallback=True keeps
+        # TLS-hijacked tunnels working through the chunked-copy fallback).
+        window = P2PTransport.sendfile_window(attrs, rng, total)
+        if window is not None:
+            store, offset, count = window
+            await body_iter.aclose()  # unstarted generator: no pin yet
+            store.pin()
+            try:
+                writer.write(
+                    (f"HTTP/1.1 {status} OK\r\n{extra}"
+                     f"Content-Length: {count}\r\n\r\n").encode())
+                await writer.drain()
+                with open(store.data_path, "rb") as f:
+                    await asyncio.get_running_loop().sendfile(
+                        writer.transport, f, offset, count, fallback=True)
+            finally:
+                store.unpin()
+            PROXY_REQUESTS.labels("p2p").inc()
+            PROXY_BYTES.labels("p2p").inc(count)
+            return True
         sent = await self._write_body(writer, status, resp_len, extra, body_iter)
         PROXY_REQUESTS.labels("p2p").inc()
         PROXY_BYTES.labels("p2p").inc(sent)

@@ -8,6 +8,7 @@ async fetch() used by the proxy and the object-storage gateway.
 
 from __future__ import annotations
 
+import os
 import re
 from dataclasses import dataclass, field
 
@@ -87,6 +88,31 @@ class P2PTransport:
             if rule.matches(url):
                 return not rule.direct
         return bool(_BLOB_RE.search(url))
+
+    @staticmethod
+    def sendfile_window(attrs: dict, rng, total: int):
+        """(store, offset, count) when a fetch's response can be served by
+        sendfile off a COMPLETED local store — the warm fast path shared by
+        the proxy and the object gateway. None when the bytes must stream
+        through the piece iterator: no store exposed, unknown total, a
+        partial store whose file size differs from the content total
+        (Content-Range math would corrupt), or an empty window
+        (loop.sendfile rejects count=0, and a 0-byte body needs no fast
+        path). Callers own pin/unpin around the actual send."""
+        store = attrs.get("local_store")
+        if store is None or total < 0:
+            return None
+        try:
+            if os.path.getsize(store.data_path) != total:
+                return None
+        except OSError:
+            return None
+        if rng is None:
+            return (store, 0, total) if total > 0 else None
+        count = min(rng.length, max(total - rng.start, 0))
+        if count <= 0:
+            return None
+        return store, rng.start, count
 
     async def fetch(self, url: str, headers: dict[str, str] | None = None):
         """Fetch through the P2P fabric. Returns (attrs, body_iterator).

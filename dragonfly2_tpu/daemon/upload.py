@@ -43,9 +43,9 @@ class _PieceFileResponse(web.FileResponse):
     releasing in the handler would let GC rmtree the data file mid-
     sendfile."""
 
-    def __init__(self, path, range_header: str, release):
+    def __init__(self, path, range_header: str | None, release):
         super().__init__(path)
-        self._df_range = range_header
+        self._df_range = range_header  # None → whole file, plain 200
         self._df_prepared = False
         self._df_release = release
 
@@ -59,6 +59,10 @@ class _PieceFileResponse(web.FileResponse):
             return self._payload_writer
         self._df_prepared = True
         try:
+            if self._df_range is None:
+                headers = {k: v for k, v in request.headers.items()
+                           if k.lower() != "range"}
+                return await super().prepare(request.clone(headers=headers))
             cloned = request.clone(headers={**request.headers,
                                             "Range": self._df_range})
             return await super().prepare(cloned)

@@ -455,3 +455,48 @@ def test_gateway_ranged_get_unknown_length_origin(run_async, tmp_path):
             await svc.close()
 
     run_async(run())
+
+
+def test_gateway_warm_get_rides_sendfile(run_async, tmp_path, monkeypatch):
+    """Once a task is completed in the piece store, gateway GETs must take
+    the sendfile fast path (zero Python byte handling) with bytes-exact
+    whole and ranged responses — and partial/cold fetches must not."""
+
+    async def run():
+        hits = {"n": 0}
+        orig = ObjectStorageService._try_sendfile
+
+        def probe(attrs, rng, total):
+            r = orig(attrs, rng, total)
+            if r is not None:
+                hits["n"] += 1
+            return r
+
+        monkeypatch.setattr(ObjectStorageService, "_try_sendfile",
+                            staticmethod(probe))
+        svc, port, tm = await start_gateway(tmp_path)
+        store = Dfstore(f"http://127.0.0.1:{port}")
+        try:
+            await store.create_bucket("warm")
+            payload = os.urandom(2 * 1024 * 1024 + 77)
+            await store.put_object("warm", "blob.bin", payload,
+                                   mode="write_back")
+            got_cold = await store.get_object("warm", "blob.bin")
+            assert got_cold == payload
+            cold_hits = hits["n"]  # cold GET streams through the task
+            got_warm = await store.get_object("warm", "blob.bin")
+            assert got_warm == payload
+            assert hits["n"] == cold_hits + 1, "warm GET missed sendfile"
+            part = await store.get_object("warm", "blob.bin",
+                                          range_header="bytes=1000-4999")
+            assert part == payload[1000:5000]
+            assert hits["n"] == cold_hits + 2, "warm ranged GET missed sendfile"
+            # open-ended suffix range stays correct through the fast path
+            tail = await store.get_object("warm", "blob.bin",
+                                          range_header=f"bytes={len(payload)-500}-")
+            assert tail == payload[-500:]
+        finally:
+            await store.close()
+            await svc.close()
+
+    run_async(run())
