@@ -73,13 +73,17 @@ def _run_dfcache(args: argparse.Namespace) -> int:
 def _add_dfstore(sub: argparse._SubParsersAction) -> None:
     p = sub.add_parser("dfstore",
                        help="object-storage ops via the daemon gateway (reference client/dfstore)")
-    p.add_argument("op", choices=["cp", "rm", "stat", "ls", "mb", "rb"])
+    p.add_argument("op", choices=["cp", "rm", "stat", "ls", "mb", "rb",
+                                  "prefetch"])
     p.add_argument("args", nargs="*",
                    help="cp SRC DST (df://bucket/key or local path); "
-                        "rm/stat df://bucket/key; ls/mb/rb df://bucket")
+                        "rm/stat/prefetch df://bucket/key; ls/mb/rb df://bucket")
     p.add_argument("--endpoint", default="http://127.0.0.1:65004",
                    help="daemon object gateway endpoint")
     p.add_argument("--mode", default="async_write_back")
+    p.add_argument("--device", default="", choices=["", "tpu"],
+                   help="prefetch: additionally land the object in the "
+                        "daemon's TPU HBM sink (north-star --device=tpu)")
     p.set_defaults(func=_run_dfstore)
 
 
@@ -96,7 +100,8 @@ def _run_dfstore(args: argparse.Namespace) -> int:
 
     from dragonfly2_tpu.client.dfstore import Dfstore
 
-    required_args = {"cp": 2, "rm": 1, "stat": 1, "ls": 0, "mb": 1, "rb": 1}
+    required_args = {"cp": 2, "rm": 1, "stat": 1, "ls": 0, "mb": 1, "rb": 1,
+                     "prefetch": 1}
 
     async def run() -> int:
         if len(args.args) < required_args[args.op]:
@@ -124,6 +129,11 @@ def _run_dfstore(args: argparse.Namespace) -> int:
                 bucket, key = _parse_df_url(a[0])
                 await store.delete_object(bucket, key)
                 print("deleted")
+            elif args.op == "prefetch":
+                bucket, key = _parse_df_url(a[0])
+                result = await store.prefetch_object(bucket, key,
+                                                     device=args.device)
+                print(json.dumps(result))
             elif args.op == "stat":
                 bucket, key = _parse_df_url(a[0])
                 info = await store.stat_object(bucket, key)

@@ -133,6 +133,20 @@ class Dfstore:
         data = await self.get_object(bucket, src_key)
         await self.put_object(bucket, dst_key, data)
 
+    async def prefetch_object(self, bucket: str, key: str,
+                              device: str = "") -> dict:
+        """Warm the daemon's stores with an object without downloading it
+        here: piece store always, and with device="tpu" the daemon also
+        lands verified pieces in its HBM sink (dfstore --device=tpu).
+        Returns {state, task_id, content_length, device_verified, ...}."""
+        url = (f"{self.endpoint}/buckets/{quote(bucket, safe='')}"
+               f"/prefetch/{quote(key, safe='/')}")
+        params = {"device": device} if device else {}
+        async with self._http().post(url, params=params) as r:
+            if r.status != 200:
+                raise DfstoreError(await r.text(), r.status)
+            return await r.json()
+
     async def list_objects(self, bucket: str, prefix: str = "",
                            limit: int = 1000) -> list[ObjectInfo]:
         url = (f"{self.endpoint}/buckets/{quote(bucket, safe='')}/metadatas"

@@ -77,6 +77,7 @@ class ObjectStorageService:
                   allow_head=False)
         r.add_put("/buckets/{bucket}/objects/{key:.*}", self._put_object)
         r.add_delete("/buckets/{bucket}/objects/{key:.*}", self._delete_object)
+        r.add_post("/buckets/{bucket}/prefetch/{key:.*}", self._prefetch_object)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, host, port)
@@ -151,6 +152,61 @@ class ObjectStorageService:
             headers["Content-Type"] = meta.content_type
         return web.Response(status=200, headers=headers)
 
+    async def _prefetch_object(self, request: web.Request) -> web.Response:
+        """Pull an object into this daemon's stores without streaming it
+        back: piece store always; `?device=tpu` additionally lands verified
+        pieces in the HBM sink (the north star's dfstore --device=tpu —
+        a pod-wide webdataset/checkpoint warm-up never touches the client).
+        Same task identity as gateway GETs (url + tag=bucket), so later
+        GETs are warm hits."""
+        bucket, key = request.match_info["bucket"], request.match_info["key"]
+        device = request.query.get("device", "")
+        if device not in ("", "tpu"):
+            raise web.HTTPBadRequest(text=f"unknown device {device!r}")
+        from dragonfly2_tpu.daemon.peer.task_manager import FileTaskRequest
+        from dragonfly2_tpu.proto.common import UrlMeta
+
+        url = self.backend.object_url(bucket, key)
+        req = FileTaskRequest(url=url, output="",
+                              meta=UrlMeta(tag=bucket), device=device)
+
+        async def run_prefetch():
+            final = None
+            async for p in self.transport.task_manager.start_file_task(req):
+                final = p
+            return final
+
+        # Detached from the request lifetime: a client timeout/disconnect
+        # must NOT cancel the download (cancellation invalidates the
+        # partially-warmed store — the opposite of what prefetch is for).
+        # The shield keeps the task running in _background to completion.
+        fut = asyncio.ensure_future(run_prefetch())
+        self._background.add(fut)
+        fut.add_done_callback(self._background.discard)
+        try:
+            final = await asyncio.shield(fut)
+        except asyncio.CancelledError:
+            fut.add_done_callback(
+                lambda f: f.exception() if not f.cancelled() else None)
+            raise
+        except DfError as e:
+            OBJ_REQUESTS.labels("PREFETCH", "error").inc()
+            raise web.HTTPBadGateway(text=f"prefetch failed: {e}")
+        if final is None or final.state != "done":
+            OBJ_REQUESTS.labels("PREFETCH", "error").inc()
+            err = (final.error or {}) if final is not None else {}
+            raise web.HTTPBadGateway(
+                text=f"prefetch failed: {err.get('message', 'no result')}")
+        OBJ_REQUESTS.labels("PREFETCH", "ok").inc()
+        return web.json_response({
+            "state": final.state,
+            "task_id": final.task_id,
+            "content_length": final.content_length,
+            "from_reuse": final.from_reuse,
+            "from_p2p": final.from_p2p,
+            "device_verified": final.device_verified,
+        })
+
     @staticmethod
     def _try_sendfile(attrs: dict, rng, total: int):
         """Warm-path fast exit: a COMPLETED local store whose data file is
@@ -164,10 +220,20 @@ class ObjectStorageService:
             return None, 0
         store, offset, count = window
         store.pin()
+
+        def release() -> None:
+            # Runs when the send finishes (or aborts): counters record at
+            # response completion, matching the streaming path's timing.
+            # (Aborted sends still count the window size — FileResponse
+            # doesn't expose partial-send byte counts.)
+            store.unpin()
+            OBJ_BYTES.labels("out").inc(count)
+            OBJ_REQUESTS.labels("GET", "ok").inc()
+
         range_header = None
         if rng is not None:
             range_header = f"bytes={offset}-{offset + count - 1}"
-        return (_PieceFileResponse(store.data_path, range_header, store.unpin),
+        return (_PieceFileResponse(store.data_path, range_header, release),
                 count)
 
     async def _get_object(self, request: web.Request) -> web.StreamResponse:
@@ -188,8 +254,6 @@ class ObjectStorageService:
         sendfile_resp, sendfile_count = self._try_sendfile(attrs, rng, total)
         if sendfile_resp is not None:
             await body_iter.aclose()  # unstarted generator: no pin taken yet
-            OBJ_BYTES.labels("out").inc(sendfile_count)
-            OBJ_REQUESTS.labels("GET", "ok").inc()
             return sendfile_resp
         if rng is not None and total < 0:
             # Ranged GET against an unknown-length origin (chunked source):

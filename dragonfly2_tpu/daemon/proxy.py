@@ -495,8 +495,10 @@ class Proxy:
         window = P2PTransport.sendfile_window(attrs, rng, total)
         if window is not None:
             store, offset, count = window
-            await body_iter.aclose()  # unstarted generator: no pin yet
+            # Pin BEFORE any await: the aclose suspension would otherwise
+            # open a window for storage GC to reclaim the unpinned store.
             store.pin()
+            await body_iter.aclose()  # unstarted generator: holds no pin
             try:
                 writer.write(
                     (f"HTTP/1.1 {status} OK\r\n{extra}"

@@ -500,3 +500,49 @@ def test_gateway_warm_get_rides_sendfile(run_async, tmp_path, monkeypatch):
             await svc.close()
 
     run_async(run())
+
+
+def test_gateway_prefetch_and_device_sink(run_async, tmp_path):
+    """dfstore prefetch warms the daemon's piece store without streaming
+    bytes to the client, and `device=tpu` additionally lands the object in
+    the HBM sink with on-device verification (north-star dfstore
+    --device=tpu; CPU jax backend in tests)."""
+    from dragonfly2_tpu.daemon.peer.device_sink import DeviceSinkManager
+    from dragonfly2_tpu.daemon.peer.task_manager import TaskManager
+
+    async def run():
+        backend = FSObjectStorage(root=str(tmp_path / "buckets"))
+        storage = StorageManager(StorageOption(data_dir=str(tmp_path / "p2p")))
+        sinks = DeviceSinkManager()
+        tm = TaskManager(storage, PieceManager(PieceManagerOption(concurrency=2)),
+                         device_sinks=sinks)
+        svc = ObjectStorageService(backend, P2PTransport(tm))
+        port = await svc.serve("127.0.0.1", 0)
+        store = Dfstore(f"http://127.0.0.1:{port}")
+        try:
+            await store.create_bucket("warmup")
+            payload = os.urandom((1 << 20) + 33)
+            await store.put_object("warmup", "shard.tar", payload,
+                                   mode="write_back")
+            result = await store.prefetch_object("warmup", "shard.tar",
+                                                 device="tpu")
+            assert result["state"] == "done", result
+            assert result["device_verified"] is True, result
+            assert result["content_length"] == len(payload)
+            # The piece store is warm: a GET must not touch the backend's
+            # object_url again... it rides reuse (from_reuse on 2nd prefetch).
+            again = await store.prefetch_object("warmup", "shard.tar")
+            assert again["from_reuse"] is True
+            got = await store.get_object("warmup", "shard.tar")
+            assert got == payload
+            # Unknown object → 502 with a coded message, not a hang.
+            with pytest.raises(DfstoreError) as exc:
+                await store.prefetch_object("warmup", "ghost.tar")
+            assert exc.value.status == 502
+        finally:
+            await store.close()
+            await svc.close()
+            sinks.close()
+            storage.close()
+
+    run_async(run())
