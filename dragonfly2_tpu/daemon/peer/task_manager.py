@@ -590,10 +590,23 @@ class TaskManager:
         is_seed = spec.get("seed", True)
         req = FileTaskRequest(url=spec.get("url", ""), output="", meta=meta,
                               disable_back_source=bool(
-                                  spec.get("disable_back_source")))
+                                  spec.get("disable_back_source")),
+                              device=spec.get("device", ""))
         task_id = spec.get("task_id") or req.task_id()
-        if task_id in self._running:
-            return  # already seeding
+        running = self._running.get(task_id)
+        if running is not None:
+            # Already seeding. A device=tpu trigger must still land the
+            # content in HBM (device is not part of the task identity, so a
+            # plain seed in flight would otherwise silently swallow it):
+            # wait for the running download, then finalize the sink.
+            if req.device != "tpu":
+                return
+            await running.done.wait()
+            if running.error is None:
+                store = self.storage.find_completed_task(task_id)
+                if store is not None:
+                    await self._finalize_device_for_seed(req, task_id, store)
+            return
         peer_id = (idgen.seed_peer_id_v1(self.host_ip) if is_seed
                    else idgen.peer_id_v1(self.host_ip))
 
@@ -608,12 +621,18 @@ class TaskManager:
             await self._run_download(task_id, peer_id, req, store, None,
                                      is_seed=is_seed)
             store.mark_done()
+            # Preheat-to-device (spec device="tpu"): verify the HBM copy
+            # after the disk result is final.
+            device_verified = await self._finalize_device_for_seed(
+                req, task_id, store)
             self._pex_announce(task_id)
             self.broker.publish(task_id, PieceEvent(
                 [], store.metadata.total_piece_count, store.metadata.content_length,
                 store.metadata.piece_size, done=True))
             log.info("seed task complete", task_id=task_id[:16],
-                     pieces=len(store.metadata.pieces))
+                     pieces=len(store.metadata.pieces),
+                     **({"device_verified": device_verified}
+                        if req.device else {}))
         except Exception as e:
             log.error("seed task failed", error=describe(e))
             store.mark_invalid()
@@ -910,6 +929,20 @@ class TaskManager:
         resident sink could otherwise shadow a later retry's bytes."""
         if req.device and self.device_sinks is not None:
             self.device_sinks.discard(task_id)
+
+    async def _finalize_device_for_seed(self, req: "FileTaskRequest",
+                                        task_id: str, store) -> bool:
+        """Seed/preheat variant of _finalize_device: device-copy corruption
+        must NOT fail the task — the disk result is already digest-verified
+        and peers depend on it (the finalize contract: fail only a
+        requesting stream, and a preheat has none). Degrades to disk-only
+        warm-up, loudly."""
+        try:
+            return await self._finalize_device(req, task_id, store)
+        except DfError as e:
+            log.error("device sink verify failed; disk warm-up stands",
+                      task_id=task_id[:16], error=str(e))
+            return False
 
     async def _finalize_device(self, req: "FileTaskRequest", task_id: str,
                                store) -> bool:

@@ -179,7 +179,10 @@ UNARY: dict[str, Msg] = {
         url=F(str, required=True), task_id=F(str), tag=F(str),
         application=F(str), digest=F(str), header=F(dict),
         filters=F(list, item=F(str)), seed=F(bool),
-        disable_back_source=F(bool)),
+        disable_back_source=F(bool),
+        # preheat-to-device: "tpu" additionally lands the content in the
+        # triggered daemon's HBM sink (north-star pod-wide warm-up)
+        device=F(str)),
     "Peer.StatTask": Msg("PeerStatTask", task_id=F(str, required=True)),
     "Peer.DeleteTask": Msg("PeerDeleteTask", task_id=F(str, required=True)),
 

@@ -134,6 +134,11 @@ class JobWorker:
                 "application": application,
                 "filters": idgen.parse_filtered_query_params(filters),
                 "header": args.get("headers") or {},
+                # device="tpu": every triggered daemon also lands the
+                # content in its HBM sink — the pod-wide weight broadcast
+                # that never touches host NVMe (north star). Daemons
+                # without a sink degrade to disk-only warm-up.
+                "device": args.get("device", ""),
             }
             # Concurrent fan-out: unreachable hosts cost one RPC timeout in
             # total, not one per host (reference preheatAllPeers fans via

@@ -318,3 +318,85 @@ def test_stale_sink_rebuilt_when_store_content_changed(run_async, tmp_path):
             mgr.close()
 
     run_async(body(), timeout=60)
+
+
+def test_preheat_trigger_lands_in_device_sink(run_async, tmp_path):
+    """Pod-wide preheat-to-HBM (north star): a TriggerDownloadTask spec
+    with device="tpu" — what the scheduler's preheat job sends when the
+    manager job carries device — makes the triggered daemon back-to-source
+    the content AND land it verified in its HBM sink. Daemons without a
+    sink degrade to disk-only warm-up."""
+
+    async def body():
+        origin, oport, stats = await start_origin()
+        sched = await start_scheduler()
+        url = f"http://127.0.0.1:{oport}/blob"
+        daemons = []
+        try:
+            sink_peer = await _start_sink_daemon(tmp_path, "sink-peer",
+                                                 sched.port(), seed=True)
+            plain_peer = await e2e.start_daemon(tmp_path, "plain-peer",
+                                                sched.port())
+            daemons += [sink_peer, plain_peer]
+            spec = {"url": url, "device": "tpu"}
+            # Trigger both directly (the scheduler preheat job fans this
+            # exact spec to every target daemon).
+            await sink_peer.task_manager.start_seed_task(dict(spec))
+            await plain_peer.task_manager.start_seed_task(dict(spec))
+
+            from dragonfly2_tpu.pkg import idgen
+            task_id = idgen.task_id_v1(url)
+            # Sink daemon: content is on disk AND verified in HBM.
+            store = sink_peer.storage.find_completed_task(task_id)
+            assert store is not None and store.metadata.done
+            sink = sink_peer.task_manager.device_sinks._sinks.get(task_id)
+            assert sink is not None and sink.verified
+            landed = bytes(np.asarray(sink.as_bytes_array()))
+            assert landed == CONTENT
+            # Plain daemon: disk-only warm-up, no failure.
+            store2 = plain_peer.storage.find_completed_task(task_id)
+            assert store2 is not None and store2.metadata.done
+        finally:
+            for d in daemons:
+                await d.stop()
+            await sched.stop()
+            await origin.cleanup()
+
+    run_async(body(), timeout=120)
+
+
+def test_device_trigger_dedups_onto_running_plain_seed(run_async, tmp_path):
+    """A device=tpu trigger arriving while a PLAIN seed of the same task is
+    in flight must wait for it and still land the content in HBM (device
+    is not part of the task identity, so the dedup path must not swallow
+    the device request)."""
+    import asyncio
+
+    async def body():
+        origin, oport, stats = await start_origin()
+        sched = await start_scheduler()
+        url = f"http://127.0.0.1:{oport}/blob"
+        daemons = []
+        try:
+            d = await _start_sink_daemon(tmp_path, "dedup-sink", sched.port(),
+                                         seed=True)
+            daemons.append(d)
+            plain = asyncio.ensure_future(
+                d.task_manager.start_seed_task({"url": url}))
+            await asyncio.sleep(0)  # let the plain seed claim _running
+            await d.task_manager.start_seed_task({"url": url,
+                                                  "device": "tpu"})
+            await plain
+
+            from dragonfly2_tpu.pkg import idgen
+            task_id = idgen.task_id_v1(url)
+            sink = d.task_manager.device_sinks._sinks.get(task_id)
+            assert sink is not None and sink.verified
+            assert bytes(np.asarray(sink.as_bytes_array())) == CONTENT
+        finally:
+            for dd in daemons:
+                await dd.stop()
+            await sched.stop()
+            await origin.cleanup()
+
+    run_async(body(), timeout=120)
