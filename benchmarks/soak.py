@@ -1,0 +1,187 @@
+"""Fabric soak: many sequential tasks through a live seed+peers fabric,
+watching for resource drift.
+
+The churn/stress tests cover scheduler logic and single HTTP surfaces;
+this drives the WHOLE fabric (scheduler + seed + N peers, real processes)
+through many distinct tasks and asserts the things that only show up over
+time: every task sha-exact, origin economy held per task, and no fd /
+memory / task-store drift in the daemons (native connection pools, device
+buffers and piece stores must all reap).
+
+Usage: python benchmarks/soak.py [--tasks 30] [--mb 16] [--peers 2]
+Prints one JSON line with per-task stats and before/after fd+RSS of every
+daemon.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import hashlib
+import json
+import os
+import random
+import signal
+import statistics
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from aiohttp import web  # noqa: E402
+
+from dragonfly2_tpu.pkg.piece import Range  # noqa: E402
+from benchmarks.fanout_bench import _free_port, _spawn, _wait_sock  # noqa: E402
+
+
+def _proc_stats(pid: int) -> dict:
+    try:
+        fds = len(os.listdir(f"/proc/{pid}/fd"))
+        with open(f"/proc/{pid}/status") as f:
+            rss_kb = next(int(line.split()[1]) for line in f
+                          if line.startswith("VmRSS:"))
+        return {"fds": fds, "rss_mb": round(rss_kb / 1024, 1)}
+    except (OSError, StopIteration):
+        return {"fds": -1, "rss_mb": -1}
+
+
+async def run_soak(n_tasks: int, task_mb: int, n_peers: int,
+                   workdir: str, settle_s: float = 1.0) -> dict:
+    rng = random.Random(123)
+    blobs = {f"/blob{i}": rng.randbytes(task_mb << 20) for i in range(n_tasks)}
+    shas = {p: hashlib.sha256(b).hexdigest() for p, b in blobs.items()}
+    origin_bytes = {"n": 0}
+
+    async def blob(request: web.Request) -> web.Response:
+        content = blobs[request.path]
+        r = request.headers.get("Range")
+        if r:
+            rr = Range.parse_http(r, len(content))
+            data = content[rr.start:rr.start + rr.length]
+            origin_bytes["n"] += len(data)
+            return web.Response(status=206, body=data, headers={
+                "Accept-Ranges": "bytes",
+                "Content-Range":
+                    f"bytes {rr.start}-{rr.start + rr.length - 1}/{len(content)}"})
+        origin_bytes["n"] += len(content)
+        return web.Response(body=content, headers={"Accept-Ranges": "bytes"})
+
+    app = web.Application()
+    for path in blobs:
+        app.router.add_get(path, blob)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    oport = site._server.sockets[0].getsockname()[1]
+
+    sched_port = _free_port()
+    names = ["seed"] + [f"peer{i}" for i in range(n_peers)]
+    homes = {n: os.path.join(workdir, n) for n in names}
+    procs: dict[str, subprocess.Popen] = {}
+    try:
+        procs["sched"] = _spawn(
+            ["scheduler", "--host", "127.0.0.1", "--port", str(sched_port)],
+            os.path.join(workdir, "sched.log"))
+        procs["seed"] = _spawn(
+            ["daemon", "--work-home", homes["seed"], "--seed-peer",
+             "--scheduler", f"127.0.0.1:{sched_port}"],
+            os.path.join(workdir, "seed.log"))
+        for i in range(n_peers):
+            procs[f"peer{i}"] = _spawn(
+                ["daemon", "--work-home", homes[f"peer{i}"],
+                 "--scheduler", f"127.0.0.1:{sched_port}"],
+                os.path.join(workdir, f"peer{i}.log"))
+        for n in names:
+            ok = await asyncio.to_thread(
+                _wait_sock, os.path.join(homes[n], "run", "dfdaemon.sock"))
+            if not ok:
+                raise RuntimeError(f"{n} did not come up")
+
+        # Let imports/announce settle before the before-snapshot.
+        await asyncio.sleep(2)
+        before = {n: _proc_stats(p.pid) for n, p in procs.items()}
+
+        from dragonfly2_tpu.client import dfget as dfget_lib
+        from dragonfly2_tpu.proto.common import UrlMeta
+
+        walls: list[float] = []
+        total_expected = 0
+        t0 = time.perf_counter()
+        for i, path in enumerate(blobs):
+            url = f"http://127.0.0.1:{oport}{path}"
+            peer = f"peer{i % n_peers}"
+            out = os.path.join(workdir, "out.bin")
+            t1 = time.perf_counter()
+            result = await dfget_lib.download(dfget_lib.DfgetConfig(
+                url=url, output=out,
+                daemon_sock=os.path.join(homes[peer], "run", "dfdaemon.sock"),
+                meta=UrlMeta(digest=f"sha256:{shas[path]}"),
+                allow_source_fallback=False, timeout=120.0))
+            walls.append(time.perf_counter() - t1)
+            if result.get("state") != "done":
+                raise RuntimeError(f"task {i} failed: {result}")
+            with open(out, "rb") as f:
+                if hashlib.file_digest(f, "sha256").hexdigest() != shas[path]:
+                    raise RuntimeError(f"task {i} sha mismatch")
+            os.unlink(out)
+            total_expected += len(blobs[path])
+        wall = time.perf_counter() - t0
+
+        # settle > the daemons' 60s gc_interval demonstrates fd reaping
+        # (idle stores drop their data-file fd at GC time); the default
+        # short settle shows the hot-window drift instead.
+        await asyncio.sleep(settle_s)
+        after = {n: _proc_stats(p.pid) for n, p in procs.items()}
+        walls.sort()
+        return {
+            "config": "fabric-soak",
+            "tasks": n_tasks,
+            "task_mb": task_mb,
+            "peers": n_peers,
+            "wall_s": round(wall, 2),
+            "task_p50_s": round(statistics.median(walls), 3),
+            "task_max_s": round(walls[-1], 3),
+            # one origin copy per task (each peer pulls via the seed)
+            "origin_ratio": round(origin_bytes["n"] / total_expected, 3),
+            "proc_before": before,
+            "proc_after": after,
+            "fd_drift": {n: after[n]["fds"] - before[n]["fds"]
+                         for n in procs},
+            "host_cores": os.cpu_count(),
+        }
+    finally:
+        for p in procs.values():
+            p.send_signal(signal.SIGTERM)
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        await runner.cleanup()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tasks", type=int, default=30)
+    ap.add_argument("--mb", type=int, default=16)
+    ap.add_argument("--peers", type=int, default=2)
+    ap.add_argument("--workdir", default="")
+    ap.add_argument("--settle", type=float, default=1.0,
+                    help="seconds before the after-snapshot; >130 rides "
+                         "past two GC cycles and shows fd reaping")
+    args = ap.parse_args()
+
+    import tempfile
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="df-soak-")
+    result = asyncio.run(run_soak(args.tasks, args.mb, args.peers, workdir,
+                                  settle_s=args.settle))
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

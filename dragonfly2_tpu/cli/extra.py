@@ -84,10 +84,10 @@ def _add_dfstore(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--device", default="", choices=["", "tpu"],
                    help="prefetch: additionally land the object in the "
                         "daemon's TPU HBM sink (north-star --device=tpu)")
-    p.add_argument("--timeout", type=float, default=0,
+    p.add_argument("--timeout", type=float, default=None,
                    help="client timeout seconds (default 60; prefetch "
                         "defaults to 3600 — it blocks until the daemon "
-                        "finishes the warm-up)")
+                        "finishes the warm-up; 0 = no timeout)")
     p.set_defaults(func=_run_dfstore)
 
 
@@ -112,7 +112,10 @@ def _run_dfstore(args: argparse.Namespace) -> int:
             print(f"dfstore {args.op}: expected {required_args[args.op]} "
                   f"argument(s), got {len(args.args)}")
             return 2
-        timeout = args.timeout or (3600.0 if args.op == "prefetch" else 60.0)
+        if args.timeout is None:
+            timeout = 3600.0 if args.op == "prefetch" else 60.0
+        else:
+            timeout = args.timeout  # 0 = unbounded (Dfstore maps it to None)
         store = Dfstore(args.endpoint, timeout=timeout)
         try:
             a = args.args

@@ -35,7 +35,8 @@ class Dfstore:
 
     def __init__(self, endpoint: str, *, timeout: float = 60.0):
         self.endpoint = endpoint.rstrip("/")
-        self.timeout = aiohttp.ClientTimeout(total=timeout)
+        # timeout 0 = unbounded (long prefetch warm-ups).
+        self.timeout = aiohttp.ClientTimeout(total=timeout or None)
         self._session: aiohttp.ClientSession | None = None
 
     def _http(self) -> aiohttp.ClientSession:

@@ -621,14 +621,15 @@ class TaskManager:
             await self._run_download(task_id, peer_id, req, store, None,
                                      is_seed=is_seed)
             store.mark_done()
-            # Preheat-to-device (spec device="tpu"): verify the HBM copy
-            # after the disk result is final.
-            device_verified = await self._finalize_device_for_seed(
-                req, task_id, store)
+            # Disk result is final: announce and publish FIRST (peers and
+            # dedup waiters must not stall behind the HBM backfill — the
+            # device copy cannot affect the disk result either way).
             self._pex_announce(task_id)
             self.broker.publish(task_id, PieceEvent(
                 [], store.metadata.total_piece_count, store.metadata.content_length,
                 store.metadata.piece_size, done=True))
+            device_verified = await self._finalize_device_for_seed(
+                req, task_id, store)
             log.info("seed task complete", task_id=task_id[:16],
                      pieces=len(store.metadata.pieces),
                      **({"device_verified": device_verified}
@@ -938,7 +939,8 @@ class TaskManager:
         requesting stream, and a preheat has none). Degrades to disk-only
         warm-up, loudly."""
         try:
-            return await self._finalize_device(req, task_id, store)
+            with store:  # pin: finalize preads run in executor threads
+                return await self._finalize_device(req, task_id, store)
         except DfError as e:
             log.error("device sink verify failed; disk warm-up stands",
                       task_id=task_id[:16], error=str(e))

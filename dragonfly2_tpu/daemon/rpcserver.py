@@ -224,8 +224,12 @@ class DaemonRpcServer:
         task_id = spec.get("task_id", "")
         already = bool(task_id and
                        self.task_manager.storage.find_completed_task(task_id) is not None)
-        if not (task_id and self.task_manager.is_task_running(task_id)):
+        if (spec.get("device") == "tpu"
+                or not (task_id and self.task_manager.is_task_running(task_id))):
             # Runs even when complete: the announce-only fast path re-reports
             # local pieces so the scheduler can hand this seed out as parent.
+            # device=tpu triggers ALWAYS enter start_seed_task — its dedup
+            # waits for an in-flight plain seed and still lands the HBM
+            # copy; skipping here would swallow the device request.
             aio.spawn(self.task_manager.start_seed_task(spec))
         return {"ok": True, "already_complete": already}

@@ -172,9 +172,12 @@ class LocalTaskStore:
         return self._fd
 
     def close(self) -> None:
-        if self._fd is not None:
-            os.close(self._fd)
-            self._fd = None
+        # Under _meta_lock: serializes with _ensure_fd's lazy reopen — GC
+        # now closes idle stores' fds mid-life, not only at destroy time.
+        with self._meta_lock:
+            if self._fd is not None:
+                os.close(self._fd)
+                self._fd = None
 
     def destroy(self) -> None:
         self.close()

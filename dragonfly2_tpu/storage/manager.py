@@ -159,6 +159,14 @@ class StorageManager:
             if m.invalid or (now - m.last_access) > self.opt.task_ttl:
                 self.delete_task(task_id)
                 reclaimed.append(task_id)
+                continue
+            # Idle stores drop their data-file fd (reopened lazily on the
+            # next read): without this, a long-lived daemon holds one fd
+            # per task it has EVER served until the TTL delete — the soak
+            # tool (benchmarks/soak.py) measures exactly this drift. The
+            # native upload server is unaffected: it opens per request.
+            if now - m.last_access > self.opt.gc_interval:
+                store.close()
         if self.opt.disk_gc_threshold > 0:
             usage = sum(s.disk_usage() for s in self._stores.values())
             if usage > self.opt.disk_gc_threshold:

@@ -384,9 +384,21 @@ def test_device_trigger_dedups_onto_running_plain_seed(run_async, tmp_path):
             plain = asyncio.ensure_future(
                 d.task_manager.start_seed_task({"url": url}))
             await asyncio.sleep(0)  # let the plain seed claim _running
-            await d.task_manager.start_seed_task({"url": url,
-                                                  "device": "tpu"})
+            # Through the WIRE handler (not task_manager directly): the
+            # RPC-level is_task_running shortcut must not swallow a
+            # device trigger while the plain seed is in flight.
+            resp = await d.rpc._trigger_download(
+                {"url": url, "device": "tpu"}, None)
+            assert resp["ok"]
             await plain
+            # the spawned device trigger finalizes after the plain seed
+            for _ in range(100):
+                from dragonfly2_tpu.pkg import idgen as _idgen
+                sk = d.task_manager.device_sinks._sinks.get(
+                    _idgen.task_id_v1(url))
+                if sk is not None and sk.verified:
+                    break
+                await asyncio.sleep(0.05)
 
             from dragonfly2_tpu.pkg import idgen
             task_id = idgen.task_id_v1(url)

@@ -222,3 +222,36 @@ def test_concurrent_writes_and_reads_threadsafe(tmp_path):
     assert not errors, errors
     assert len(store.metadata.pieces) == total
     assert store.is_complete()
+
+
+def test_gc_closes_idle_store_fds(tmp_path):
+    """Idle (but un-expired) stores drop their data-file fd at GC time and
+    reopen lazily — a long-lived daemon must not hold one fd per task it
+    ever served (benchmarks/soak.py measures the drift)."""
+    import time as _time
+
+    from dragonfly2_tpu.storage.manager import StorageManager, StorageOption
+
+    mgr = StorageManager(StorageOption(data_dir=str(tmp_path / "d"),
+                                       task_ttl=3600.0, gc_interval=10.0))
+    store = mgr.register_task(TaskStoreMetadata(
+        task_id="fd-task", content_length=8, piece_size=8,
+        total_piece_count=1))
+    store.write_piece(0, b"12345678")
+    assert store._fd is not None
+    # Fresh store: GC must keep the fd (recently used).
+    mgr.gc()
+    assert store._fd is not None
+    # Idle past gc_interval but under TTL: fd closes, store survives.
+    store.metadata.last_access = _time.time() - 60
+    mgr.gc()
+    assert store._fd is None
+    assert mgr.try_get("fd-task") is store
+    # Lazy reopen serves reads.
+    assert store.read_piece(0) == b"12345678"
+    # Pinned stores are never touched.
+    store.metadata.last_access = _time.time() - 60
+    with store:
+        mgr.gc()
+        assert store._fd is not None
+    mgr.close()
