@@ -173,6 +173,8 @@ def main() -> int:
                     help="seconds before the after-snapshot; >130 rides "
                          "past two GC cycles and shows fd reaping")
     args = ap.parse_args()
+    if args.peers < 1:
+        ap.error("--peers must be >= 1")
 
     import tempfile
 

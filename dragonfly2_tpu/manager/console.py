@@ -50,6 +50,7 @@ INDEX_HTML = """<!doctype html>
   <form onsubmit="return createPreheat(this)">
     <select name="ptype"><option>file</option><option>image</option></select>
     <input name="url" placeholder="preheat url" size="40" required>
+    <label><input type="checkbox" name="device"> land in TPU HBM</label>
     <button>trigger preheat</button> <span class="err" id="job-msg"></span>
   </form>
   <h2>users &amp; roles</h2>
@@ -95,7 +96,8 @@ function createCluster(f) {
 }
 function createPreheat(f) {
   return formAction("job-msg", () => post("jobs",
-      {type: "preheat", args: {type: f.ptype.value, url: f.url.value}}));
+      {type: "preheat", args: {type: f.ptype.value, url: f.url.value,
+                               device: f.device.checked ? "tpu" : ""}}));
 }
 function createUser(f) {
   return formAction("user-msg", () => post("users/signup",

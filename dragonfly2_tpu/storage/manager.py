@@ -29,6 +29,10 @@ class StorageOption:
     disk_gc_threshold: int = 0               # bytes; 0 = unlimited
     keep_storage: bool = False               # survive daemon exit without GC
     gc_interval: float = 60.0
+    # Idle time before an un-expired store drops its data-file fd (lazily
+    # reopened). 0 = follow gc_interval; decoupled so operators can speed
+    # up TTL sweeps without making warm stores thrash open()/close().
+    fd_idle_close: float = 0.0
 
 
 class StorageManager:
@@ -165,7 +169,8 @@ class StorageManager:
             # per task it has EVER served until the TTL delete — the soak
             # tool (benchmarks/soak.py) measures exactly this drift. The
             # native upload server is unaffected: it opens per request.
-            if now - m.last_access > self.opt.gc_interval:
+            idle_close = self.opt.fd_idle_close or self.opt.gc_interval
+            if now - m.last_access > idle_close:
                 store.close()
         if self.opt.disk_gc_threshold > 0:
             usage = sum(s.disk_usage() for s in self._stores.values())
