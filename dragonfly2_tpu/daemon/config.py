@@ -72,6 +72,9 @@ class StorageOpt:
     disk_gc_threshold: int = 0
     keep_storage: bool = True
     write_buffer_size: int = 4 << 20
+    # Idle seconds before an un-expired store drops its data-file fd
+    # (lazily reopened). 0 = follow gc_interval.
+    fd_idle_close: float = 0.0
 
 
 @dataclass

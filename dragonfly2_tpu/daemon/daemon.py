@@ -46,6 +46,7 @@ class Daemon:
                 disk_gc_threshold=config.storage.disk_gc_threshold,
                 keep_storage=config.storage.keep_storage,
                 gc_interval=config.gc_interval,
+                fd_idle_close=config.storage.fd_idle_close,
             )
         )
         self.storage.reload()

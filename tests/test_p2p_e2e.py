@@ -246,3 +246,63 @@ def test_dispatcher_peek_does_not_reserve():
     a1 = d.try_get()
     a2 = d.try_get()
     assert {a1.piece_num, a2.piece_num} == {0, 1}  # both still assignable
+
+
+def test_seed_death_mid_transfer_peers_recover(run_async, tmp_path):
+    """Resilience: the seed daemon dies while peers are mid-download. Peers
+    must still finish sha-exact — rescheduling onto each other for pieces
+    already spread, and a bounded back-to-source for the remainder (the
+    reference e2e counts pod restarts for the same reason)."""
+
+    async def body():
+        origin, oport, stats = await start_origin()
+        sched = await start_scheduler()
+        url = f"http://127.0.0.1:{oport}/blob"
+        daemons = []
+        try:
+            # Rate-limit the seed's serving (this also selects the
+            # limiter-honoring aiohttp upload path over the native server)
+            # so the kill deterministically lands mid-transfer.
+            seed_cfg = daemon_config(tmp_path, "seed", sched.port(), seed=True)
+            seed_cfg.upload.rate_limit = 4 * 1024 * 1024
+            seed = Daemon(seed_cfg)
+            await seed.start()
+            daemons.append(seed)  # killer() stops it; stop() is idempotent
+            daemons.append(p1 := await start_daemon(tmp_path, "p1", sched.port()))
+            daemons.append(p2 := await start_daemon(tmp_path, "p2", sched.port()))
+
+            async def killer():
+                # Wait until at least one peer has a piece, then kill.
+                for _ in range(200):
+                    for d in (p1, p2):
+                        for s in d.storage.tasks():
+                            if s.metadata.pieces:
+                                await seed.stop()
+                                return
+                    await asyncio.sleep(0.02)
+                await seed.stop()  # nothing landed; kill anyway
+
+            kill_task = asyncio.ensure_future(killer())
+            try:
+                results = await asyncio.gather(
+                    dfget_via(p1, url, str(tmp_path / "k1.bin")),
+                    dfget_via(p2, url, str(tmp_path / "k2.bin")))
+                await kill_task
+            finally:
+                kill_task.cancel()
+            for i, r in enumerate(results):
+                assert r["state"] == "done", r
+                got = (tmp_path / f"k{i + 1}.bin").read_bytes()
+                assert hashlib.sha256(got).hexdigest() == SHA.split(":")[1]
+            # Recovery is allowed to re-touch origin, but boundedly: the
+            # seed's partial fetch plus at most one remainder per peer
+            # (BOTH peers may legitimately demote if they stall at the
+            # same instant — the scheduler allows it).
+            assert stats["blob_bytes"] <= 3 * len(CONTENT) + (1 << 20), stats
+        finally:
+            for d in daemons:
+                await d.stop()
+            await sched.stop()
+            await origin.cleanup()
+
+    run_async(body(), timeout=120)
