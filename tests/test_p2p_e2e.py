@@ -82,7 +82,9 @@ async def start_daemon(tmp_path, name, scheduler_port, *, seed=False) -> Daemon:
     return d
 
 
-async def dfget_via(daemon: Daemon, url: str, out: str, digest: str = SHA) -> dict:
+async def dfget_via(daemon: Daemon, url: str, out: str, digest: str = SHA,
+                    *, allow_source_fallback: bool = False,
+                    timeout: float = 60.0) -> dict:
     from dragonfly2_tpu.proto.common import UrlMeta
 
     return await dfget_lib.download(
@@ -90,8 +92,8 @@ async def dfget_via(daemon: Daemon, url: str, out: str, digest: str = SHA) -> di
             url=url, output=out,
             daemon_sock=daemon.config.unix_sock,
             meta=UrlMeta(digest=digest),
-            allow_source_fallback=False,
-            timeout=60.0,
+            allow_source_fallback=allow_source_fallback,
+            timeout=timeout,
         ))
 
 
@@ -303,6 +305,57 @@ def test_seed_death_mid_transfer_peers_recover(run_async, tmp_path):
             for d in daemons:
                 await d.stop()
             await sched.stop()
+            await origin.cleanup()
+
+    run_async(body(), timeout=120)
+
+
+def test_scheduler_death_mid_transfer_download_still_lands(run_async, tmp_path):
+    """Resilience: the scheduler dies while a peer is mid-download. The
+    user-visible guarantee: with source fallback permitted, the download
+    still lands sha-exact (conductor-level back-source demotion or the
+    client library's daemon-side fallback — either path is acceptable;
+    losing the download is not)."""
+
+    async def body():
+        origin, oport, stats = await start_origin()
+        sched = await start_scheduler()
+        url = f"http://127.0.0.1:{oport}/blob"
+        daemons = []
+        try:
+            seed_cfg = daemon_config(tmp_path, "seed", sched.port(), seed=True)
+            seed_cfg.upload.rate_limit = 4 * 1024 * 1024  # slow serving
+            seed = Daemon(seed_cfg)
+            await seed.start()
+            daemons.append(seed)
+            daemons.append(p1 := await start_daemon(tmp_path, "p1",
+                                                    sched.port()))
+
+            async def killer():
+                for _ in range(200):
+                    for s in p1.storage.tasks():
+                        if s.metadata.pieces:
+                            await sched.stop()
+                            return
+                    await asyncio.sleep(0.02)
+                await sched.stop()
+
+            kill_task = asyncio.ensure_future(killer())
+            result = await dfget_via(p1, url, str(tmp_path / "s1.bin"),
+                                     allow_source_fallback=True, timeout=90.0)
+            # Await the killer: a silently-failed kill would leave the
+            # scheduler alive and this test would stop testing anything.
+            await kill_task
+            assert result["state"] == "done", result
+            got = (tmp_path / "s1.bin").read_bytes()
+            assert hashlib.sha256(got).hexdigest() == SHA.split(":")[1]
+        finally:
+            for d in daemons:
+                await d.stop()
+            try:
+                await sched.stop()
+            except Exception:
+                pass
             await origin.cleanup()
 
     run_async(body(), timeout=120)
