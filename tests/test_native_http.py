@@ -291,3 +291,52 @@ class TestNativeSpan:
         assert plan is not None
         host, port, head = plan
         assert b"Host: origin:8080\r\n" in head and b"user:pw" not in head
+
+
+class TestMalformedResponses:
+    def test_garbage_heads_fail_cleanly(self, run_async, tmp_path):
+        """Random/adversarial response bytes must produce a coded error —
+        never a hang past the socket timeout, a crash, or a bogus
+        success."""
+        import random
+
+        cases = [
+            b"",                                      # immediate close
+            b"\x00" * 64,                             # binary junk
+            b"HTTP/1.1\r\n\r\n",                      # no status code
+            b"HTTP/1.1 9999 X\r\n\r\n",               # out-of-range status
+            b"HTTP/1.1 200 OK\r\nContent-Length: banana\r\n\r\n",
+            b"HTTP/1.1 200 OK\r\nContent-Length: -5\r\n\r\n",
+            b"HTTP/1.1 200 OK\r\nContent-Length: 99999999999999999999\r\n\r\n",
+            b"HTTP/1.1 200 OK\r\n" + b"X: y\r\n" * 20000,  # head > 64KiB
+            random.Random(0).randbytes(512),
+        ]
+
+        async def body():
+            def handle_factory(payload):
+                async def handle(reader, writer):
+                    try:
+                        await reader.read(4096)  # consume the request
+                        writer.write(payload)
+                        await writer.drain()
+                    finally:
+                        writer.close()
+                return handle
+
+            fd = os.open(tmp_path / "out", os.O_RDWR | os.O_CREAT)
+            try:
+                for payload in cases:
+                    server = await asyncio.start_server(
+                        handle_factory(payload), "127.0.0.1", 0)
+                    port = server.sockets[0].getsockname()[1]
+                    h = await T(nb.http_connect, "127.0.0.1", port, 3000)
+                    with pytest.raises(nb.NativeHttpError):
+                        await T(nb.http_fetch_to_file, h,
+                                _head(port), fd, 0, 1024)
+                    nb.http_close(h)
+                    server.close()
+                    await server.wait_closed()
+            finally:
+                os.close(fd)
+
+        run_async(body(), timeout=90)
