@@ -1,0 +1,130 @@
+"""Multi-host assembly: per-host fabric landings → one pod-global jax.Array.
+
+The fabric's unit of delivery is per HOST: every dfdaemon lands its bytes
+into its own devices' HBM (device sink, preheat device="tpu"). A training
+job on a v5p pod is N processes over one global device set — this module is
+the seam between the two worlds, built on jax.distributed + the global-mesh
+APIs (the scaling-book recipe at pod scale; no NCCL/MPI — DCN handles
+process coordination, ICI the collectives XLA inserts).
+
+Two assembly patterns, matching how the fabric was used:
+
+- **Broadcast** (pod-wide preheat: every host landed the FULL content):
+  ``global_replicated`` wraps each process's local copy as one globally
+  replicated Array — zero transfer, the fabric already did the broadcast
+  over its P2P tree instead of burning ICI/DCN on an all-gather.
+- **Sharded fan-out** (each host dfget'ed only ITS byte range, e.g. range
+  requests over a checkpoint): ``global_from_local_shards`` stitches the
+  per-process shards into one Array under a NamedSharding; XLA then moves
+  data only when a consumer's sharding demands it.
+
+Everything works unchanged on a single process (tests / the CPU dryrun):
+jax.make_array_from_single_device_arrays spans however many processes the
+runtime has.
+
+Reference contrast: Dragonfly2 ends at the filesystem on every node
+(client/daemon/storage/storage_manager.go) and leaves consumption to the
+reader; here consumption into the pod's compute fabric is part of the
+design (BASELINE north star).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def initialize_distributed(coordinator_address: str | None = None,
+                           num_processes: int | None = None,
+                           process_id: int | None = None) -> None:
+    """jax.distributed.initialize with pass-through args; on TPU pods the
+    runtime autodetects everything when args are None. Idempotent: a
+    second call (or single-process use where init is unnecessary) is a
+    no-op instead of an error."""
+    explicit = (coordinator_address is not None or num_processes is not None
+                or process_id is not None)
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except RuntimeError as e:
+        msg = str(e).lower()
+        if "already" in msg or "only be called once" in msg:
+            return  # idempotent (jax phrases double-init as "...only once")
+        if "before" in msg and not explicit:
+            # Backends already initialized in a single-process context
+            # (tests, notebooks): distributed init is simply unnecessary.
+            return
+        raise
+    except ValueError:
+        # No coordinator and nothing to autodetect (single process off a
+        # pod, e.g. CPU): same no-op semantics.
+        if explicit:
+            raise
+
+
+def global_mesh(axis_shapes: dict[str, int] | None = None) -> Mesh:
+    """Mesh over ALL devices in the job (every process's). Default: one
+    1-D "d" axis; pass {"dp": 4, "tp": 8}-style shapes to factor it."""
+    if not axis_shapes:
+        from dragonfly2_tpu.parallel.ici import make_mesh
+
+        return make_mesh()  # the same 1-D "d" mesh ici plans key on
+    devices = np.array(jax.devices())
+    names = tuple(axis_shapes)
+    shape = tuple(axis_shapes[n] for n in names)
+    if int(np.prod(shape)) != devices.size:
+        raise ValueError(f"mesh {axis_shapes} needs {np.prod(shape)} devices, "
+                         f"job has {devices.size}")
+    return Mesh(devices.reshape(shape), names)
+
+
+def global_replicated(mesh: Mesh, local_array) -> jax.Array:
+    """Wrap each process's full local copy (a landed checkpoint after a
+    pod-wide preheat) as one globally REPLICATED Array — no transfer; the
+    fabric already broadcast the bytes host-by-host."""
+    sharding = NamedSharding(mesh, P())  # replicated over every axis
+    local = np.asarray(local_array)
+    local_devices = [d for d in mesh.devices.flat
+                     if d.process_index == jax.process_index()]
+    shards = [jax.device_put(local, d) for d in local_devices]
+    return jax.make_array_from_single_device_arrays(
+        local.shape, sharding, shards)
+
+
+def global_from_local_shards(mesh: Mesh, local_shard, *,
+                             axis_name: str = "d",
+                             global_rows: int | None = None) -> jax.Array:
+    """Stitch per-process shards (each host dfget'ed its own byte range)
+    into one Array sharded over ``axis_name``'s leading dimension; on a
+    factored mesh the other axes hold replicated copies, exactly as
+    P(axis_name) demands. The local shard must cover the contiguous row
+    blocks of this process's devices along ``axis_name``; ``global_rows``
+    defaults to assuming equal per-process coverage (the fabric's ranged
+    fan-out contract)."""
+    local = np.asarray(local_shard)
+    sharding = NamedSharding(mesh, P(axis_name))
+    axis_idx = mesh.axis_names.index(axis_name)
+    axis_size = mesh.devices.shape[axis_idx]
+
+    # (device, its index along axis_name) for this process's devices.
+    mine: list[tuple[object, int]] = []
+    for coords, dev in np.ndenumerate(mesh.devices):
+        if dev.process_index == jax.process_index():
+            mine.append((dev, coords[axis_idx]))
+    blocks = sorted({a for _, a in mine})
+    if local.shape[0] % len(blocks):
+        raise ValueError(
+            f"local shard rows {local.shape[0]} not divisible by this "
+            f"process's {len(blocks)} blocks along {axis_name!r}")
+    per = local.shape[0] // len(blocks)
+    rows = global_rows if global_rows is not None else per * axis_size
+    block_of = {a: i for i, a in enumerate(blocks)}
+    shards = []
+    for dev, a in mine:
+        i = block_of[a]
+        shards.append(jax.device_put(local[i * per:(i + 1) * per], dev))
+    return jax.make_array_from_single_device_arrays(
+        (rows,) + local.shape[1:], sharding, shards)
