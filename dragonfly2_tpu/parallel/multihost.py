@@ -30,10 +30,14 @@ design (BASELINE north star).
 
 from __future__ import annotations
 
+import logging
+
 import numpy as np
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+log = logging.getLogger("dragonfly2_tpu.parallel.multihost")
 
 
 def initialize_distributed(coordinator_address: str | None = None,
@@ -56,13 +60,19 @@ def initialize_distributed(coordinator_address: str | None = None,
         if "before" in msg and not explicit:
             # Backends already initialized in a single-process context
             # (tests, notebooks): distributed init is simply unnecessary.
+            # Logged so a mis-ordered init on a real pod is diagnosable.
+            log.warning("skipping distributed init (backends already up): %s", e)
             return
         raise
-    except ValueError:
-        # No coordinator and nothing to autodetect (single process off a
-        # pod, e.g. CPU): same no-op semantics.
+    except ValueError as e:
+        # No coordinator and nothing to autodetect: treated as
+        # single-process use — but logged, because on a real pod this
+        # means autodetection FAILED and silent degradation to an
+        # un-coordinated job would produce wrong global arrays.
         if explicit:
             raise
+        log.warning("distributed autodetect unavailable; running "
+                    "single-process: %s", e)
 
 
 def global_mesh(axis_shapes: dict[str, int] | None = None) -> Mesh:
@@ -87,23 +97,20 @@ def global_replicated(mesh: Mesh, local_array) -> jax.Array:
     fabric already broadcast the bytes host-by-host."""
     sharding = NamedSharding(mesh, P())  # replicated over every axis
     local = np.asarray(local_array)
-    local_devices = [d for d in mesh.devices.flat
-                     if d.process_index == jax.process_index()]
-    shards = [jax.device_put(local, d) for d in local_devices]
-    return jax.make_array_from_single_device_arrays(
-        local.shape, sharding, shards)
+    # One API call; jax owns the placement (vs a hand-rolled device_put
+    # per local device, which would re-copy a multi-GB checkpoint over
+    # PCIe once per device).
+    return jax.make_array_from_process_local_data(sharding, local)
 
 
 def global_from_local_shards(mesh: Mesh, local_shard, *,
-                             axis_name: str = "d",
-                             global_rows: int | None = None) -> jax.Array:
+                             axis_name: str = "d") -> jax.Array:
     """Stitch per-process shards (each host dfget'ed its own byte range)
     into one Array sharded over ``axis_name``'s leading dimension; on a
     factored mesh the other axes hold replicated copies, exactly as
-    P(axis_name) demands. The local shard must cover the contiguous row
-    blocks of this process's devices along ``axis_name``; ``global_rows``
-    defaults to assuming equal per-process coverage (the fabric's ranged
-    fan-out contract)."""
+    P(axis_name) demands. The local shard must cover the contiguous,
+    equal-size row blocks of this process's devices along ``axis_name``
+    (the fabric's ranged fan-out contract)."""
     local = np.asarray(local_shard)
     sharding = NamedSharding(mesh, P(axis_name))
     axis_idx = mesh.axis_names.index(axis_name)
@@ -120,7 +127,7 @@ def global_from_local_shards(mesh: Mesh, local_shard, *,
             f"local shard rows {local.shape[0]} not divisible by this "
             f"process's {len(blocks)} blocks along {axis_name!r}")
     per = local.shape[0] // len(blocks)
-    rows = global_rows if global_rows is not None else per * axis_size
+    rows = per * axis_size
     block_of = {a: i for i, a in enumerate(blocks)}
     shards = []
     for dev, a in mine:

@@ -238,3 +238,32 @@ class TestReviewRegressions:
         loaded = st.load_from_sink(sink)
         np.testing.assert_array_equal(
             np.asarray(loaded["ids"]), arr.astype(np.int32))
+
+
+def test_pod_global_shardings_from_preheated_sink(checkpoint):
+    """The north-star consumption chain: a preheat-landed checkpoint loads
+    straight into tensors placed on a pod-global factored mesh —
+    load_from_sink's shardings hook composes with parallel.multihost
+    (single process here; the same NamedSharding spans processes on a
+    pod where every host preheated the same content)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dragonfly2_tpu.ops.safetensors import load_from_sink
+    from dragonfly2_tpu.parallel import multihost
+
+    arrays, content = checkpoint
+    sink = _land(content)
+    mesh = multihost.global_mesh({"dp": 2, "tp": 4})
+    name, ref = next((n, a) for n, a in arrays.items() if a.ndim >= 2)
+    axis = "tp" if ref.shape[-1] % 4 == 0 else "dp"
+    spec = P(*([None] * (ref.ndim - 1) + [axis]))
+    tensors = load_from_sink(
+        sink, names=[name],
+        shardings={name: NamedSharding(mesh, spec)})
+    arr = tensors[name]
+    assert arr.sharding.mesh.shape == {"dp": 2, "tp": 4}
+    np.testing.assert_array_equal(np.asarray(arr), ref)
+    # a consumer jit under the same mesh uses it directly
+    out = jax.jit(lambda x: x.sum())(arr)
+    np.testing.assert_allclose(float(out), float(ref.sum()), rtol=1e-4)
