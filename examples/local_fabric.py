@@ -1,0 +1,99 @@
+"""Quickstart: boot a local fabric and pull a file through it twice.
+
+Starts an origin + scheduler + seed + one peer (all on this machine),
+dfgets a blob through the peer (origin is fetched once, by the seed),
+then dfgets it again (served instantly from the local piece store).
+
+    python examples/local_fabric.py
+"""
+
+import asyncio
+import hashlib
+import os
+import random
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from aiohttp import web
+
+from dragonfly2_tpu.client import dfget as dfget_lib
+from dragonfly2_tpu.daemon.config import DaemonConfig
+from dragonfly2_tpu.daemon.daemon import Daemon
+from dragonfly2_tpu.pkg.piece import Range
+from dragonfly2_tpu.proto.common import UrlMeta
+from dragonfly2_tpu.scheduler.config import SchedulerConfig
+from dragonfly2_tpu.scheduler.server import SchedulerServer
+
+
+async def main() -> None:
+    work = tempfile.mkdtemp(prefix="df-example-")
+    content = random.Random(7).randbytes(32 << 20)
+    sha = hashlib.sha256(content).hexdigest()
+    hits = {"n": 0}
+
+    async def blob(request: web.Request) -> web.Response:
+        hits["n"] += 1
+        rng = request.headers.get("Range")
+        if rng:
+            r = Range.parse_http(rng, len(content))
+            return web.Response(
+                status=206, body=content[r.start:r.start + r.length],
+                headers={"Accept-Ranges": "bytes",
+                         "Content-Range": f"bytes {r.start}-"
+                                          f"{r.start + r.length - 1}"
+                                          f"/{len(content)}"})
+        return web.Response(body=content,
+                            headers={"Accept-Ranges": "bytes"})
+
+    app = web.Application()
+    app.router.add_get("/weights.bin", blob)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    oport = site._server.sockets[0].getsockname()[1]
+
+    scfg = SchedulerConfig()
+    scfg.server.port = 0
+    sched = SchedulerServer(scfg)
+    await sched.start()
+
+    def daemon(name: str, seed: bool) -> Daemon:
+        cfg = DaemonConfig()
+        cfg.work_home = os.path.join(work, name)
+        cfg.__post_init__()
+        cfg.host.hostname = name
+        cfg.host.ip = "127.0.0.1"
+        cfg.scheduler.addrs = [f"127.0.0.1:{sched.port()}"]
+        cfg.seed_peer = seed
+        return Daemon(cfg)
+
+    seed, peer = daemon("seed", True), daemon("peer", False)
+    await seed.start()
+    await peer.start()
+    try:
+        url = f"http://127.0.0.1:{oport}/weights.bin"
+        for attempt in ("cold (seed back-to-sources, peer rides P2P)",
+                        "warm (local piece-store reuse)"):
+            out = os.path.join(work, "out.bin")
+            result = await dfget_lib.download(dfget_lib.DfgetConfig(
+                url=url, output=out,
+                daemon_sock=peer.config.unix_sock,
+                meta=UrlMeta(digest=f"sha256:{sha}")))
+            with open(out, "rb") as f:
+                ok = hashlib.file_digest(f, "sha256").hexdigest() == sha
+            print(f"{attempt}: state={result['state']} sha_ok={ok} "
+                  f"p2p={result.get('from_p2p')} "
+                  f"reuse={result.get('from_reuse')} "
+                  f"origin_requests={hits['n']}")
+    finally:
+        await peer.stop()
+        await seed.stop()
+        await sched.stop()
+        await runner.cleanup()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
