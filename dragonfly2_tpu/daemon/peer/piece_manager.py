@@ -295,12 +295,22 @@ class PieceManager:
     ) -> None:
         m = store.metadata
         total_pieces = m.total_piece_count
-        concurrency = min(self.opt.concurrency, total_pieces)
+        # Resume: never re-fetch the contiguous landed prefix (reference
+        # continuePieceNum, piece_manager.go:804-815 — groups start at the
+        # first missing piece; mid-range holes still stream-and-drain
+        # inside their group, matching the reference).
+        continue_piece = 0
+        while continue_piece < total_pieces and store.has_piece(continue_piece):
+            continue_piece += 1
+        to_download = total_pieces - continue_piece
+        if to_download <= 0:
+            return
+        concurrency = min(self.opt.concurrency, to_download)
         # Contiguous piece groups (reference pieceGroup :876-922): group g
         # covers pieces [g*per + min(g, rem) ... ), sizes differ by ≤1.
-        per, rem = divmod(total_pieces, concurrency)
+        per, rem = divmod(to_download, concurrency)
         groups: list[tuple[int, int]] = []
-        start_piece = 0
+        start_piece = continue_piece
         for g in range(concurrency):
             count = per + (1 if g < rem else 0)
             groups.append((start_piece, start_piece + count))

@@ -36,6 +36,8 @@ async def start_origin() -> tuple[web.AppRunner, int, dict]:
 
     async def blob(request: web.Request) -> web.StreamResponse:
         stats["blob_gets"] += 1
+        stats.setdefault("blob_ranges", []).append(
+            request.headers.get("Range"))
         rng = request.headers.get("Range")
         if rng:
             r = Range.parse_http(rng, len(CONTENT))
@@ -198,6 +200,45 @@ class TestPieceManagerBackSource:
                 assert stats["blob_gets"] == 4
                 store.mark_done()
                 out = tmp_path / "o.bin"
+                store.store_to(str(out))
+                assert out.read_bytes() == CONTENT
+            finally:
+                await runner.cleanup()
+
+        run_async(body())
+
+    def test_concurrent_resume_skips_landed_prefix(self, run_async, tmp_path):
+        """Resume economy (reference continuePieceNum,
+        piece_manager.go:804-815): a partially-landed store must only
+        fetch the missing tail from origin — every range request starts at
+        or after the landed prefix, and landed bytes are not re-sent."""
+
+        async def body():
+            runner, port, stats = await start_origin()
+            try:
+                sm, store = _store_for(tmp_path)
+                pm = PieceManager(PieceManagerOption(
+                    concurrency=4, concurrent_min_length=1 << 20))
+                piece = 4 << 20
+                store.update_task(content_length=len(CONTENT),
+                                  piece_size=piece,
+                                  total_piece_count=3)
+                for n in range(2):  # landed prefix: pieces 0,1 of 3
+                    store.write_piece(n, CONTENT[n * piece:(n + 1) * piece])
+                stats["blob_ranges"] = []
+                await pm.download_source(store,
+                                         f"http://127.0.0.1:{port}/blob")
+                assert store.is_complete()
+                data_ranges = [r for r in stats["blob_ranges"] if r]
+                # Every data request starts at/after the landed prefix;
+                # the only sub-prefix request allowed is the 1-byte probe.
+                for r in data_ranges:
+                    start = int(r.split("=")[1].split("-")[0])
+                    assert start >= 2 * piece or r == "bytes=0-0", data_ranges
+                assert any(int(r.split("=")[1].split("-")[0]) == 2 * piece
+                           for r in data_ranges), data_ranges
+                store.mark_done()
+                out = tmp_path / "r.bin"
                 store.store_to(str(out))
                 assert out.read_bytes() == CONTENT
             finally:
