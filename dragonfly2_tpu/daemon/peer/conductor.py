@@ -101,12 +101,40 @@ class PeerTaskConductor:
             "is_seed": self.is_seed,
             "disable_back_source": self.disable_back_source,
         }
-        self._stream = await self.scheduler_client.open_announce_stream(open_body)
+        # Registration phase: any transport failure BEFORE a scheduler
+        # answer arrives (connect refused, connect-then-drop, silence)
+        # demotes to back-to-source instead of failing the task (reference
+        # behavior — the piece store still gets populated for reuse/PEX,
+        # and clients without source-fallback permission still succeed).
+        # A scheduler-SENT rejection (schedule_failed) stays fatal via the
+        # dispatch below.
+        msg = None
+        register_error = "scheduler closed stream at register"
         try:
+            self._stream = await self.scheduler_client.open_announce_stream(
+                open_body)
             await self._stream.send({"type": "register"})
             msg = await self._stream.recv(timeout=60.0)
-            if msg is None:
-                raise DfError(Code.SchedError, "scheduler closed stream at register")
+        except DfError as e:
+            if self.disable_back_source:
+                await self._teardown()
+                raise
+            register_error = str(e)
+        if msg is None:
+            if not self.disable_back_source:
+                log.warning("scheduler unavailable at register; "
+                            "degrading to back-to-source",
+                            task=self.task_id[:16], error=register_error)
+            if self.disable_back_source:
+                await self._teardown()
+                raise DfError(Code.SchedError,
+                              "scheduler unavailable at register")
+            try:
+                await self._back_source()
+            finally:
+                await self._teardown()
+            return
+        try:
             await self._dispatch_schedule(msg)
         except BaseException:
             await self._safe_send({"type": "download_failed"})

@@ -45,8 +45,27 @@ class SchedulerClient:
                 pass
 
     async def open_announce_stream(self, open_body: dict) -> ClientStream:
-        cli = self._client_for(open_body["task_id"])
-        return await cli.open_stream("Scheduler.AnnouncePeer", open_body)
+        """Open the AnnouncePeer stream on the ring member owning this
+        task, failing over clockwise to the other members when one is
+        unreachable (a dead scheduler must not push its ~1/N of tasks to
+        origin while healthy schedulers sit idle; dynconfig eventually
+        drops the dead member from the ring)."""
+        task_id = open_body["task_id"]
+        members = self._ring.pick_n(task_id, len(self._ring.members()))
+        last: DfError | None = None
+        for i, addr in enumerate(members):
+            try:
+                cli = self._client_for_addr(addr)
+                return await cli.open_stream("Scheduler.AnnouncePeer",
+                                             open_body)
+            except DfError as e:
+                last = e
+                if i + 1 < len(members):
+                    log.warning("scheduler unreachable, trying next ring "
+                                "member", addr=addr, error=e.message)
+        if last is not None:
+            raise last
+        raise DfError(Code.SchedError, "no scheduler addresses")
 
     async def announce_host(self, host_wire: dict) -> None:
         # Host announcements go to every scheduler (each keeps its own view).

@@ -359,3 +359,33 @@ def test_scheduler_death_mid_transfer_download_still_lands(run_async, tmp_path):
             await origin.cleanup()
 
     run_async(body(), timeout=120)
+
+
+def test_dead_scheduler_at_register_degrades_to_back_source(run_async, tmp_path):
+    """Scheduler unreachable at registration: the DAEMON demotes to
+    back-to-source (reference behavior) instead of failing the task — no
+    client-side source fallback needed, and the piece store is populated
+    for reuse."""
+
+    async def body():
+        origin, oport, stats = await start_origin()
+        url = f"http://127.0.0.1:{oport}/blob"
+        # Point the daemon at a port nothing listens on.
+        d = None
+        try:
+            cfg = daemon_config(tmp_path, "p1", scheduler_port=1)
+            d = Daemon(cfg)
+            await d.start()
+            r = await dfget_via(d, url, str(tmp_path / "o.bin"))
+            assert r["state"] == "done", r
+            assert not r["from_p2p"]
+            assert (tmp_path / "o.bin").read_bytes() == CONTENT
+            # The store is populated and reusable.
+            r2 = await dfget_via(d, url, str(tmp_path / "o2.bin"))
+            assert r2["from_reuse"], r2
+        finally:
+            if d is not None:
+                await d.stop()
+            await origin.cleanup()
+
+    run_async(body(), timeout=60)
