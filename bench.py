@@ -22,10 +22,39 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+# Rolling record of successful on-chip measurements (this file, committed):
+# when the tunneled backend is down at bench time, the fallback output
+# cites the last KNOWN-GOOD device number with its timestamp instead of
+# letting a transient outage erase the round's real measurements (round-2
+# lost its number exactly this way).
+_DEVICE_HISTORY = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_DEVICE_HISTORY.json")
+
+
+def _load_history() -> list:
+    try:
+        with open(_DEVICE_HISTORY) as f:
+            history = json.load(f)
+    except (OSError, ValueError):
+        return []
+    return history if isinstance(history, list) else []
+
+
+def _record_device_result(entry: dict) -> None:
+    history = _load_history()
+    history.append(entry)
+    try:
+        with open(_DEVICE_HISTORY, "w") as f:
+            json.dump(history[-50:], f, indent=2)
+            f.write("\n")
+    except OSError:
+        pass  # read-only checkout: the measurement still prints
 
 
 def bench_cpu_sha256(data: bytes, repeats: int = 3) -> float:
@@ -193,13 +222,18 @@ def main() -> int:
         jax, attempts = _init_backend_with_retry()
         device_bps = bench_device_sink(jax)
     except Exception as e:  # no usable accelerator: report CPU path honestly
-        print(json.dumps({
+        out = {
             "metric": "verify_and_land_throughput",
             "value": round(cpu_bps / 1e9, 3),
             "unit": "GB/s",
             "vs_baseline": 1.0,
             "note": f"device path unavailable: {e}",
-        }))
+        }
+        good = [h for h in _load_history()
+                if isinstance(h, dict) and h.get("sink_smoke") == "ok"]
+        if good:
+            out["last_known_device"] = good[-1]
+        print(json.dumps(out))
         return 0
     try:
         staged_bps = bench_staged_transfer(jax)
@@ -209,6 +243,16 @@ def main() -> int:
         smoke = sink_smoke(jax)
     except Exception as e:
         smoke = f"failed: {e}"
+    if smoke == "ok":
+        # Only verified runs may ever be cited as "last known-good".
+        _record_device_result({
+            "ts": time.time(),
+            "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "gbps": round(device_bps / 1e9, 3),
+            "vs_cpu_sha256": round(device_bps / cpu_bps, 3),
+            "backend": jax.default_backend(),
+            "sink_smoke": smoke,
+        })
     print(json.dumps({
         "metric": "verify_and_land_throughput",
         "value": round(device_bps / 1e9, 3),

@@ -26,6 +26,30 @@ class SchedulerClient:
     def _client_for(self, task_id: str) -> Client:
         return self._client_for_addr(self._ring.pick(task_id))
 
+    async def _routed_call(self, task_id: str, method: str, body: dict,
+                           timeout: float):
+        """Unary call with the same clockwise ring failover as the
+        announce stream: connection-level failures try the next member;
+        the OWNING member's error is what surfaces if all fail (it is the
+        one operators need to diagnose)."""
+        members = self._ring.pick_n(task_id, len(self._ring.members()))
+        first: DfError | None = None
+        for i, addr in enumerate(members):
+            try:
+                return await self._client_for_addr(addr).call(
+                    method, body, timeout=timeout)
+            except DfError as e:
+                if first is None:
+                    first = e
+                if e.code != Code.ClientConnectionError:
+                    raise  # a scheduler ANSWERED: its verdict stands
+                if i + 1 < len(members):
+                    log.warning("scheduler unreachable, trying next ring "
+                                "member", addr=addr, method=method,
+                                error=e.message)
+        raise first if first is not None else DfError(
+            Code.SchedError, "no scheduler addresses")
+
     def update_addrs(self, addrs: list[str]) -> None:
         """Dynconfig observer: rebuild the hash ring when the manager's
         scheduler set changes (reference pkg/resolver/scheduler_resolver.go).
@@ -52,19 +76,20 @@ class SchedulerClient:
         drops the dead member from the ring)."""
         task_id = open_body["task_id"]
         members = self._ring.pick_n(task_id, len(self._ring.members()))
-        last: DfError | None = None
+        first: DfError | None = None
         for i, addr in enumerate(members):
             try:
                 cli = self._client_for_addr(addr)
                 return await cli.open_stream("Scheduler.AnnouncePeer",
                                              open_body)
             except DfError as e:
-                last = e
+                if first is None:
+                    first = e
                 if i + 1 < len(members):
                     log.warning("scheduler unreachable, trying next ring "
                                 "member", addr=addr, error=e.message)
-        if last is not None:
-            raise last
+        if first is not None:
+            raise first
         raise DfError(Code.SchedError, "no scheduler addresses")
 
     async def announce_host(self, host_wire: dict) -> None:
@@ -80,14 +105,14 @@ class SchedulerClient:
                     timeout: float = 10.0):
         """Unary call routed by task id through the consistent-hash ring
         (public surface for call families without a dedicated wrapper,
-        e.g. the persistent cache RPCs)."""
-        return await self._client_for(task_id).call(method, body, timeout=timeout)
+        e.g. the persistent cache RPCs), with ring failover."""
+        return await self._routed_call(task_id, method, body, timeout)
 
     async def announce_task(self, body: dict) -> None:
         """Advertise a locally-complete task (dfcache import) — reference
         AnnounceTask, service_v1.go:331."""
-        await self._client_for(body.get("task_id", "")).call(
-            "Scheduler.AnnounceTask", body, timeout=10.0)
+        await self._routed_call(body.get("task_id", ""),
+                                "Scheduler.AnnounceTask", body, 10.0)
 
     async def leave_host(self, host_id: str) -> None:
         for addr in self._ring.members():
