@@ -1,5 +1,7 @@
 """TPU ops + parallel plans on the virtual 8-device CPU mesh."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -167,6 +169,63 @@ def test_graft_entry_dryrun_multichip():
     import __graft_entry__
 
     __graft_entry__.dryrun_multichip(8)
+
+
+def test_cpu_mesh_env_scrubs_accelerator_triggers(monkeypatch):
+    """The dryrun subprocess env must be hermetic: no accelerator-plugin
+    trigger vars, no plugin site dirs — regardless of the parent env."""
+    import __graft_entry__
+
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    monkeypatch.setenv("PALLAS_AXON_TPU_GEN", "v5e")
+    monkeypatch.setenv("AXON_LOOPBACK_RELAY", "1")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    monkeypatch.setenv("LIBTPU_INIT_ARGS", "--x")
+    monkeypatch.setenv(
+        "PYTHONPATH", os.pathsep.join(["/root/.axon_site", "/srv/lib"]))
+    env = __graft_entry__._cpu_mesh_env(8)
+    for key in env:
+        assert not key.startswith(("PALLAS_AXON", "AXON_", "TPU_", "LIBTPU"))
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "--xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+    assert "axon_site" not in env.get("PYTHONPATH", "")
+    assert "/srv/lib" in env.get("PYTHONPATH", "")
+
+
+def test_dryrun_survives_hanging_sitecustomize(tmp_path):
+    """Round-3 regression (MULTICHIP_r03 rc=124): a sitecustomize that
+    dials a wedged TPU relay whenever PALLAS_AXON_POOL_IPS is set must NOT
+    wedge the CPU dryrun — the dryrun's subprocess env scrubs the trigger.
+
+    The outer interpreter runs with -S (site disabled) so the fake
+    sitecustomize cannot hang the test itself; the inner dryrun subprocess
+    runs with site enabled and imports it, proving hermeticity end to end.
+    """
+    import subprocess
+    import sys
+    import sysconfig
+
+    fake_site = tmp_path / "fake_site"
+    fake_site.mkdir()
+    (fake_site / "sitecustomize.py").write_text(
+        "import os, time\n"
+        "if os.environ.get('PALLAS_AXON_POOL_IPS'):\n"
+        "    time.sleep(600)  # simulated wedged TPU relay dial\n")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    purelib = sysconfig.get_paths()["purelib"]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join([str(fake_site), repo])
+    env["PALLAS_AXON_POOL_IPS"] = "127.0.0.1"
+    env["JAX_PLATFORMS"] = "axon"   # forces dryrun onto its subprocess path
+    env.pop("XLA_FLAGS", None)
+    env["GRAFT_DRYRUN_TIMEOUT"] = "150"
+    code = (f"import sys; sys.path[:0] = [{repo!r}, {purelib!r}]; "
+            "import __graft_entry__ as g; g.dryrun_multichip(8); "
+            "print('SURVIVED')")
+    proc = subprocess.run([sys.executable, "-S", "-c", code], env=env,
+                          capture_output=True, text=True, timeout=200)
+    assert proc.returncode == 0, (proc.stdout[-1000:], proc.stderr[-2000:])
+    assert "SURVIVED" in proc.stdout
 
 
 def test_land_and_checksum_verify_on_land():
