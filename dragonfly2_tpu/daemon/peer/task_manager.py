@@ -941,9 +941,18 @@ class TaskManager:
         try:
             with store:  # pin: finalize preads run in executor threads
                 return await self._finalize_device(req, task_id, store)
-        except DfError as e:
-            log.error("device sink verify failed; disk warm-up stands",
-                      task_id=task_id[:16], error=str(e))
+        except Exception as e:
+            # Broad by contract: ANY escape here would reach the seed
+            # task's generic handler, which marks the digest-verified,
+            # already-PEX-announced disk store invalid — destroying a good
+            # store peers depend on (advisor round 3). The partial sink is
+            # discarded: a DeviceSinkError arrives pre-discarded, but e.g.
+            # an OSError from a backfill pread would otherwise leave an
+            # unverified content-sized HBM buffer parked in a sink slot.
+            if self.device_sinks is not None:
+                self.device_sinks.discard(task_id)
+            log.error("device sink finalize failed; disk warm-up stands",
+                      task_id=task_id[:16], error=describe(e))
             return False
 
     async def _finalize_device(self, req: "FileTaskRequest", task_id: str,

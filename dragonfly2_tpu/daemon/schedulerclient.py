@@ -27,12 +27,20 @@ class SchedulerClient:
         return self._client_for_addr(self._ring.pick(task_id))
 
     async def _routed_call(self, task_id: str, method: str, body: dict,
-                           timeout: float):
+                           timeout: float, idempotent: bool = False):
         """Unary call with the same clockwise ring failover as the
         announce stream: connection-level failures try the next member;
         the OWNING member's error is what surfaces if all fail (it is the
-        one operators need to diagnose)."""
-        members = self._ring.pick_n(task_id, len(self._ring.members()))
+        one operators need to diagnose).
+
+        Failover is OPT-IN per method (``idempotent=True``): a
+        state-bearing call (e.g. the persistent-cache family, whose
+        Started/Finished pair must land on the member holding the task
+        FSM) must NOT fail over — the substitute member would give an
+        authoritative-looking "not found" where the caller needs a
+        retryable connection error (advisor round 3)."""
+        members = (self._ring.pick_n(task_id, len(self._ring.members()))
+                   if idempotent else self._ring.pick_n(task_id, 1))
         first: DfError | None = None
         for i, addr in enumerate(members):
             try:
@@ -102,17 +110,22 @@ class SchedulerClient:
                 log.warning("announce host failed", addr=addr, error=e.message)
 
     async def unary(self, task_id: str, method: str, body: dict,
-                    timeout: float = 10.0):
+                    timeout: float = 10.0, idempotent: bool = False):
         """Unary call routed by task id through the consistent-hash ring
         (public surface for call families without a dedicated wrapper,
-        e.g. the persistent cache RPCs), with ring failover."""
-        return await self._routed_call(task_id, method, body, timeout)
+        e.g. the persistent cache RPCs). Ring failover only when the
+        caller declares the method ``idempotent`` — the safe default for
+        state-bearing methods is the owning member's error, retryable."""
+        return await self._routed_call(task_id, method, body, timeout,
+                                       idempotent=idempotent)
 
     async def announce_task(self, body: dict) -> None:
         """Advertise a locally-complete task (dfcache import) — reference
-        AnnounceTask, service_v1.go:331."""
+        AnnounceTask, service_v1.go:331. Idempotent registration: safe to
+        land on a failover member."""
         await self._routed_call(body.get("task_id", ""),
-                                "Scheduler.AnnounceTask", body, 10.0)
+                                "Scheduler.AnnounceTask", body, 10.0,
+                                idempotent=True)
 
     async def leave_host(self, host_id: str) -> None:
         for addr in self._ring.members():

@@ -15,6 +15,7 @@ Routes:
 from __future__ import annotations
 
 import asyncio
+import threading
 
 from aiohttp import web
 
@@ -140,9 +141,17 @@ class UploadManager:
             from dragonfly2_tpu.storage.local_store import _native
 
             srv, self._native_srv = self._native_srv, None
+            # Detach + barrier BEFORE the stop frees the handle: observer
+            # callbacks arrive from executor threads (piece commits), and a
+            # register racing upload_stop would call into freed memory.
+            index = self.storage.observer
+            self.storage.clear_observer()
+            if isinstance(index, _NativeServingIndex):
+                # May wait behind an in-flight callback's native call; keep
+                # the event loop free.
+                await asyncio.to_thread(index.close)
             # stop() joins serving threads; keep the event loop free.
             await asyncio.to_thread(_native().upload_stop, srv)
-            self.storage.observer = None
         if self._runner is not None:
             await self._runner.cleanup()
 
@@ -224,20 +233,44 @@ class UploadManager:
 class _NativeServingIndex:
     """StorageManager observer mirroring task/piece state into the native
     upload server's registry. Pure ctypes calls guarded by the C side's
-    mutex — safe from any thread (piece commits arrive from workers)."""
+    mutex — safe from any thread (piece commits arrive from workers).
+
+    The close() barrier upholds the binding layer's handle-ownership
+    contract: callbacks may arrive from executor threads right up to
+    teardown, so every native call holds a lock that close() takes before
+    upload_stop frees the server — after close() returns, no callback can
+    touch the dead handle (it sees _closed and returns)."""
 
     def __init__(self, nb, srv: int):
         self._nb = nb
         self._srv = srv
+        self._mu = threading.Lock()
+        self._closed = False
 
     def task_updated(self, store) -> None:
         m = store.metadata
-        self._nb.upload_register_task(self._srv, m.task_id, store.data_path,
-                                      m.content_length, m.piece_size)
+        with self._mu:
+            if self._closed:
+                return
+            self._nb.upload_register_task(self._srv, m.task_id,
+                                          store.data_path,
+                                          m.content_length, m.piece_size)
 
     def piece_recorded(self, task_id: str, rec) -> None:
-        self._nb.upload_register_piece(self._srv, task_id, rec.num,
-                                       rec.offset, rec.size)
+        with self._mu:
+            if self._closed:
+                return
+            self._nb.upload_register_piece(self._srv, task_id, rec.num,
+                                           rec.offset, rec.size)
 
     def task_deleted(self, task_id: str) -> None:
-        self._nb.upload_unregister_task(self._srv, task_id)
+        with self._mu:
+            if self._closed:
+                return
+            self._nb.upload_unregister_task(self._srv, task_id)
+
+    def close(self) -> None:
+        """After this returns, no further native call will be made; any
+        in-flight callback has completed."""
+        with self._mu:
+            self._closed = True

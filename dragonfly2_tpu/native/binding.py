@@ -5,6 +5,16 @@ Importing this module raises if the library can't be built/loaded; callers
 reference loads optional plugins (internal/dfplugin/dfplugin.go:53-55).
 ctypes calls release the GIL, so piece hashing/writing runs truly parallel
 under the daemon's worker threads.
+
+HANDLE OWNERSHIP CONTRACT (dfhttp connections, dfupload servers): the C
+layer resolves a handle to a raw object pointer under its registry mutex
+and then RELEASES the mutex for the call's duration — a concurrent
+``http_close``/``upload_stop`` on the SAME handle would free the object
+under a live call. Each handle therefore has exactly one owner that
+sequences its calls and invokes close/stop last, never concurrently with
+another call on that handle (connection pool slots in
+daemon/peer/piece_downloader; the UploadManager's server handle).
+Cross-HANDLE concurrency is unrestricted.
 """
 
 from __future__ import annotations
@@ -241,6 +251,8 @@ def http_reusable(handle: int) -> bool:
 
 
 def http_close(handle: int) -> None:
+    """Must be the handle owner's LAST call, never concurrent with another
+    call on the same handle (see module HANDLE OWNERSHIP CONTRACT)."""
     _lib.df_http_close(handle)
 
 
@@ -284,4 +296,6 @@ def upload_counters(handle: int) -> dict:
 
 
 def upload_stop(handle: int) -> None:
+    """Must be the handle owner's LAST call, never concurrent with another
+    call on the same handle (see module HANDLE OWNERSHIP CONTRACT)."""
     _lib.df_upload_stop(handle)

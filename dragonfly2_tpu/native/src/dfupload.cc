@@ -355,7 +355,10 @@ void handle_request(Server* srv, int fd, const std::string& head,
   while (ok && left > 0) {
     ssize_t r = sendfile(fd, in_fd, &off, left);
     if (r < 0) {
-      if (errno == EINTR || errno == EAGAIN) continue;
+      if (errno == EINTR) continue;
+      // Blocking socket + SO_SNDTIMEO: EAGAIN here IS the send timeout —
+      // a live-but-not-reading client. Retrying would park this worker
+      // forever and let stalled clients exhaust the whole pool.
       ok = false;
       break;
     }

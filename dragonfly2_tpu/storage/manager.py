@@ -57,6 +57,14 @@ class StorageManager:
             for rec in store.metadata.pieces.values():
                 observer.piece_recorded(store.metadata.task_id, rec)
 
+    def clear_observer(self) -> None:
+        """Detach the observer from the manager AND every store (each store
+        holds its own reference — clearing only the manager's would leave
+        piece commits calling a dead index)."""
+        self.observer = None
+        for store in self._stores.values():
+            store.observer = None
+
     # -- paths -------------------------------------------------------------
 
     def _task_dir(self, task_id: str) -> str:

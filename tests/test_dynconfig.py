@@ -7,6 +7,8 @@ from __future__ import annotations
 
 import asyncio
 
+import pytest
+
 from dragonfly2_tpu.manager.config import ManagerConfig
 from dragonfly2_tpu.manager.server import ManagerServer
 from dragonfly2_tpu.pkg.dynconfig import Dynconfig
@@ -130,3 +132,39 @@ async def _daemon_resolves(tmp_path):
         await daemon.stop()
         await sched.stop()
         await manager.stop()
+
+
+def test_unary_failover_is_idempotent_gated(run_async):
+    """State-bearing unary calls must NOT fail over to a ring member that
+    lacks the task's state (its authoritative-looking answer would replace
+    a retryable connection error); idempotent methods may (advisor r3)."""
+    from dragonfly2_tpu.daemon.schedulerclient import SchedulerClient
+    from dragonfly2_tpu.pkg.errors import Code, DfError
+
+    cli = SchedulerClient(["10.0.0.1:1", "10.0.0.2:1"])
+    owner = cli._ring.pick_n("t1", 2)
+    calls = []
+
+    class _Stub:
+        def __init__(self, addr):
+            self.addr = addr
+
+        async def call(self, method, body, timeout=None):
+            calls.append(self.addr)
+            if self.addr == owner[0]:
+                raise DfError(Code.ClientConnectionError, "down")
+            return {"ok": True, "from": self.addr}
+
+    cli._client_for_addr = lambda addr: _Stub(addr)
+
+    # Default (state-bearing): owner down -> retryable error, no failover.
+    with pytest.raises(DfError) as ei:
+        run_async(cli.unary("t1", "Scheduler.M", {}))
+    assert ei.value.code == Code.ClientConnectionError
+    assert calls == [owner[0]]
+
+    # Idempotent: fails over clockwise to the next member.
+    calls.clear()
+    out = run_async(cli.unary("t1", "Scheduler.M", {}, idempotent=True))
+    assert out["from"] == owner[1]
+    assert calls == [owner[0], owner[1]]
