@@ -78,7 +78,14 @@ def _open_body(i: int) -> dict:
 
 
 async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
-                  arrival_window_s: float = 1.0) -> dict:
+                  arrival_window_s: float = 1.0,
+                  churn: bool = False) -> dict:
+    """``churn=True`` kills one whole slice mid-fan-out (its peers' streams
+    drop after a few pieces, no finish) and sends a straggler wave into the
+    SAME slice late: the scheduler must keep origin economy (no fresh
+    back-source demotions — survivors hold the pieces), never hand a
+    straggler a dead parent, and hold ICI locality on the healthy
+    slices."""
     rng = random.Random(11)
     cfg = SchedulerConfig()
     cfg.scheduling.retry_interval = 0.05
@@ -89,8 +96,13 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
     origin_fetches = 0
     schedule_lat: list[float] = []
     parent_picks = {"intra": 0, "cross": 0}
+    healthy_picks = {"intra": 0, "cross": 0}
     finished: set[int] = set()
     max_lag = 0.0
+    killed_slice = 1 if churn else -1
+    dead_peer_ids: set[str] = set()
+    straggler_dead_picks = 0
+    straggler_pick_count = 0
 
     async def heartbeat():
         nonlocal max_lag
@@ -100,10 +112,19 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
             await asyncio.sleep(0.01)
             max_lag = max(max_lag, loop.time() - t0 - 0.01)
 
-    async def peer(i: int):
-        nonlocal origin_fetches
-        my_slice = f"slice-{i // HOSTS_PER_SLICE}"
-        stream = FakeStream(_open_body(i))
+    async def peer(i: int, *, die_after: int = -1,
+                   straggler: bool = False):
+        nonlocal origin_fetches, straggler_dead_picks, straggler_pick_count
+        my_slice = f"slice-{(i // HOSTS_PER_SLICE) % max(1, n_hosts // HOSTS_PER_SLICE)}"
+        body = _open_body(i)
+        if straggler:
+            # Stragglers re-join the KILLED slice with fresh peer ids.
+            body["peer_id"] = f"peer-straggler-{i}"
+            body["host"]["id"] = f"host-straggler-{i}"
+            body["host"]["tpu_slice"] = f"slice-{killed_slice}"
+            body["host"]["idc"] = f"slice-{killed_slice}"
+            my_slice = f"slice-{killed_slice}"
+        stream = FakeStream(body)
         server = asyncio.ensure_future(_serve(svc, stream))
         try:
             t_reg = time.perf_counter()
@@ -118,6 +139,12 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
                     pslice = (p.get("host") or {}).get("tpu_slice", "")
                     key = "intra" if pslice == my_slice else "cross"
                     parent_picks[key] += 1
+                    if my_slice != f"slice-{killed_slice}":
+                        healthy_picks[key] += 1
+                    if straggler:
+                        straggler_pick_count += 1
+                        if p.get("id") in dead_peer_ids:
+                            straggler_dead_picks += 1
             elif kind == "small_task":
                 finished.add(i)
                 await stream.to_sched.put(
@@ -135,6 +162,12 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
                 "piece_size": PIECE_SIZE,
                 "total_piece_count": N_PIECES})
             for n in range(N_PIECES):
+                if n == die_after:
+                    # Slice kill: the stream drops mid-download, no
+                    # finish, no goodbye — the scheduler's stream-gone
+                    # path must reap this peer from the DAG.
+                    dead_peer_ids.add(body["peer_id"])
+                    return
                 await asyncio.sleep(piece_latency_s * rng.uniform(0.5, 1.5))
                 await stream.to_sched.put({
                     "type": "piece_finished",
@@ -162,25 +195,46 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
             # its origin fetch has first pieces to serve.
             if i:
                 await asyncio.sleep(0.25 + rng.uniform(0, arrival_window_s))
-            await peer(i)
+            in_killed = churn and i // HOSTS_PER_SLICE == killed_slice
+            await peer(i, die_after=rng.randint(2, N_PIECES // 2)
+                       if in_killed else -1)
 
-        await asyncio.wait_for(
-            asyncio.gather(*[delayed(i) for i in range(n_hosts)]),
-            timeout=600)
+        waves = [delayed(i) for i in range(n_hosts)]
+        if churn:
+            async def straggle(i):
+                # Join AFTER the kill window, into the killed slice.
+                await asyncio.sleep(
+                    0.25 + arrival_window_s + rng.uniform(0.2, 0.6))
+                await peer(i, straggler=True)
+
+            waves += [straggle(n_hosts + j) for j in range(HOSTS_PER_SLICE)]
+        await asyncio.wait_for(asyncio.gather(*waves), timeout=600)
     finally:
         hb.cancel()
     wall = time.perf_counter() - t0
 
     total_picks = parent_picks["intra"] + parent_picks["cross"]
+    healthy_total = healthy_picks["intra"] + healthy_picks["cross"]
+    # With churn: one slice (HOSTS_PER_SLICE peers) dies, an equal
+    # straggler wave completes in its place — the target count is n_hosts
+    # either way.
+    expected_finishers = n_hosts
     return {
-        "config": "pod-fanout-sim",
+        "config": "pod-fanout-sim" + ("-churn" if churn else ""),
         "hosts": n_hosts,
         "slices": n_hosts // HOSTS_PER_SLICE,
         "pieces": N_PIECES,
         "finished": len(finished),
+        "expected_finishers": expected_finishers,
         "origin_fetches": origin_fetches,
         "intra_slice_frac": round(parent_picks["intra"] / total_picks, 3)
         if total_picks else 0.0,
+        "healthy_intra_slice_frac": round(
+            healthy_picks["intra"] / healthy_total, 3)
+        if healthy_total else 0.0,
+        "killed_peers": len(dead_peer_ids),
+        "straggler_parent_picks": straggler_pick_count,
+        "straggler_dead_parent_picks": straggler_dead_picks,
         "parent_picks": total_picks,
         "schedule_p50_ms": round(
             statistics.median(schedule_lat) * 1000, 1),
@@ -194,7 +248,7 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
 
 def check(result: dict) -> None:
     """Assertions shared by the bench and the pytest wrapper."""
-    assert result["finished"] == result["hosts"], result
+    assert result["finished"] == result["expected_finishers"], result
     # Origin economy at pod scale: ~one copy.
     assert result["origin_fetches"] <= 3, result
     # ICI locality: with 16 hosts/slice the random-candidate base rate for
@@ -205,20 +259,36 @@ def check(result: dict) -> None:
     assert result["max_loop_lag_ms"] < 500, result
 
 
+def check_churn(result: dict) -> None:
+    """Extra invariants for the slice-kill + straggler variant."""
+    check(result)
+    assert result["killed_peers"] == HOSTS_PER_SLICE, result
+    # Stragglers must be scheduled (not demoted to fresh origin fetches)…
+    assert result["straggler_parent_picks"] > 0, result
+    # …and never onto a peer whose stream already dropped.
+    assert result["straggler_dead_parent_picks"] == 0, result
+    # Locality on the surviving slices must not degrade below the
+    # no-churn bar.
+    assert result["healthy_intra_slice_frac"] >= 0.3, result
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--hosts", type=int, default=256)
+    ap.add_argument("--churn", action="store_true",
+                    help="kill one slice mid-fan-out + late stragglers")
     ap.add_argument("--publish", action="store_true")
     args = ap.parse_args()
 
-    result = asyncio.run(run_sim(args.hosts))
-    check(result)
+    result = asyncio.run(run_sim(args.hosts, churn=args.churn))
+    (check_churn if args.churn else check)(result)
     print(json.dumps(result))
 
     if args.publish:
         path = os.path.join(REPO, "BASELINE.json")
         doc = json.load(open(path))
-        doc.setdefault("published", {})["config5_pod_sim"] = result
+        key = "config5_pod_sim_churn" if args.churn else "config5_pod_sim"
+        doc.setdefault("published", {})[key] = result
         with open(path, "w") as f:
             json.dump(doc, f, indent=2)
             f.write("\n")

@@ -178,6 +178,7 @@ class Daemon:
             "filters": request.meta.filter.split("&") if request.meta.filter else [],
             "header": dict(request.meta.header),
             "priority": request.meta.priority,
+            "range": request.meta.range,
         }
         return PeerTaskConductor(
             task_id=task_id,

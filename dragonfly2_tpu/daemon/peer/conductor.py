@@ -26,7 +26,7 @@ from dragonfly2_tpu.daemon.peer.piece_manager import PieceManager
 from dragonfly2_tpu.daemon.peer.synchronizer import PieceTaskSynchronizer
 from dragonfly2_tpu.pkg import dflog, metrics
 from dragonfly2_tpu.pkg.errors import Code, DfError
-from dragonfly2_tpu.pkg.piece import PieceInfo, compute_piece_count
+from dragonfly2_tpu.pkg.piece import PieceInfo, Range, compute_piece_count
 from dragonfly2_tpu.pkg.ratelimit import Limiter
 from dragonfly2_tpu.storage.local_store import LocalTaskStore
 
@@ -71,6 +71,15 @@ class PeerTaskConductor:
         self.limiter = limiter or Limiter()
         self.on_piece = on_piece
         self.disable_back_source = disable_back_source
+        # Ranged task (task id encodes the range): the content of THIS task
+        # is the slice, and a back-source demotion must fetch exactly it —
+        # dropping the range here once fetched (and emitted) the whole
+        # object for a 1 MiB request. Derived from the ONE range
+        # representation (meta["range"], also what registers with the
+        # scheduler) so no caller can desynchronize the two.
+        range_header = self.meta.get("range", "")
+        self.content_range = (Range.parse_http(range_header)
+                              if range_header else None)
 
         self.dispatcher = PieceDispatcher()
         self.downloader = PieceDownloader()
@@ -82,6 +91,16 @@ class PeerTaskConductor:
         self._resched_lock = asyncio.Lock()
         self._sched_update = asyncio.Event()   # receiver loop applied a push
         self._need_back_source = False
+        # Piece-finished reports coalesce into pieces_finished batches: the
+        # first report flushes immediately (the scheduler's "peer became a
+        # usable parent" wakeup must not lag), subsequent ones within the
+        # flush window ride one message. Peer-to-peer piece DISCOVERY does
+        # not ride these reports at all (the synchronizer syncs piece maps
+        # parent-direct), so batching costs scheduling metadata freshness
+        # only, bounded by the window.
+        self._pending_reports: list[dict] = []
+        self._flush_task: asyncio.Task | None = None
+        self._last_flush = 0.0
 
     # ------------------------------------------------------------------ #
 
@@ -98,6 +117,7 @@ class PeerTaskConductor:
             "filters": self.meta.get("filters") or [],
             "header": self.meta.get("header") or {},
             "priority": self.meta.get("priority", 3),
+            "range": self.meta.get("range", ""),
             "is_seed": self.is_seed,
             "disable_back_source": self.disable_back_source,
         }
@@ -281,6 +301,7 @@ class PeerTaskConductor:
 
         await self.piece_manager.download_source(
             self.store, self.url, self.meta.get("header") or {},
+            content_range=self.content_range,
             on_piece=on_piece, limiter=self.limiter,
         )
         await self._safe_send({
@@ -325,6 +346,10 @@ class PeerTaskConductor:
                 raise DfError(Code.ClientPieceDownloadFail,
                               f"p2p download stalled at "
                               f"{self.dispatcher.downloaded_count()} pieces")
+            if self.dispatcher.parent_reported_done:
+                # A completed parent certified the digest set — the
+                # completion-time re-hash skip may engage (store gate).
+                self.store.chain_validated = True
             await self._safe_send({
                 "type": "download_finished",
                 "content_length": self.store.metadata.content_length,
@@ -458,21 +483,53 @@ class PeerTaskConductor:
 
     # -- reporting ---------------------------------------------------------
 
+    _REPORT_FLUSH_S = 0.05
+
     async def _report_piece(self, rec, parent_id: str) -> None:
+        self._pending_reports.append({
+            "piece_num": rec.num,
+            "range_start": rec.offset,
+            "range_size": rec.size,
+            "digest": rec.digest,
+            "download_cost_ms": rec.cost_ms,
+            "dst_peer_id": parent_id,
+        })
+        if self._flush_task is None or self._flush_task.done():
+            self._flush_task = asyncio.ensure_future(self._flush_soon())
+
+    async def _flush_soon(self) -> None:
+        # Loop until drained: a report appended while _flush_reports is
+        # mid-send sees this task as not-done and schedules nothing — the
+        # re-check here is what keeps it from stranding past the window.
+        loop = asyncio.get_running_loop()
+        while True:
+            wait = self._last_flush + self._REPORT_FLUSH_S - loop.time()
+            if wait > 0:
+                await asyncio.sleep(wait)
+            await self._flush_reports()
+            if not self._pending_reports:
+                return
+
+    async def _flush_reports(self) -> None:
         async with self._report_lock:
-            await self._safe_send({
-                "type": "piece_finished",
-                "piece": {
-                    "piece_num": rec.num,
-                    "range_start": rec.offset,
-                    "range_size": rec.size,
-                    "digest": rec.digest,
-                    "download_cost_ms": rec.cost_ms,
-                    "dst_peer_id": parent_id,
-                },
-            })
+            if not self._pending_reports:
+                return
+            batch, self._pending_reports = self._pending_reports, []
+            self._last_flush = asyncio.get_running_loop().time()
+            if len(batch) == 1:
+                await self._safe_send({"type": "piece_finished",
+                                       "piece": batch[0]})
+            else:
+                await self._safe_send({"type": "pieces_finished",
+                                       "pieces": batch})
 
     async def _safe_send(self, msg: dict) -> None:
+        # Scheduler-visible ordering: buffered piece reports precede any
+        # terminal or reschedule message (the scheduler's piece counts must
+        # be current when it acts on those).
+        if msg.get("type") in ("download_finished", "reschedule",
+                               "download_failed"):
+            await self._flush_reports()
         if self._stream is None or self._stream.closed:
             return
         try:
@@ -481,6 +538,9 @@ class PeerTaskConductor:
             pass
 
     async def _teardown(self) -> None:
+        if self._flush_task is not None and not self._flush_task.done():
+            self._flush_task.cancel()
+        await self._flush_reports()
         if self.synchronizer is not None:
             await self.synchronizer.close()
         await self.downloader.close()

@@ -97,6 +97,12 @@ class PieceDispatcher:
     def active_parents(self) -> list[ParentInfo]:
         return [p for p in self.parents.values() if not p.blocked]
 
+    # Set when any synced parent reported done=True for this task: that
+    # parent's completion gate passed (seed: full-digest validation;
+    # intermediate peer: its own verified chain), certifying the task's
+    # shared piece-digest set. Read by the conductor at completion.
+    parent_reported_done: bool = False
+
     def on_parent_pieces(self, peer_id: str, piece_nums: list[int],
                          total_piece_count: int = -1, content_length: int = -1,
                          piece_size: int = 0,

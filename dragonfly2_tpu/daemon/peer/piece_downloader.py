@@ -300,7 +300,7 @@ class PieceDownloader:
         # the whole accumulated piece map — a repeated loop stall on
         # many-piece tasks if run inline.
         return await asyncio.to_thread(store.record_piece, piece_num, n, crc,
-                                       cost_ms)
+                                       cost_ms, want_crc >= 0)
 
     async def close(self) -> None:
         if self._session is not None and not self._session.closed:

@@ -81,6 +81,10 @@ class PieceTaskSynchronizer:
                     digests=msg.get("digests") or {},
                 )
                 if msg.get("done"):
+                    # The parent passed its completion gate (seed: full
+                    # digest validated) — certifies the task's piece-digest
+                    # set for the child's re-hash-skip decision.
+                    self.dispatcher.parent_reported_done = True
                     done = True
                     break
             if not done:

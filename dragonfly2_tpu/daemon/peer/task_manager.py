@@ -509,12 +509,25 @@ class TaskManager:
             async for p in self._stream_progress(download, progress_q):
                 yield p
             from_p2p = download.result()
-            # Verify + land output inside the same failure envelope.
-            if req.meta.digest:
-                # Off-loop: a whole-content sha256 of a multi-GB task would
-                # otherwise freeze this daemon's serving for seconds.
-                await asyncio.to_thread(store.validate_digest, req.meta.digest)
-                store.metadata.digest = req.meta.digest
+            # Verify + land output inside the same failure envelope. A
+            # ranged task skips whole-content validation: the digest names
+            # the FULL object, the store holds only the slice.
+            if req.meta.digest and req.range is None:
+                if store.pieces_all_digest_verified():
+                    # Every piece matched a parent-announced digest and the
+                    # chain anchors at the seed's full-content validation —
+                    # the O(content) re-hash would re-prove what per-piece
+                    # verification already proved, and on a fan-out it is
+                    # the dominant CPU cost × every peer (reference parity:
+                    # children trust the piece-digest chain, pieceMd5Sign).
+                    store.metadata.digest = req.meta.digest
+                else:
+                    # Off-loop: a whole-content sha256 of a multi-GB task
+                    # would otherwise freeze this daemon's serving for
+                    # seconds.
+                    await asyncio.to_thread(store.validate_digest,
+                                            req.meta.digest)
+                    store.metadata.digest = req.meta.digest
             store.mark_done()
             self._pex_announce(task_id)
             if req.output:
@@ -583,6 +596,7 @@ class TaskManager:
             application=spec.get("application", ""),
             header=spec.get("header") or {},
             filter="&".join(spec.get("filters") or []),
+            range=spec.get("range", ""),
         )
         # seed=False: run as a normal peer (persistent-cache replication —
         # the scheduler wants this host to PULL from peers, not re-seed from
@@ -592,6 +606,8 @@ class TaskManager:
                               disable_back_source=bool(
                                   spec.get("disable_back_source")),
                               device=spec.get("device", ""))
+        if meta.range:
+            req.range = Range.parse_http(meta.range)
         task_id = spec.get("task_id") or req.task_id()
         running = self._running.get(task_id)
         if running is not None:
@@ -620,6 +636,17 @@ class TaskManager:
         try:
             await self._run_download(task_id, peer_id, req, store, None,
                                      is_seed=is_seed)
+            if (req.meta.digest and req.range is None
+                    and not store.pieces_all_digest_verified()):
+                # The seed is the TRUST ANCHOR of the piece-digest chain:
+                # its back-sourced pieces carry self-computed crcs, so the
+                # full-content digest must be proven HERE, before announce
+                # — otherwise a corrupted origin response would fan out
+                # pod-wide under per-piece digests that faithfully match
+                # the corruption. Children then skip this re-hash.
+                await asyncio.to_thread(store.validate_digest,
+                                        req.meta.digest)
+                store.metadata.digest = req.meta.digest
             store.mark_done()
             # Disk result is final: announce and publish FIRST (peers and
             # dedup waiters must not stall behind the HBM backfill — the
@@ -795,9 +822,13 @@ class TaskManager:
         aggregator; completion is observed through the broker)."""
         try:
             await self._run_download(task_id, peer_id, req, store, None)
-            if req.meta.digest:
-                await asyncio.to_thread(store.validate_digest, req.meta.digest)
-                store.metadata.digest = req.meta.digest
+            if req.meta.digest and req.range is None:
+                if store.pieces_all_digest_verified():
+                    store.metadata.digest = req.meta.digest
+                else:
+                    await asyncio.to_thread(store.validate_digest,
+                                            req.meta.digest)
+                    store.metadata.digest = req.meta.digest
             store.mark_done()
             self._pex_announce(task_id)
             self.broker.publish(task_id, PieceEvent(

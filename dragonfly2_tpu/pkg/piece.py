@@ -10,18 +10,32 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass, field
 
-DEFAULT_PIECE_SIZE = 4 * 1024 * 1024
-PIECE_SIZE_LIMIT = 15 * 1024 * 1024
+_MB = 1024 * 1024
+DEFAULT_PIECE_SIZE = 4 * _MB
+PIECE_SIZE_LIMIT = 32 * _MB
+
+# Content up to this size keeps the 4 MiB floor; above it the piece size
+# scales to hold the piece count near _TARGET_PIECES.
+_SCALE_START = 128 * _MB
+_TARGET_PIECES = 32
 
 
 def compute_piece_size(length: int) -> int:
-    """Piece size scaling (reference util.go:33-44): 4 MiB up to 200 MiB of
-    content, then +1 MiB per extra 100 MiB, capped at 15 MiB."""
-    if length <= 200 * 1024 * 1024:
+    """Piece size scaling. Deliberately steeper than the reference curve
+    (util.go:33-44: 4 MiB → 15 MiB above 200 MiB of content): every piece
+    costs a fixed slice of Python control plane on both ends of the hop —
+    dispatch, report, metadata — so a task aims for ~32 pieces once content
+    outgrows 128 MiB (256 MiB → 8 MiB pieces, 1 GiB → 32 MiB), capped at
+    32 MiB (the non-native pull path buffers whole pieces in memory;
+    piece_parallelism × cap bounds that transient). 32 pieces still
+    saturate the multi-parent pipeline (piece parallelism is 4-8 per
+    peer); what the extra pieces bought the reference's Go runtime, they
+    cost this one."""
+    if length <= 0 or length <= _SCALE_START:
         return DEFAULT_PIECE_SIZE
-    gap_count = length // (100 * 1024 * 1024)
-    mp_size = (gap_count - 2) * 1024 * 1024 + DEFAULT_PIECE_SIZE
-    return min(mp_size, PIECE_SIZE_LIMIT)
+    target = length // _TARGET_PIECES
+    size = ((target + _MB - 1) // _MB) * _MB  # 1 MiB multiple (sink alignment)
+    return min(max(size, DEFAULT_PIECE_SIZE), PIECE_SIZE_LIMIT)
 
 
 def compute_piece_count(length: int, piece_size: int) -> int:

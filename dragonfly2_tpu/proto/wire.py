@@ -267,6 +267,9 @@ STREAM_MSGS: dict[str, dict[str, Msg]] = {
             total_piece_count=F(int)),
         "piece_finished": Msg(
             "PieceFinished", piece=F(dict, required=True, spec=PIECE)),
+        "pieces_finished": Msg(
+            "PiecesFinished",
+            pieces=F(list, required=True, item=F(dict, spec=PIECE))),
         "piece_failed": Msg(
             "PieceFailed", piece_num=F(int), parent_id=F(str),
             temporary=F(bool)),

@@ -36,7 +36,8 @@ _TASK_EVENTS = [
 class Task:
     def __init__(self, task_id: str, url: str = "", *, tag: str = "", application: str = "",
                  digest: str = "", filtered_query_params: list[str] | None = None,
-                 header: dict | None = None, back_to_source_limit: int = 200):
+                 header: dict | None = None, back_to_source_limit: int = 200,
+                 range_header: str = ""):
         self.id = task_id
         self.url = url
         self.tag = tag
@@ -44,6 +45,10 @@ class Task:
         self.digest = digest
         self.filtered_query_params = filtered_query_params or []
         self.header = header or {}
+        # Ranged task (the id encodes it): a triggered seed must fetch
+        # exactly this slice, or its store would hold the whole object
+        # under the ranged id.
+        self.range_header = range_header
         self.content_length = -1
         self.piece_size = 0
         self.total_piece_count = -1

@@ -153,7 +153,18 @@ class Scheduling:
                                       PeerState.SUCCEEDED):
             return False
         if parent.fsm.current != PeerState.SUCCEEDED and parent.finished_piece_count() == 0:
-            return False  # nothing to serve yet
+            # Zero-piece parents are usually useless — EXCEPT one that is
+            # actively producing bytes (a back-sourcing peer, typically
+            # the just-triggered seed). The daemon's sync stream accepts a
+            # running pieceless task and pushes pieces as they land
+            # (rpcserver SyncPieceTasks), so handing it out at
+            # registration removes a report+wakeup round trip from every
+            # waiting child's time-to-first-piece. BACK_TO_SOURCE only: a
+            # seed-host peer in RUNNING (e.g. a replication pull waiting
+            # for its own parents) produces nothing yet — pointing
+            # children at it would burn their starvation window.
+            if parent.fsm.current != PeerState.BACK_TO_SOURCE:
+                return False
         if parent.host.free_upload_count() <= 0:
             return False
         if self.evaluator.is_bad_node(parent):

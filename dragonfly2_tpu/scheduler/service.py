@@ -106,6 +106,7 @@ class SchedulerService:
                 filtered_query_params=open_body.get("filters") or [],
                 header=open_body.get("header") or {},
                 back_to_source_limit=self.config.scheduling.back_to_source_count,
+                range_header=open_body.get("range", ""),
             )
         )
         peer = self.peers.load_or_store(
@@ -153,6 +154,11 @@ class SchedulerService:
             self._handle_download_started(msg, task, peer)
         elif kind == "piece_finished":
             self._handle_piece_finished(msg, task, peer)
+        elif kind == "pieces_finished":
+            # Coalesced batch (clients flush reports on a short window);
+            # semantics identical to N piece_finished in order.
+            for p in msg.get("pieces") or []:
+                self._apply_piece_finished(p, task, peer)
         elif kind == "piece_failed":
             self._handle_piece_failed(msg, task, peer)
         elif kind == "reschedule":
@@ -315,6 +321,10 @@ class SchedulerService:
         if peer.fsm.can("download_back_to_source"):
             peer.fsm.event("download_back_to_source")
             task.back_to_source_peers.add(peer.id)
+            # A back-sourcing peer is a valid candidate parent from this
+            # instant (the sync stream pushes pieces as they land) — wake
+            # blocked schedule loops now, not at its first piece report.
+            task.notify_parents_changed()
             log.info("peer going back-to-source", peer=peer.id[:24], reason=reason)
 
     def _fail_peer(self, peer: Peer) -> None:
@@ -347,6 +357,7 @@ class SchedulerService:
                 "digest": task.digest,
                 "filters": task.filtered_query_params,
                 "header": task.header,
+                "range": task.range_header,
             },
         )
         if ok:
@@ -364,7 +375,9 @@ class SchedulerService:
         )
 
     def _handle_piece_finished(self, msg: dict, task: Task, peer: Peer) -> None:
-        p = msg.get("piece") or {}
+        self._apply_piece_finished(msg.get("piece") or {}, task, peer)
+
+    def _apply_piece_finished(self, p: dict, task: Task, peer: Peer) -> None:
         info = PieceInfo.from_wire(p)
         first_piece = not peer.finished_pieces
         peer.add_finished_piece(info.piece_num, info.download_cost_ms)

@@ -108,6 +108,121 @@ def _store_sha_by_task(work_home: str, task_id: str) -> str | None:
     return None
 
 
+class _Fabric:
+    """Spawn/teardown helper for real-process scenarios: scheduler + seed +
+    N peers as CLI subprocesses, with exit-code collection on teardown
+    (the reference e2e's pod-restart-count analog:
+    /root/reference/test/e2e/e2e_test.go:34-75)."""
+
+    def __init__(self, tmp_path, peers=("p1", "p2"), seed_yaml: str = ""):
+        self.tmp = tmp_path
+        self.peer_names = list(peers)
+        self.seed_yaml = seed_yaml
+        self.procs: dict[str, subprocess.Popen] = {}
+        self.homes: dict[str, str] = {}
+        self.exit_codes: dict[str, int] = {}
+        self.sched_port = 0
+
+    async def start(self, extra_daemon_args: dict | None = None) -> None:
+        extra = extra_daemon_args or {}
+        self.sched_port = _free_port()
+        self.procs["sched"] = _spawn(
+            ["scheduler", "--host", "127.0.0.1",
+             "--port", str(self.sched_port)],
+            str(self.tmp / "sched.log"))
+        names = ["seed"] + self.peer_names
+        for name in names:
+            home = str(self.tmp / name)
+            self.homes[name] = home
+            args = ["daemon", "--work-home", home,
+                    "--scheduler", f"127.0.0.1:{self.sched_port}"]
+            if name == "seed":
+                args.append("--seed-peer")
+                if self.seed_yaml:
+                    cfg_path = str(self.tmp / "seed_cfg.yaml")
+                    with open(cfg_path, "w") as f:
+                        f.write(self.seed_yaml)
+                    args += ["--config", cfg_path]
+            args += extra.get(name, [])
+            self.procs[name] = _spawn(args, str(self.tmp / f"{name}.log"))
+        for name in names:
+            ok = await asyncio.to_thread(
+                _wait_sock, f"{self.homes[name]}/run/dfdaemon.sock")
+            assert ok, self.log_tail(name)
+
+    def log_tail(self, name: str, n: int = 2000) -> str:
+        try:
+            return open(self.tmp / f"{name}.log").read()[-n:]
+        except OSError:
+            return "<no log>"
+
+    def kill(self, name: str, sig=signal.SIGKILL) -> None:
+        self.procs[name].send_signal(sig)
+        self.exit_codes[name] = self.procs[name].wait(timeout=15)
+
+    async def restart_daemon(self, name: str) -> None:
+        """SIGTERM + respawn on the same work home (store reload path)."""
+        if self.procs[name].poll() is None:
+            self.procs[name].send_signal(signal.SIGTERM)
+        self.exit_codes[name] = await asyncio.to_thread(
+            self.procs[name].wait, 20)
+        # A fresh-spawn readiness check needs the stale socket gone (the
+        # daemon usually unlinks it on clean exit; tolerate either).
+        try:
+            os.remove(f"{self.homes[name]}/run/dfdaemon.sock")
+        except FileNotFoundError:
+            pass
+        args = ["daemon", "--work-home", self.homes[name],
+                "--scheduler", f"127.0.0.1:{self.sched_port}"]
+        if name == "seed":
+            args.append("--seed-peer")
+        self.procs[name] = _spawn(args, str(self.tmp / f"{name}.restart.log"))
+        ok = await asyncio.to_thread(
+            _wait_sock, f"{self.homes[name]}/run/dfdaemon.sock")
+        assert ok, self.log_tail(name)
+
+    def dfget(self, name: str, url: str, out: str,
+              extra: list[str] | None = None) -> subprocess.Popen:
+        return _spawn(
+            ["dfget", url, "-O", out, "--work-home", self.homes[name],
+             "--no-daemon", "--digest", f"sha256:{SHA}", *(extra or [])],
+            out + ".log")
+
+    async def await_dfget(self, proc: subprocess.Popen, out: str,
+                          timeout: float = 120) -> None:
+        rc = await asyncio.to_thread(proc.wait, timeout)
+        assert rc == 0, open(out + ".log").read()[-2000:]
+        with open(out, "rb") as f:
+            assert hashlib.sha256(f.read()).hexdigest() == SHA
+
+    async def teardown(self) -> None:
+        for name, p in self.procs.items():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for name, p in self.procs.items():
+            try:
+                self.exit_codes.setdefault(name, p.wait(timeout=10))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                self.exit_codes[name] = p.wait()
+
+
+def _wait_first_piece(homes: list[str], timeout: float = 60.0) -> bool:
+    """Block until any task data file under any home has bytes — the
+    'transfer is mid-flight' trigger for kill scenarios."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for home in homes:
+            for data in glob.glob(f"{home}/**/data", recursive=True):
+                try:
+                    if os.path.getsize(data) > 0:
+                        return True
+                except OSError:
+                    pass
+        time.sleep(0.05)
+    return False
+
+
 def test_multiprocess_fanout(run_async, tmp_path):
     """scheduler + seed + 2 peer daemon PROCESSES; dfget from both peers:
     outputs sha-verify, stores sha-verify on every node, origin served ~one
@@ -185,3 +300,107 @@ def test_multiprocess_fanout(run_async, tmp_path):
             await runner.cleanup()
 
     run_async(run())
+
+
+def test_multiprocess_seed_death(run_async, tmp_path):
+    """SIGKILL the seed PROCESS mid-transfer: both peers still land
+    sha-exact (reschedule onto each other + bounded back-source), and the
+    collected exit code proves the kill was a real process death."""
+
+    async def run():
+        runner, origin_port, stats = await _start_origin()
+        # Rate-limit seed serving so the kill lands mid-transfer.
+        fab = _Fabric(tmp_path, seed_yaml="upload:\n  rate_limit: 4194304\n")
+        try:
+            await fab.start()
+            url = f"http://127.0.0.1:{origin_port}/model.bin"
+            outs = [str(tmp_path / "o1.bin"), str(tmp_path / "o2.bin")]
+            dls = [fab.dfget("p1", url, outs[0]),
+                   fab.dfget("p2", url, outs[1])]
+
+            hit = await asyncio.to_thread(
+                _wait_first_piece, [fab.homes["p1"], fab.homes["p2"]])
+            assert hit, "no piece landed on any peer before timeout"
+            await asyncio.to_thread(fab.kill, "seed", signal.SIGKILL)
+            assert fab.exit_codes["seed"] == -signal.SIGKILL
+
+            for p, out in zip(dls, outs):
+                await fab.await_dfget(p, out)
+            # Bounded origin re-touch: seed's partial + ≤1 remainder/peer.
+            assert stats["bytes"] <= 3 * len(CONTENT) + (1 << 20), stats
+        finally:
+            await fab.teardown()
+            await runner.cleanup()
+
+    run_async(run(), timeout=240)
+
+
+def test_multiprocess_scheduler_death(run_async, tmp_path):
+    """SIGKILL the scheduler PROCESS mid-transfer: with source fallback
+    permitted the in-flight download still lands sha-exact (conductor
+    demotion), and a FRESH dfget after the death also lands (registration
+    ring failover → back-source demotion)."""
+
+    async def run():
+        runner, origin_port, stats = await _start_origin()
+        fab = _Fabric(tmp_path, peers=("p1",),
+                      seed_yaml="upload:\n  rate_limit: 4194304\n")
+        try:
+            await fab.start()
+            url = f"http://127.0.0.1:{origin_port}/model.bin"
+            out1 = str(tmp_path / "s1.bin")
+            dl = fab.dfget("p1", url, out1)
+            hit = await asyncio.to_thread(
+                _wait_first_piece, [fab.homes["p1"]])
+            assert hit, "no piece landed before timeout"
+            await asyncio.to_thread(fab.kill, "sched", signal.SIGKILL)
+            assert fab.exit_codes["sched"] == -signal.SIGKILL
+            await fab.await_dfget(dl, out1)
+
+            # Schedulerless cold task: a DIFFERENT task id (range variant)
+            # from the same daemon must still land via demotion.
+            out2 = str(tmp_path / "s2.bin")
+            p = _spawn(["dfget", url, "-O", out2,
+                        "--work-home", fab.homes["p1"], "--no-daemon",
+                        "--range", "0-1048575"], out2 + ".log")
+            rc = await asyncio.to_thread(p.wait, 120)
+            assert rc == 0, open(out2 + ".log").read()[-2000:]
+            with open(out2, "rb") as f:
+                got = f.read()
+            assert got == CONTENT[:1048576]
+        finally:
+            await fab.teardown()
+            await runner.cleanup()
+
+    run_async(run(), timeout=240)
+
+
+def test_multiprocess_daemon_restart_reuse(run_async, tmp_path):
+    """Restart a peer daemon PROCESS after a download: clean SIGTERM exit
+    (code 0 — restart-count hygiene), store reloads from disk, and a second
+    dfget is a warm reuse that never touches the origin again."""
+
+    async def run():
+        runner, origin_port, stats = await _start_origin()
+        fab = _Fabric(tmp_path, peers=("p1",))
+        try:
+            await fab.start()
+            url = f"http://127.0.0.1:{origin_port}/model.bin"
+            out1 = str(tmp_path / "r1.bin")
+            await fab.await_dfget(fab.dfget("p1", url, out1), out1)
+            bytes_before = stats["bytes"]
+
+            await fab.restart_daemon("p1")
+            assert fab.exit_codes["p1"] == 0, \
+                f"daemon SIGTERM exit {fab.exit_codes['p1']}"
+
+            out2 = str(tmp_path / "r2.bin")
+            await fab.await_dfget(fab.dfget("p1", url, out2), out2)
+            assert stats["bytes"] == bytes_before, \
+                "reuse after restart must not re-touch the origin"
+            assert "reuse=True" in open(out2 + ".log").read()
+        finally:
+            await fab.teardown()
+            await runner.cleanup()
+
+    run_async(run(), timeout=240)

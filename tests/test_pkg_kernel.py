@@ -86,13 +86,23 @@ class TestIdgen:
 
 class TestPiece:
     def test_piece_size_scaling(self):
-        # reference internal/util/util.go semantics
+        # Steeper than reference util.go: ~32 pieces per task above 128 MiB
+        # (per-piece control-plane cost dominates small hops here).
         assert piece.compute_piece_size(-1) == 4 << 20
         assert piece.compute_piece_size(100 << 20) == 4 << 20
-        assert piece.compute_piece_size(200 << 20) == 4 << 20
-        assert piece.compute_piece_size(300 << 20) == 5 << 20
-        assert piece.compute_piece_size(500 << 20) == 7 << 20
-        assert piece.compute_piece_size(10 << 30) == 15 << 20  # capped
+        assert piece.compute_piece_size(128 << 20) == 4 << 20
+        assert piece.compute_piece_size(256 << 20) == 8 << 20
+        assert piece.compute_piece_size(1 << 30) == 32 << 20
+        assert piece.compute_piece_size(10 << 30) == 32 << 20  # capped
+        # Piece count stays near the target across the scaling band;
+        # beyond the 32 MiB cap the count grows instead (memory bound on
+        # the non-native pull path wins over the 32-piece target).
+        for mb in (129, 200, 256, 512, 1024):
+            n = piece.compute_piece_count(
+                mb << 20, piece.compute_piece_size(mb << 20))
+            assert 16 <= n <= 33, (mb, n)
+        assert piece.compute_piece_count(
+            2048 << 20, piece.compute_piece_size(2048 << 20)) == 64
 
     def test_piece_count(self):
         assert piece.compute_piece_count(10, 4) == 3

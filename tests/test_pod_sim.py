@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import sys
 
-from benchmarks.pod_sim_bench import check, run_sim
+from benchmarks.pod_sim_bench import check, check_churn, run_sim
 
 
 def test_pod_sim_96_hosts(run_async):
@@ -20,5 +20,18 @@ def test_pod_sim_96_hosts(run_async):
                                arrival_window_s=0.5)
         check(result)
         assert result["schedule_p99_ms"] < 1000, result
+
+    run_async(body(), timeout=120)
+
+
+def test_pod_sim_churn_slice_kill_and_stragglers(run_async):
+    """Kill a whole slice mid-fan-out; a straggler wave re-joins that
+    slice late. Origin stays ~one copy, no straggler is handed a dead
+    parent, and surviving slices keep their ICI locality."""
+
+    async def body():
+        result = await run_sim(96, piece_latency_s=0.001,
+                               arrival_window_s=0.5, churn=True)
+        check_churn(result)
 
     run_async(body(), timeout=120)

@@ -255,3 +255,37 @@ def test_gc_closes_idle_store_fds(tmp_path):
         mgr.gc()
         assert store._fd is not None
     mgr.close()
+
+
+def test_pieces_all_digest_verified_tracking(tmp_path):
+    """The completion-time re-hash skip needs exact provenance: verified
+    means 'matched an externally-announced digest at landing', never
+    self-computed."""
+    from dragonfly2_tpu.pkg import digest as pkgdigest
+
+    mgr = make_manager(tmp_path)
+    store = mgr.register_task(meta("t-verified", content_length=9))
+    store.update_task(content_length=9, piece_size=4, total_piece_count=3)
+    d0 = pkgdigest.hash_bytes(pkgdigest.ALGORITHM_CRC32C, b"aaaa")
+    store.write_piece(0, b"aaaa", expected_digest=str(d0))
+    assert not store.pieces_all_digest_verified()  # incomplete
+    store.write_piece(1, b"bbbb")                  # self-computed digest
+    crc2 = int(pkgdigest.hash_bytes(
+        pkgdigest.ALGORITHM_CRC32C, b"c").encoded, 16)
+    store.record_piece(2, 1, crc2, verified=True)
+    assert store.is_complete()
+    # Piece 1 was never externally verified -> no skip.
+    assert not store.pieces_all_digest_verified()
+
+    store2 = mgr.register_task(meta("t-verified2", content_length=8))
+    store2.update_task(content_length=8, piece_size=4, total_piece_count=2)
+    d = pkgdigest.hash_bytes(pkgdigest.ALGORITHM_CRC32C, b"xxxx")
+    store2.write_piece(0, b"xxxx", expected_digest=str(d))
+    crc = int(pkgdigest.hash_bytes(
+        pkgdigest.ALGORITHM_CRC32C, b"yyyy").encoded, 16)
+    store2.record_piece(1, 4, crc, verified=True)
+    # All pieces verified but no completed parent certified the digest
+    # set yet -> still no skip.
+    assert not store2.pieces_all_digest_verified()
+    store2.chain_validated = True
+    assert store2.pieces_all_digest_verified()

@@ -53,6 +53,21 @@ class TestSchemas:
             wire.validate_stream_msg("Scheduler.AnnouncePeer", {
                 "type": "reschedule", "blocklist": ["ok", 42]})
 
+    def test_pieces_finished_batch(self):
+        wire.validate_stream_msg("Scheduler.AnnouncePeer", {
+            "type": "pieces_finished",
+            "pieces": [{"piece_num": 0, "range_start": 0, "range_size": 4,
+                        "digest": "d", "download_cost_ms": 1,
+                        "dst_peer_id": "p"},
+                       {"piece_num": 1}]})
+        with pytest.raises(wire.SchemaError, match="pieces"):
+            wire.validate_stream_msg("Scheduler.AnnouncePeer", {
+                "type": "pieces_finished",
+                "pieces": [{"piece_num": "not-an-int"}]})
+        with pytest.raises(wire.SchemaError, match="pieces"):
+            wire.validate_stream_msg("Scheduler.AnnouncePeer", {
+                "type": "pieces_finished"})
+
     def test_every_registered_schema_accepts_empty_optional(self):
         # Optional-only messages validate {} (no accidental requireds).
         for method, msg in wire.UNARY.items():
