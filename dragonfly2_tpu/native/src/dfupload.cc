@@ -33,6 +33,7 @@
 #include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/sendfile.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
@@ -42,6 +43,15 @@
 namespace {
 
 constexpr size_t HEAD_MAX = 16 << 10;
+
+long env_seconds(const char* name, long dflt) {
+  const char* v = getenv(name);
+  if (!v || !*v) return dflt;
+  errno = 0;
+  char* end = nullptr;
+  long n = strtol(v, &end, 10);
+  return (errno || *end || n <= 0) ? dflt : n;
+}
 
 struct PieceEnt {
   uint64_t offset;
@@ -352,13 +362,31 @@ void handle_request(Server* srv, int fd, const std::string& head,
   bool ok = send_all(fd, hdr, (size_t)hn);
   off_t off = (off_t)start;
   uint64_t left = length;
+  // SO_SNDTIMEO is NOT honored by sendfile on a blocking socket (measured:
+  // a zero-window peer parks the call indefinitely — the exact stalled-
+  // client worker exhaustion the timeout was meant to prevent). Bound the
+  // stall explicitly: non-blocking sendfile + poll(POLLOUT) with the
+  // timeout; a peer that stays unwritable past it loses the transfer.
+  long timeout_s = env_seconds("DF_UPLOAD_SEND_TIMEOUT_S", 60);
+  if (timeout_s > 2000000) timeout_s = 2000000;  // keep ms in int range
+  const int send_timeout_ms = (int)(timeout_s * 1000);
+  int fl = fcntl(fd, F_GETFL, 0);
+  if (fl >= 0) fcntl(fd, F_SETFL, fl | O_NONBLOCK);
   while (ok && left > 0) {
     ssize_t r = sendfile(fd, in_fd, &off, left);
     if (r < 0) {
       if (errno == EINTR) continue;
-      // Blocking socket + SO_SNDTIMEO: EAGAIN here IS the send timeout —
-      // a live-but-not-reading client. Retrying would park this worker
-      // forever and let stalled clients exhaust the whole pool.
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        struct pollfd pfd;
+        pfd.fd = fd;
+        pfd.events = POLLOUT;
+        pfd.revents = 0;
+        int pr = poll(&pfd, 1, send_timeout_ms);
+        if (pr < 0 && errno == EINTR) continue;  // signal, not a stall
+        if (pr > 0 && !(pfd.revents & (POLLERR | POLLHUP))) continue;
+        ok = false;  // stalled past the send timeout, or dead socket
+        break;
+      }
       ok = false;
       break;
     }
@@ -368,6 +396,7 @@ void handle_request(Server* srv, int fd, const std::string& head,
     }
     left -= (uint64_t)r;
   }
+  if (fl >= 0) fcntl(fd, F_SETFL, fl);
   close(in_fd);
   srv->active.fetch_sub(1, std::memory_order_relaxed);
   if (ok) {
@@ -388,25 +417,31 @@ void conn_loop(Server* srv, int fd) {
   // worker inside recv. A short receive timeout bounds that parking (the
   // pull side's pool probes liveness and retries on a fresh connection, so
   // idle-close is client-transparent); sends keep a long timeout for slow
-  // readers mid-transfer.
+  // readers mid-transfer. Both are env-tunable for abuse tests.
   struct timeval tv;
   tv.tv_sec = 10;
   tv.tv_usec = 0;
   setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
-  tv.tv_sec = 60;
+  tv.tv_sec = env_seconds("DF_UPLOAD_SEND_TIMEOUT_S", 60);
   setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  // The per-recv timeout alone does not bound a slow-loris head (a byte
+  // every few seconds resets it forever, parking this worker; enough such
+  // connections exhaust the pool). A whole-head deadline does.
+  const long head_deadline_s = env_seconds("DF_UPLOAD_HEAD_DEADLINE_S", 30);
 
   std::string buf;
   char chunk[4096];
   while (!srv->stopping.load(std::memory_order_relaxed)) {
     // Read one request head (requests have no bodies on this server).
     size_t mark;
+    time_t head_start = time(nullptr);
     while ((mark = buf.find("\r\n\r\n")) == std::string::npos) {
       if (buf.size() > HEAD_MAX) { close(fd); return; }
       ssize_t r = recv(fd, chunk, sizeof(chunk), 0);
       if (r <= 0) { close(fd); return; }
+      if (time(nullptr) - head_start > head_deadline_s) { close(fd); return; }
       buf.append(chunk, (size_t)r);
     }
     std::string head = buf.substr(0, mark);

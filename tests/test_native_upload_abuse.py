@@ -1,0 +1,253 @@
+"""Adversarial coverage for the native upload server (dfupload.cc).
+
+The serving side of the piece hop faces other daemons' pulls — and
+anything else that can reach the port. These drive the abuse paths the
+happy-path contract tests (test_native_upload.py) skip: slow-loris heads,
+oversized heads, pathological Range headers, clients that stop reading
+mid-sendfile, and task deregistration racing an in-flight send. Spirit of
+the dfhttp head fuzz (test_native_http.py), aimed at the server.
+
+The server's abuse timeouts are env-tuned down (DF_UPLOAD_HEAD_DEADLINE_S,
+DF_UPLOAD_SEND_TIMEOUT_S are read per-connection in conn_loop) so expiry
+is observable in test time.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import socket
+import time
+
+import aiohttp
+import pytest
+
+from dragonfly2_tpu.daemon.upload import UploadManager
+from dragonfly2_tpu.storage.local_store import TaskStoreMetadata, _native
+from dragonfly2_tpu.storage.manager import StorageManager, StorageOption
+
+nb = _native()
+pytestmark = pytest.mark.skipif(nb is None, reason="native library unavailable")
+
+# 8 MiB: must exceed server sndbuf + client rcvbuf so a stalled reader
+# genuinely blocks the server's sendfile (loopback auto-tunes buffers to
+# multiple MB; 1 MiB vanished into them without ever blocking).
+PIECE = 8 << 20
+
+
+async def _boot(tmp_path, monkeypatch, *, head_deadline_s=2, send_timeout_s=2):
+    monkeypatch.setenv("DF_UPLOAD_HEAD_DEADLINE_S", str(head_deadline_s))
+    monkeypatch.setenv("DF_UPLOAD_SEND_TIMEOUT_S", str(send_timeout_s))
+    storage = StorageManager(StorageOption(data_dir=str(tmp_path / "d")))
+    content = random.Random(5).randbytes(4 * PIECE)
+    store = storage.register_task(TaskStoreMetadata(
+        task_id="abuse-task", content_length=len(content), piece_size=PIECE,
+        total_piece_count=4))
+    for n in range(4):
+        store.write_piece(n, content[n * PIECE:(n + 1) * PIECE])
+    upload = UploadManager(storage)
+    port = await upload.serve("127.0.0.1", 0)
+    assert upload._native_srv is not None
+    return storage, store, content, upload, port
+
+
+async def _get_piece(port: int, n: int) -> bytes:
+    async with aiohttp.ClientSession() as http:
+        async with http.get(
+                f"http://127.0.0.1:{port}/download/abu/abuse-task",
+                params={"peerId": "p", "pieceNum": str(n)},
+                timeout=aiohttp.ClientTimeout(total=30)) as r:
+            assert r.status == 200, r.status
+            return await r.read()
+
+
+def _raw_conn(port: int, rcvbuf: int = 0) -> socket.socket:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    if rcvbuf:
+        # Before connect: the receive window is negotiated at SYN time —
+        # setting it later leaves the kernel's multi-MB autotuned window.
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, rcvbuf)
+    s.settimeout(10)
+    s.connect(("127.0.0.1", port))
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return s
+
+
+def test_slow_loris_heads_reaped_and_serving_continues(run_async, tmp_path,
+                                                       monkeypatch):
+    """Heads that dribble a byte at a time defeat the per-recv timeout;
+    the whole-head deadline must reap them, and normal piece serving must
+    continue while they dribble."""
+
+    async def body():
+        storage, store, content, upload, port = await _boot(
+            tmp_path, monkeypatch, head_deadline_s=2)
+        conns = [_raw_conn(port) for _ in range(6)]
+        stop = time.monotonic() + 5.5
+
+        async def dribble(s: socket.socket):
+            payload = b"GET /download/abu/abuse-task?pieceNum=0 HTTP/1.1\r\n"
+            i = 0
+            try:
+                while time.monotonic() < stop:
+                    s.send(payload[i % len(payload):i % len(payload) + 1])
+                    i += 1
+                    await asyncio.sleep(0.3)
+            except OSError:
+                return "closed"
+            return "alive"
+
+        try:
+            dribblers = [asyncio.ensure_future(dribble(s)) for s in conns]
+            # Serving continues while the loris connections dribble.
+            for n in range(4):
+                assert await _get_piece(port, n) == \
+                    content[n * PIECE:(n + 1) * PIECE]
+            results = await asyncio.gather(*dribblers)
+            # The deadline (2s) reaped the dribblers mid-run: sends start
+            # failing once the server closes its end.
+            assert results.count("closed") >= 4, results
+            # And the pool is healthy afterwards.
+            assert await _get_piece(port, 0) == content[:PIECE]
+        finally:
+            for s in conns:
+                s.close()
+            await upload.close()
+
+    run_async(body(), timeout=60)
+
+
+def test_oversized_head_closes_connection(run_async, tmp_path, monkeypatch):
+    async def body():
+        storage, store, content, upload, port = await _boot(
+            tmp_path, monkeypatch)
+        try:
+            s = _raw_conn(port)
+            junk = b"GET /x HTTP/1.1\r\nX-Filler: " + b"a" * (20 << 10)
+            with pytest.raises(OSError):
+                # No terminator: the server must close at HEAD_MAX; the
+                # send eventually fails rather than buffering forever.
+                for _ in range(64):
+                    s.sendall(junk)
+                    time.sleep(0.02)
+            s.close()
+            assert await _get_piece(port, 1) == content[PIECE:2 * PIECE]
+        finally:
+            await upload.close()
+
+    run_async(body(), timeout=60)
+
+
+def test_pathological_range_headers(run_async, tmp_path, monkeypatch):
+    """Oversized range lists and malformed ranges are 400/416, never a
+    crash, and never a served body."""
+
+    async def body():
+        storage, store, content, upload, port = await _boot(
+            tmp_path, monkeypatch)
+        bad = [
+            "bytes=" + ",".join(f"{i}-{i + 1}" for i in range(2000)),
+            "bytes=9999999999999999999999999-999999999999999999999999999",
+            "bytes=5-4",
+            "bytes=--10",
+            "bytes=",
+            "bites=0-10",
+            "bytes=0-10,",
+        ]
+        try:
+            async with aiohttp.ClientSession() as http:
+                for hdr in bad:
+                    async with http.get(
+                            f"http://127.0.0.1:{port}/download/abu/abuse-task",
+                            headers={"Range": hdr},
+                            timeout=aiohttp.ClientTimeout(total=15)) as r:
+                        assert r.status in (400, 416), (hdr, r.status)
+                # A range far past EOF: not satisfiable, not a crash.
+                async with http.get(
+                        f"http://127.0.0.1:{port}/download/abu/abuse-task",
+                        headers={"Range": f"bytes={10 * PIECE}-{11 * PIECE}"},
+                        timeout=aiohttp.ClientTimeout(total=15)) as r:
+                    assert r.status in (400, 416), r.status
+            assert await _get_piece(port, 2) == content[2 * PIECE:3 * PIECE]
+        finally:
+            await upload.close()
+
+    run_async(body(), timeout=60)
+
+
+def test_stalled_reader_does_not_park_worker_forever(run_async, tmp_path,
+                                                     monkeypatch):
+    """A live-but-not-reading client must hit the send timeout (EAGAIN on
+    the blocking socket) and free its worker — the round-3 advisor finding
+    (EAGAIN-forever retry) regression-tested end to end."""
+
+    async def body():
+        storage, store, content, upload, port = await _boot(
+            tmp_path, monkeypatch, send_timeout_s=2)
+        s = _raw_conn(port, rcvbuf=4096)
+        s.sendall(b"GET /download/abu/abuse-task?pieceNum=0 HTTP/1.1\r\n"
+                  b"Host: x\r\n\r\n")
+        try:
+            # Never read. Within ~send_timeout the server must abort the
+            # send; its FIN shows up as EOF once we finally drain.
+            await asyncio.sleep(4.0)
+            s.settimeout(10)
+            total = 0
+            while True:
+                b = s.recv(1 << 16)
+                if not b:
+                    break
+                total += len(b)
+            # Far less than the full piece arrived: the send was cut off.
+            assert total < PIECE, total
+            # The worker is free again: serving proceeds normally.
+            assert await _get_piece(port, 1) == content[PIECE:2 * PIECE]
+        finally:
+            s.close()
+            await upload.close()
+
+    run_async(body(), timeout=60)
+
+
+def test_deregister_task_during_inflight_send(run_async, tmp_path,
+                                              monkeypatch):
+    """Unregistering a task while one of its pieces is being sent must
+    neither crash nor corrupt the in-flight response (the server resolved
+    the path/offsets before the send; the open fd outlives the registry
+    entry), and later requests 404."""
+
+    async def body():
+        storage, store, content, upload, port = await _boot(
+            tmp_path, monkeypatch, send_timeout_s=5)
+        s = _raw_conn(port, rcvbuf=8192)
+        s.sendall(b"GET /download/abu/abuse-task?pieceNum=3 HTTP/1.1\r\n"
+                  b"Host: x\r\n\r\n")
+        try:
+            await asyncio.sleep(0.1)   # send in flight, reader slow
+            nb.upload_unregister_task(upload._native_srv, "abuse-task")
+            # Drain slowly AFTER the dereg: bytes must still be the piece.
+            s.settimeout(10)
+            got = b""
+            while b"\r\n\r\n" not in got:
+                got += s.recv(4096)
+            head, _, rest = got.partition(b"\r\n\r\n")
+            assert b"200 OK" in head.splitlines()[0]
+            body_bytes = rest
+            while len(body_bytes) < PIECE:
+                b = s.recv(1 << 16)
+                if not b:
+                    break
+                body_bytes += b
+            assert body_bytes == content[3 * PIECE:]
+            # Registry entry is gone for new requests.
+            async with aiohttp.ClientSession() as http:
+                async with http.get(
+                        f"http://127.0.0.1:{port}/download/abu/abuse-task",
+                        params={"pieceNum": "0"},
+                        timeout=aiohttp.ClientTimeout(total=15)) as r:
+                    assert r.status == 404
+        finally:
+            s.close()
+            await upload.close()
+
+    run_async(body(), timeout=60)
