@@ -48,11 +48,19 @@ def _free_port() -> int:
     return port
 
 
-def _spawn(args: list[str], log_path: str) -> subprocess.Popen:
+def _spawn(args: list[str], log_path: str,
+           jax_cpu: bool = False) -> subprocess.Popen:
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO
     env.pop("XLA_FLAGS", None)
     env.pop("JAX_PLATFORMS", None)
+    if jax_cpu:
+        # Device-sink daemons: a real single-device CPU backend (the
+        # jax.Array landing path the TPU sink uses, minus the chip).
+        env["JAX_PLATFORMS"] = "cpu"
+        for key in list(env):
+            if key.startswith(("PALLAS_AXON", "AXON_", "TPU_", "LIBTPU")):
+                del env[key]
     logf = open(log_path, "w")
     return subprocess.Popen(
         [sys.executable, "-m", "dragonfly2_tpu.cli.main", *args],
@@ -97,7 +105,8 @@ async def _grab_profile(port: int, seconds: float, out_path: str) -> str:
 
 async def run_bench(total_mb: int, n_peers: int, workdir: str,
                     profile: bool = False,
-                    origin_concurrency: int = 4) -> dict:
+                    origin_concurrency: int = 4,
+                    device_sink: bool = False) -> dict:
     # randbytes caps at 2^31 bits; build large content from 16 MiB blocks.
     rng = random.Random(99)
     content = b"".join(rng.randbytes(16 << 20)
@@ -146,10 +155,13 @@ async def run_bench(total_mb: int, n_peers: int, workdir: str,
         for i in range(n_peers):
             peer_args = ["daemon", "--work-home", homes[f"peer{i}"],
                          "--scheduler", f"127.0.0.1:{sched_port}"]
+            if device_sink:
+                peer_args += ["--device-sink"]
             if profile and i == 0:
                 peer_args += ["--metrics-port", str(peer0_metrics)]
             procs.append(_spawn(peer_args,
-                                os.path.join(workdir, f"peer{i}.log")))
+                                os.path.join(workdir, f"peer{i}.log"),
+                                jax_cpu=device_sink))
         for n in names:
             ok = await asyncio.to_thread(
                 _wait_sock, os.path.join(homes[n], "run", "dfdaemon.sock"))
@@ -181,10 +193,14 @@ async def run_bench(total_mb: int, n_peers: int, workdir: str,
                     daemon_sock=os.path.join(homes[f"peer{i}"], "run",
                                              "dfdaemon.sock"),
                     meta=UrlMeta(digest=f"sha256:{sha}"),
+                    device="tpu" if device_sink else "",
                     allow_source_fallback=False, timeout=600.0),
                 on_progress)
             if result.get("state") != "done":
                 raise RuntimeError(f"client {i} failed: {result}")
+            if device_sink and not result.get("device_verified"):
+                raise RuntimeError(
+                    f"client {i}: device sink did not verify: {result}")
             ttfps.append(first_piece[0] if first_piece[0] is not None
                          else time.perf_counter() - started)
 
@@ -237,6 +253,7 @@ async def run_bench(total_mb: int, n_peers: int, workdir: str,
             "origin_streams": stats["streams"],
             "origin_concurrency": origin_concurrency,
             "host_cores": os.cpu_count(),
+            "device_sink": device_sink,
         }
         # The seed is the only origin client; its request fan-in must stay
         # within the configured concurrency (+1 for the initial HEAD-like
@@ -267,6 +284,10 @@ def main() -> int:
     ap.add_argument("--profile", action="store_true",
                     help="cProfile the seed and one peer mid-bench "
                          "(saves profile_{seed,peer0}.txt in the workdir)")
+    ap.add_argument("--device-sink", action="store_true",
+                    help="daemons run a CPU-backend jax device sink; "
+                         "clients request device=tpu and require "
+                         "device_verified")
     ap.add_argument("--origin-concurrency", type=int, default=4,
                     help="seed's concurrent origin range streams (asserted "
                          "as the origin's observed request fan-in bound)")
@@ -278,7 +299,8 @@ def main() -> int:
     workdir = args.workdir or tempfile.mkdtemp(prefix="df-fanout-")
     result = asyncio.run(run_bench(args.mb, args.peers, workdir,
                                    profile=args.profile,
-                                   origin_concurrency=args.origin_concurrency))
+                                   origin_concurrency=args.origin_concurrency,
+                                   device_sink=args.device_sink))
     if args.profile:
         for role, text in (result.get("profiles") or {}).items():
             sys.stderr.write(f"\n=== {role} profile (top cumulative, "
@@ -289,7 +311,12 @@ def main() -> int:
     if args.publish:
         path = os.path.join(REPO, "BASELINE.json")
         doc = json.load(open(path))
-        doc.setdefault("published", {})["config2_fanout"] = result
+        # Device-sink runs publish under their own key: overwriting the
+        # canonical fan-out baseline would orphan the README and
+        # config5_projection citations into it.
+        key = ("config2_fanout_device_sink" if args.device_sink
+               else "config2_fanout")
+        doc.setdefault("published", {})[key] = result
         with open(path, "w") as f:
             json.dump(doc, f, indent=2)
             f.write("\n")
