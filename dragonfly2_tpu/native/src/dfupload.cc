@@ -231,19 +231,36 @@ void handle_request(Server* srv, int fd, const std::string& head,
     return;
   }
   if (path == "/metrics") {
-    char buf[512];
-    int n = snprintf(buf, sizeof(buf),
-                     "upload_bytes_total %llu\nupload_requests_total{result=\"ok\"} %llu\n"
-                     "upload_requests_total{result=\"not_found\"} %llu\n"
-                     "upload_requests_total{result=\"piece_missing\"} %llu\n"
-                     "upload_requests_total{result=\"throttled\"} %llu\n"
-                     "upload_requests_total{result=\"bad_request\"} %llu\n",
-                     (unsigned long long)srv->bytes_served.load(),
-                     (unsigned long long)srv->ok.load(),
-                     (unsigned long long)srv->not_found.load(),
-                     (unsigned long long)srv->piece_missing.load(),
-                     (unsigned long long)srv->throttled.load(),
-                     (unsigned long long)srv->bad_request.load());
+    // Built as a string, not a fixed buffer: adding a counter must never
+    // silently truncate the exposition. The daemon's real metrics
+    // endpoint is the Python metrics server, which merges these counters
+    // into the full label families (upload.py native_counters); this
+    // endpoint is the raw native view for direct scrapes.
+    std::string body;
+    char scratch[128];
+    auto add = [&](const char* fmt, uint64_t v) {
+      int w = snprintf(scratch, sizeof(scratch), fmt, (unsigned long long)v);
+      if (w > 0)
+        body.append(scratch,
+                    std::min((size_t)w, sizeof(scratch) - 1));
+    };
+    add("upload_bytes_total %llu\n", srv->bytes_served.load());
+    add("upload_requests_total{result=\"ok\"} %llu\n", srv->ok.load());
+    add("upload_requests_total{result=\"not_found\"} %llu\n",
+        srv->not_found.load());
+    add("upload_requests_total{result=\"piece_missing\"} %llu\n",
+        srv->piece_missing.load());
+    add("upload_requests_total{result=\"throttled\"} %llu\n",
+        srv->throttled.load());
+    add("upload_requests_total{result=\"bad_request\"} %llu\n",
+        srv->bad_request.load());
+    add("upload_active_transfers %llu\n", (uint64_t)srv->active.load());
+    {
+      std::lock_guard<std::mutex> lk(srv->reg_mu);
+      add("upload_registered_tasks %llu\n", (uint64_t)srv->tasks.size());
+    }
+    const char* buf = body.c_str();
+    int n = (int)body.size();
     char hdr[160];
     int hn = snprintf(hdr, sizeof(hdr),
                       "HTTP/1.1 200 OK\r\nContent-Length: %d\r\n"

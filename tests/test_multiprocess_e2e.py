@@ -67,13 +67,22 @@ async def _start_origin():
     return runner, site._server.sockets[0].getsockname()[1], stats
 
 
-def _spawn(args: list[str], log_path: str) -> subprocess.Popen:
+def _spawn(args: list[str], log_path: str,
+           jax_cpu: bool = False) -> subprocess.Popen:
     env = dict(os.environ)
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     # Child processes must not inherit the test's virtual-device JAX setup
     # (8 CPU devices per daemon = needless threads/memory in an E2E).
     env.pop("XLA_FLAGS", None)
     env.pop("JAX_PLATFORMS", None)
+    if jax_cpu:
+        # Device-sink daemon: single-device CPU jax backend, with the
+        # sandbox's accelerator-plugin triggers scrubbed (they dial a TPU
+        # relay — see __graft_entry__._cpu_mesh_env).
+        env["JAX_PLATFORMS"] = "cpu"
+        for key in list(env):
+            if key.startswith(("PALLAS_AXON", "AXON_", "TPU_", "LIBTPU")):
+                del env[key]
     logf = open(log_path, "w")
     return subprocess.Popen(
         [sys.executable, "-m", "dragonfly2_tpu.cli.main", *args],
@@ -404,3 +413,50 @@ def test_multiprocess_daemon_restart_reuse(run_async, tmp_path):
             await runner.cleanup()
 
     run_async(run(), timeout=240)
+
+
+def test_multiprocess_device_sink(run_async, tmp_path):
+    """A peer daemon PROCESS with a CPU-backend jax device sink: dfget
+    --device tpu lands the bytes on disk (sha-exact) AND in the daemon's
+    device Array, reported as device_verified; warm reuse re-finalizes
+    the sink without touching the origin."""
+
+    async def run():
+        runner, origin_port, stats = await _start_origin()
+        fab = _Fabric(tmp_path, peers=())
+        try:
+            await fab.start()
+            home = str(tmp_path / "dp")
+            fab.homes["dp"] = home
+            fab.procs["dp"] = _spawn(
+                ["daemon", "--work-home", home, "--device-sink",
+                 "--scheduler", f"127.0.0.1:{fab.sched_port}"],
+                str(tmp_path / "dp.log"), jax_cpu=True)
+            ok = await asyncio.to_thread(_wait_sock, f"{home}/run/dfdaemon.sock")
+            assert ok, fab.log_tail("dp")
+
+            url = f"http://127.0.0.1:{origin_port}/model.bin"
+            out1 = str(tmp_path / "d1.bin")
+            p = _spawn(["dfget", url, "-O", out1, "--work-home", home,
+                        "--no-daemon", "--device", "tpu",
+                        "--digest", f"sha256:{SHA}"], out1 + ".log")
+            await fab.await_dfget(p, out1, timeout=180)
+            log1 = open(out1 + ".log").read()
+            assert "device_verified=True" in log1, log1[-800:]
+            bytes_cold = stats["bytes"]
+
+            # Warm: reuse must re-finalize the sink, origin untouched.
+            out2 = str(tmp_path / "d2.bin")
+            p = _spawn(["dfget", url, "-O", out2, "--work-home", home,
+                        "--no-daemon", "--device", "tpu",
+                        "--digest", f"sha256:{SHA}"], out2 + ".log")
+            await fab.await_dfget(p, out2, timeout=120)
+            log2 = open(out2 + ".log").read()
+            assert "reuse=True" in log2, log2[-800:]
+            assert "device_verified=True" in log2, log2[-800:]
+            assert stats["bytes"] == bytes_cold
+        finally:
+            await fab.teardown()
+            await runner.cleanup()
+
+    run_async(run(), timeout=300)

@@ -84,7 +84,16 @@ def test_contract(run_async, tmp_path):
                     assert r.status == 200 and await r.text() == "ok"
                 async with http.get(f"{base}/metrics") as r:
                     assert r.status == 200
-                    assert "upload_bytes_total" in await r.text()
+                    exposition = await r.text()
+                    for family in ("upload_bytes_total",
+                                   "upload_requests_total{result=\"ok\"}",
+                                   "upload_requests_total{result=\"not_found\"}",
+                                   "upload_requests_total{result=\"piece_missing\"}",
+                                   "upload_requests_total{result=\"throttled\"}",
+                                   "upload_requests_total{result=\"bad_request\"}",
+                                   "upload_active_transfers",
+                                   "upload_registered_tasks"):
+                        assert family in exposition, family
                 counters = upload.native_counters()
                 # ok counts served pieces only (health probes excluded)
                 assert counters["ok"] >= 3 and counters["bytes_served"] > 0
