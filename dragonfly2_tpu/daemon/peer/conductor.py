@@ -346,10 +346,12 @@ class PeerTaskConductor:
                 raise DfError(Code.ClientPieceDownloadFail,
                               f"p2p download stalled at "
                               f"{self.dispatcher.downloaded_count()} pieces")
-            if self.dispatcher.parent_reported_done:
-                # A completed parent certified the digest set — the
-                # completion-time re-hash skip may engage (store gate).
-                self.store.chain_validated = True
+            certified = self.dispatcher.certified_digests()
+            if certified:
+                # A completed parent's digest map can certify the
+                # completion-time re-hash skip (the store compares what
+                # each piece was verified against to this map).
+                self.store.certified_digests = certified
             await self._safe_send({
                 "type": "download_finished",
                 "content_length": self.store.metadata.content_length,
@@ -516,12 +518,19 @@ class PeerTaskConductor:
                 return
             batch, self._pending_reports = self._pending_reports, []
             self._last_flush = asyncio.get_running_loop().time()
-            if len(batch) == 1:
-                await self._safe_send({"type": "piece_finished",
-                                       "piece": batch[0]})
-            else:
-                await self._safe_send({"type": "pieces_finished",
-                                       "pieces": batch})
+            try:
+                if len(batch) == 1:
+                    await self._safe_send({"type": "piece_finished",
+                                           "piece": batch[0]})
+                else:
+                    await self._safe_send({"type": "pieces_finished",
+                                           "pieces": batch})
+            except BaseException:
+                # A cancellation (teardown racing a flush) must not drop
+                # the popped batch: restore it so the teardown's own final
+                # flush still reports these pieces.
+                self._pending_reports = batch + self._pending_reports
+                raise
 
     async def _safe_send(self, msg: dict) -> None:
         # Scheduler-visible ordering: buffered piece reports precede any

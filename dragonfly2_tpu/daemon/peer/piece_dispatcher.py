@@ -48,6 +48,11 @@ class PieceDispatcher:
         self._done: set[int] = set()
         self._inflight: set[int] = set()
         self.piece_digests: dict[int, str] = {}
+        # Per-parent digest maps + the set of parents whose sync stream
+        # reported done (see certified_digests for why provenance, not a
+        # merged view, drives the re-hash-skip decision).
+        self.parent_digests: dict[str, dict[int, str]] = {}
+        self.done_parents: set[str] = set()
         # Incremental ready-tracking: O(1) amortized per assignment instead
         # of rescanning all pieces (a 100 GiB task is ~25k pieces).
         self._needed: set[int] = set()
@@ -97,11 +102,25 @@ class PieceDispatcher:
     def active_parents(self) -> list[ParentInfo]:
         return [p for p in self.parents.values() if not p.blocked]
 
-    # Set when any synced parent reported done=True for this task: that
-    # parent's completion gate passed (seed: full-digest validation;
-    # intermediate peer: its own verified chain), certifying the task's
-    # shared piece-digest set. Read by the conductor at completion.
-    parent_reported_done: bool = False
+    def note_parent_done(self, peer_id: str) -> None:
+        """The sync stream saw done=True from this parent: its completion
+        gate passed (seed: full-digest validation; intermediate peer: its
+        own certified chain)."""
+        self.done_parents.add(peer_id)
+
+    def certified_digests(self) -> "dict[int, str] | None":
+        """The piece-digest map of a DONE parent, or None when no parent
+        has reported done. Provenance matters: a still-downloading
+        back-sourcing parent's announced digests are self-computed and
+        uncertified — the re-hash-skip decision must compare the digests
+        pieces were actually verified against to a VALIDATED parent's
+        map, never to the merged view (a corrupt parent's entries would
+        otherwise be laundered by an honest parent's done)."""
+        for pid in self.done_parents:
+            digests = self.parent_digests.get(pid)
+            if digests:
+                return digests
+        return None
 
     def on_parent_pieces(self, peer_id: str, piece_nums: list[int],
                          total_piece_count: int = -1, content_length: int = -1,
@@ -112,9 +131,11 @@ class PieceDispatcher:
             return
         p.pieces.update(piece_nums)
         if digests:
+            per_parent = self.parent_digests.setdefault(peer_id, {})
             for n, d in digests.items():
                 if d:
                     self.piece_digests[int(n)] = d
+                    per_parent[int(n)] = d
         if total_piece_count >= 0:
             self.total_piece_count = total_piece_count
         if self._total_piece_count < 0:

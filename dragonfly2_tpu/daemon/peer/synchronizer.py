@@ -82,9 +82,9 @@ class PieceTaskSynchronizer:
                 )
                 if msg.get("done"):
                     # The parent passed its completion gate (seed: full
-                    # digest validated) — certifies the task's piece-digest
-                    # set for the child's re-hash-skip decision.
-                    self.dispatcher.parent_reported_done = True
+                    # digest validated) — its digest map can certify the
+                    # child's re-hash-skip decision (provenance-checked).
+                    self.dispatcher.note_parent_done(parent_peer_id)
                     done = True
                     break
             if not done:

@@ -509,25 +509,8 @@ class TaskManager:
             async for p in self._stream_progress(download, progress_q):
                 yield p
             from_p2p = download.result()
-            # Verify + land output inside the same failure envelope. A
-            # ranged task skips whole-content validation: the digest names
-            # the FULL object, the store holds only the slice.
-            if req.meta.digest and req.range is None:
-                if store.pieces_all_digest_verified():
-                    # Every piece matched a parent-announced digest and the
-                    # chain anchors at the seed's full-content validation —
-                    # the O(content) re-hash would re-prove what per-piece
-                    # verification already proved, and on a fan-out it is
-                    # the dominant CPU cost × every peer (reference parity:
-                    # children trust the piece-digest chain, pieceMd5Sign).
-                    store.metadata.digest = req.meta.digest
-                else:
-                    # Off-loop: a whole-content sha256 of a multi-GB task
-                    # would otherwise freeze this daemon's serving for
-                    # seconds.
-                    await asyncio.to_thread(store.validate_digest,
-                                            req.meta.digest)
-                    store.metadata.digest = req.meta.digest
+            # Verify + land output inside the same failure envelope.
+            await self._finalize_content_digest(req, store)
             store.mark_done()
             self._pex_announce(task_id)
             if req.output:
@@ -636,17 +619,13 @@ class TaskManager:
         try:
             await self._run_download(task_id, peer_id, req, store, None,
                                      is_seed=is_seed)
-            if (req.meta.digest and req.range is None
-                    and not store.pieces_all_digest_verified()):
-                # The seed is the TRUST ANCHOR of the piece-digest chain:
-                # its back-sourced pieces carry self-computed crcs, so the
-                # full-content digest must be proven HERE, before announce
-                # — otherwise a corrupted origin response would fan out
-                # pod-wide under per-piece digests that faithfully match
-                # the corruption. Children then skip this re-hash.
-                await asyncio.to_thread(store.validate_digest,
-                                        req.meta.digest)
-                store.metadata.digest = req.meta.digest
+            # The seed is the TRUST ANCHOR of the piece-digest chain: its
+            # back-sourced pieces carry self-computed crcs (never
+            # certified), so the helper's re-hash branch proves the full
+            # digest HERE, before announce — otherwise a corrupted origin
+            # response would fan out pod-wide under per-piece digests that
+            # faithfully match the corruption.
+            await self._finalize_content_digest(req, store)
             store.mark_done()
             # Disk result is final: announce and publish FIRST (peers and
             # dedup waiters must not stall behind the HBM backfill — the
@@ -822,13 +801,7 @@ class TaskManager:
         aggregator; completion is observed through the broker)."""
         try:
             await self._run_download(task_id, peer_id, req, store, None)
-            if req.meta.digest and req.range is None:
-                if store.pieces_all_digest_verified():
-                    store.metadata.digest = req.meta.digest
-                else:
-                    await asyncio.to_thread(store.validate_digest,
-                                            req.meta.digest)
-                    store.metadata.digest = req.meta.digest
+            await self._finalize_content_digest(req, store)
             store.mark_done()
             self._pex_announce(task_id)
             self.broker.publish(task_id, PieceEvent(
@@ -961,6 +934,23 @@ class TaskManager:
         resident sink could otherwise shadow a later retry's bytes."""
         if req.device and self.device_sinks is not None:
             self.device_sinks.discard(task_id)
+
+    async def _finalize_content_digest(self, req: "FileTaskRequest",
+                                       store) -> None:
+        """THE single completion-digest decision point (every download
+        path calls this; the skip precondition must never fork). Ranged
+        tasks skip entirely — the digest names the full object, the store
+        holds a slice. Complete tasks either (a) skip the O(content)
+        re-hash when every piece's verified-against digest matches a
+        certified parent's map (pieces_all_digest_verified — provenance-
+        checked, anchored at the seed's full validation), or (b) re-hash
+        off-loop (a whole-content sha256 of a multi-GB task would freeze
+        this daemon's serving for seconds)."""
+        if not req.meta.digest or req.range is not None:
+            return
+        if not store.pieces_all_digest_verified():
+            await asyncio.to_thread(store.validate_digest, req.meta.digest)
+        store.metadata.digest = req.meta.digest
 
     async def _finalize_device_for_seed(self, req: "FileTaskRequest",
                                         task_id: str, store) -> bool:

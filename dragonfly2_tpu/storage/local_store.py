@@ -119,16 +119,17 @@ class LocalTaskStore:
         self._unsaved_pieces = 0
         self._last_meta_save = 0.0
         self._output_lock = threading.Lock()
-        # Piece numbers whose digest was verified against an EXTERNALLY
-        # announced value at landing time (parent piece map), vs
-        # self-computed. In-memory only: the completion-time decision to
-        # skip the whole-content re-hash is made in the process that
-        # landed the pieces (pieces_all_digest_verified).
-        self._verified_pieces: set[int] = set()
-        # Set by the conductor when a synced parent reported done=True:
-        # that parent's completion gate passed, anchoring the task's
-        # piece-digest set (seeds validate the full digest before done).
-        self.chain_validated = False
+        # num -> digest string each piece was verified AGAINST at landing
+        # time (the parent-announced value), vs self-computed. In-memory
+        # only: the completion-time decision to skip the whole-content
+        # re-hash is made in the process that landed the pieces
+        # (pieces_all_digest_verified).
+        self._verified_pieces: dict[int, str] = {}
+        # Set by the conductor at completion: the piece-digest map of a
+        # parent whose sync stream reported done (its completion gate
+        # passed — seeds validate the full digest before done). The skip
+        # compares verified-against values to THIS map, piece by piece.
+        self.certified_digests: "dict[int, str] | None" = None
         # Optional StorageObserver (see storage/manager.py): notified on
         # piece commits and geometry updates so external indexes (the
         # native upload server's serving registry) stay current. Called
@@ -289,7 +290,7 @@ class LocalTaskStore:
                         Code.ClientPieceDownloadFail,
                     )
             digest_str = expected_digest
-            self._verified_pieces.add(num)
+            self._verified_pieces[num] = expected_digest
         else:
             algorithm = algorithm or pkgdigest.preferred_piece_algorithm()
             if (native is not None and piece_is_new
@@ -331,24 +332,27 @@ class LocalTaskStore:
                           digest=f"{pkgdigest.ALGORITHM_CRC32C}:{crc:08x}",
                           cost_ms=cost_ms)
         if verified:
-            self._verified_pieces.add(num)
+            self._verified_pieces[num] = rec.digest
         return self._commit_piece_record(rec)
 
     def pieces_all_digest_verified(self) -> bool:
-        """True when the content is complete, every piece's digest was
-        verified against an externally-announced value when it landed
-        (parent piece map over P2P), AND a completed parent certified the
-        digest set (``chain_validated`` — a mid-download seed's announced
-        crcs are self-computed until its own full-digest validation
-        passes, so a child finishing FIRST must still re-hash or it would
-        propagate a corrupted origin response). This is the precondition
-        for skipping the whole-content re-hash on completion (reference
-        parity: Dragonfly2 children trust the verified piece-digest
-        chain, pieceMd5Sign in scheduler/resource)."""
-        if not self.is_complete() or not self.chain_validated:
+        """True when the content is complete and every piece's
+        verified-against digest MATCHES a certified parent's map
+        (``certified_digests`` — the map of a parent whose completion
+        gate passed; seeds validate the full digest before done). The
+        per-piece comparison is what makes provenance stick: pieces
+        verified against a corrupt still-downloading parent's
+        self-computed digests will not match an honest done parent's
+        map, so they force the full re-hash instead of being laundered
+        by it. This is the precondition for skipping the whole-content
+        re-hash on completion (reference parity: Dragonfly2 children
+        trust the verified piece-digest chain, pieceMd5Sign)."""
+        certified = self.certified_digests
+        if not self.is_complete() or not certified:
             return False
         with self._meta_lock:
-            return all(n in self._verified_pieces
+            return all(self._verified_pieces.get(n) is not None
+                       and self._verified_pieces[n] == certified.get(n)
                        for n in self.metadata.pieces)
 
     def _commit_piece_record(self, rec: PieceRecord) -> PieceRecord:

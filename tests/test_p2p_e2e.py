@@ -389,3 +389,25 @@ def test_dead_scheduler_at_register_degrades_to_back_source(run_async, tmp_path)
             await origin.cleanup()
 
     run_async(body(), timeout=60)
+
+
+def test_certified_digests_provenance():
+    """certified_digests returns only a DONE parent's own map — a corrupt
+    still-downloading parent's announced digests must not be certified by
+    an honest parent's completion."""
+    from dragonfly2_tpu.daemon.peer.piece_dispatcher import PieceDispatcher
+
+    d = PieceDispatcher()
+    d.upsert_parent("corrupt", "10.0.0.1", 1)
+    d.upsert_parent("honest", "10.0.0.2", 1)
+    d.on_parent_pieces("corrupt", [0, 1],
+                       digests={0: "crc32c:bad00000", 1: "crc32c:bad00001"})
+    assert d.certified_digests() is None          # nobody done yet
+    d.on_parent_pieces("honest", [0, 1],
+                       digests={0: "crc32c:00000aaa", 1: "crc32c:00000bbb"})
+    d.note_parent_done("honest")
+    certified = d.certified_digests()
+    assert certified == {0: "crc32c:00000aaa", 1: "crc32c:00000bbb"}
+    # The merged view (scheduling convenience) may hold the corrupt
+    # values, but certification never reads it.
+    assert d.piece_digests[0] in ("crc32c:bad00000", "crc32c:00000aaa")

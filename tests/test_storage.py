@@ -285,7 +285,13 @@ def test_pieces_all_digest_verified_tracking(tmp_path):
         pkgdigest.ALGORITHM_CRC32C, b"yyyy").encoded, 16)
     store2.record_piece(1, 4, crc, verified=True)
     # All pieces verified but no completed parent certified the digest
-    # set yet -> still no skip.
+    # map yet -> still no skip.
     assert not store2.pieces_all_digest_verified()
-    store2.chain_validated = True
+    # Certification is per-piece provenance: the certified map must MATCH
+    # what each piece was verified against, or the skip stays off (a
+    # corrupt parent's digests cannot be laundered by an honest done).
+    good = {0: str(d), 1: f"crc32c:{crc:08x}"}
+    store2.certified_digests = {0: str(d), 1: "crc32c:deadbeef"}
+    assert not store2.pieces_all_digest_verified()
+    store2.certified_digests = good
     assert store2.pieces_all_digest_verified()
