@@ -106,7 +106,8 @@ async def _grab_profile(port: int, seconds: float, out_path: str) -> str:
 async def run_bench(total_mb: int, n_peers: int, workdir: str,
                     profile: bool = False,
                     origin_concurrency: int = 4,
-                    device_sink: bool = False) -> dict:
+                    device_sink: bool = False,
+                    warm_seed: bool = False) -> dict:
     # randbytes caps at 2^31 bits; build large content from 16 MiB blocks.
     rng = random.Random(99)
     content = b"".join(rng.randbytes(16 << 20)
@@ -174,6 +175,23 @@ async def run_bench(total_mb: int, n_peers: int, workdir: str,
         from dragonfly2_tpu.proto.common import UrlMeta
 
         url = f"http://127.0.0.1:{origin_port}/model.safetensors"
+
+        if warm_seed:
+            # Preheat-then-pull (the checkpoint-distribution pattern):
+            # the seed completes and VALIDATES before any peer starts, so
+            # children ride pure P2P with the certified digest-skip and
+            # no back-source race. Seed time is reported separately.
+            t_seed = time.perf_counter()
+            r = await dfget_lib.download(dfget_lib.DfgetConfig(
+                url=url, output=os.path.join(workdir, "seed_warm.bin"),
+                daemon_sock=os.path.join(homes["seed"], "run",
+                                         "dfdaemon.sock"),
+                meta=UrlMeta(digest=f"sha256:{sha}"),
+                allow_source_fallback=False, timeout=600.0))
+            if r.get("state") != "done":
+                raise RuntimeError(f"seed preheat failed: {r}")
+            seed_warm_s = time.perf_counter() - t_seed
+
         ttfps: list[float] = []
         t0 = time.perf_counter()
 
@@ -255,6 +273,9 @@ async def run_bench(total_mb: int, n_peers: int, workdir: str,
             "host_cores": os.cpu_count(),
             "device_sink": device_sink,
         }
+        if warm_seed:
+            result["warm_seed"] = True
+            result["seed_preheat_s"] = round(seed_warm_s, 2)
         # The seed is the only origin client; its request fan-in must stay
         # within the configured concurrency (+1 for the initial HEAD-like
         # probe) — against real GCS this is per-task request pressure.
@@ -284,6 +305,9 @@ def main() -> int:
     ap.add_argument("--profile", action="store_true",
                     help="cProfile the seed and one peer mid-bench "
                          "(saves profile_{seed,peer0}.txt in the workdir)")
+    ap.add_argument("--warm-seed", action="store_true",
+                    help="preheat the seed (complete + validated) before "
+                         "the peers start: the pure-P2P pull phase")
     ap.add_argument("--device-sink", action="store_true",
                     help="daemons run a CPU-backend jax device sink; "
                          "clients request device=tpu and require "
@@ -300,7 +324,8 @@ def main() -> int:
     result = asyncio.run(run_bench(args.mb, args.peers, workdir,
                                    profile=args.profile,
                                    origin_concurrency=args.origin_concurrency,
-                                   device_sink=args.device_sink))
+                                   device_sink=args.device_sink,
+                                   warm_seed=args.warm_seed))
     if args.profile:
         for role, text in (result.get("profiles") or {}).items():
             sys.stderr.write(f"\n=== {role} profile (top cumulative, "
@@ -315,6 +340,7 @@ def main() -> int:
         # canonical fan-out baseline would orphan the README and
         # config5_projection citations into it.
         key = ("config2_fanout_device_sink" if args.device_sink
+               else "config2_fanout_warm" if args.warm_seed
                else "config2_fanout")
         doc.setdefault("published", {})[key] = result
         with open(path, "w") as f:
