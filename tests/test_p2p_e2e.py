@@ -411,3 +411,54 @@ def test_certified_digests_provenance():
     # The merged view (scheduling convenience) may hold the corrupt
     # values, but certification never reads it.
     assert d.piece_digests[0] in ("crc32c:bad00000", "crc32c:00000aaa")
+
+
+def test_ranged_task_seed_trigger_fetches_the_slice(run_async, tmp_path):
+    """A ranged dfget through a scheduler with a live seed: the triggered
+    seed must fetch exactly the slice under the ranged task id (the range
+    rides announce open body -> scheduler Task -> trigger spec), and the
+    client's output must be the byte-exact slice — not the whole object."""
+
+    async def body():
+        origin, oport, stats = await start_origin()
+        sched = await start_scheduler()
+        url = f"http://127.0.0.1:{oport}/blob"
+        daemons = []
+        try:
+            daemons.append(seed := await start_daemon(
+                tmp_path, "seed", sched.port(), seed=True))
+            daemons.append(p1 := await start_daemon(
+                tmp_path, "p1", sched.port()))
+
+            from dragonfly2_tpu.proto.common import UrlMeta
+
+            start, length = 2 * 1024 * 1024, 1024 * 1024
+            out = str(tmp_path / "slice.bin")
+            r = await dfget_lib.download(dfget_lib.DfgetConfig(
+                url=url, output=out, daemon_sock=p1.config.unix_sock,
+                meta=UrlMeta(range=f"{start}-{start + length - 1}"),
+                allow_source_fallback=False, timeout=60.0))
+            assert r["state"] == "done", r
+            got = open(out, "rb").read()
+            assert got == CONTENT[start:start + length]
+
+            # The seed holds the SLICE under the ranged id: content_length
+            # is the range length, bytes are the slice.
+            slices = [s for d in daemons for s in d.storage.tasks()
+                      if s.metadata.content_length == length
+                      and s.metadata.done]
+            assert slices, "no daemon holds the completed ranged task"
+            for s in slices:
+                data = b"".join(s.read_piece(n)
+                                for n in sorted(s.metadata.pieces))
+                assert data == CONTENT[start:start + length]
+            # Origin served the slice (possibly via the seed), never the
+            # whole object for this request.
+            assert stats["blob_bytes"] <= 2 * length, stats
+        finally:
+            for d in daemons:
+                await d.stop()
+            await sched.stop()
+            await origin.cleanup()
+
+    run_async(body(), timeout=120)
