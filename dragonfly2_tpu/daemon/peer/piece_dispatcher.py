@@ -122,6 +122,17 @@ class PieceDispatcher:
                 return digests
         return None
 
+    def seed_shared_digests(self, digests: "dict[int, str] | None") -> None:
+        """Merge scheduler-RELAYED digests into the shared map only:
+        they inform landing verification for assignments made before the
+        parent's own sync snapshot arrives, but they carry no provenance
+        — they must never enter parent_digests (a first-reporter-poisoned
+        relay attributed to an honest parent would be laundered into its
+        certified map)."""
+        for n, d in (digests or {}).items():
+            if d:
+                self.piece_digests.setdefault(int(n), d)
+
     def on_parent_pieces(self, peer_id: str, piece_nums: list[int],
                          total_piece_count: int = -1, content_length: int = -1,
                          piece_size: int = 0,

@@ -48,10 +48,17 @@ class PieceTaskSynchronizer:
                 log.warning("parent missing address", parent=peer_id[:24])
                 continue
             self.dispatcher.upsert_parent(peer_id, ip, upload_port)
-            # Seed known pieces from the schedule response if present.
+            # Seed known pieces from the schedule response, and the
+            # relayed digests into the SHARED map only (no parent
+            # attribution — relayed digests have no provenance and must
+            # not be laundered into a parent's certified map): early
+            # assignments then verify at landing, and certification still
+            # requires the parent's own announced values to match.
             finished = parent.get("finished_pieces") or []
             if finished:
                 self.dispatcher.on_parent_pieces(peer_id, finished)
+                self.dispatcher.seed_shared_digests(
+                    parent.get("piece_digests"))
             if peer_id not in self._tasks or self._tasks[peer_id].done():
                 self._tasks[peer_id] = asyncio.ensure_future(
                     self._sync_one(peer_id, ip, port))

@@ -105,6 +105,17 @@ class Peer:
             "host": self.host.to_wire(),
             "state": self.state,
             "finished_pieces": sorted(self.finished_pieces),
+            # Digests for the LOWEST-numbered advertised pieces (from
+            # this task's piece reports): children pull lowest-first, so
+            # this covers the window before the parent's sync snapshot
+            # arrives — assignments verify at landing instead of pulling
+            # digest-blind. Bounded (not the full map): the snapshot
+            # delivers the rest moments later, and a 25k-piece task must
+            # not re-serialize 25k digests per candidate per reschedule.
+            "piece_digests": {
+                n: self.task.pieces[n].digest
+                for n in sorted(self.finished_pieces)[:512]
+                if n in self.task.pieces and self.task.pieces[n].digest},
             "is_seed": self.is_seed,
             "priority": self.priority,
         }

@@ -462,3 +462,56 @@ def test_ranged_task_seed_trigger_fetches_the_slice(run_async, tmp_path):
             await origin.cleanup()
 
     run_async(body(), timeout=120)
+
+
+def test_warm_pull_skips_whole_content_rehash(run_async, tmp_path, monkeypatch):
+    """A child pulling from a DONE (validated) seed must skip the
+    O(content) completion re-hash: every piece verified against the
+    seed's announced digests + the seed's certified map. The seed itself
+    (trust anchor) must still validate."""
+    from dragonfly2_tpu.storage.local_store import LocalTaskStore
+
+    calls: list[str] = []
+    real = LocalTaskStore.validate_digest
+
+    def spy(self, expected=""):
+        calls.append(self.dir)
+        return real(self, expected)
+
+    monkeypatch.setattr(LocalTaskStore, "validate_digest", spy)
+
+    async def body():
+        origin, oport, stats = await start_origin()
+        sched = await start_scheduler()
+        url = f"http://127.0.0.1:{oport}/blob"
+        daemons = []
+        try:
+            daemons.append(seed := await start_daemon(
+                tmp_path, "seed", sched.port(), seed=True))
+            daemons.append(p1 := await start_daemon(
+                tmp_path, "p1", sched.port()))
+            # Warm the seed: completes + VALIDATES (the anchor).
+            r = await dfget_via(seed, url, str(tmp_path / "w0.bin"))
+            assert r["state"] == "done", r
+            seed_validations = [c for c in calls if "/seed/" in c]
+            assert seed_validations, "seed (anchor) must validate"
+
+            # Child pulls from the done seed: pure P2P, skip engaged.
+            r = await dfget_via(p1, url, str(tmp_path / "w1.bin"))
+            assert r["state"] == "done", r
+            import hashlib as _h
+            got = open(tmp_path / "w1.bin", "rb").read()
+            assert "sha256:" + _h.sha256(got).hexdigest() == SHA
+            p1_validations = [c for c in calls if "/p1/" in c]
+            assert not p1_validations, \
+                f"child re-hashed despite certified chain: {p1_validations}"
+            # The child's store still records the verified digest.
+            stores = [s for s in p1.storage.tasks() if s.metadata.done]
+            assert stores and stores[0].metadata.digest == SHA
+        finally:
+            for d in daemons:
+                await d.stop()
+            await sched.stop()
+            await origin.cleanup()
+
+    run_async(body(), timeout=120)
