@@ -347,6 +347,8 @@ class PeerTaskConductor:
                               f"p2p download stalled at "
                               f"{self.dispatcher.downloaded_count()} pieces")
             certified = self.dispatcher.certified_digests()
+            if certified is None:
+                certified = await self._await_certification()
             if certified:
                 # A completed parent's digest map can certify the
                 # completion-time re-hash skip (the store compares what
@@ -360,6 +362,54 @@ class PeerTaskConductor:
             })
         finally:
             receiver.cancel()
+
+    async def _await_certification(self) -> "dict[int, str] | None":
+        """Cold-race closer: in a fan-out the children's last pieces land
+        moments before the seed's own completion gate (the seed validates
+        the whole-content digest BEFORE its sync streams say done), so
+        each child would pay a redundant whole-content re-hash that the
+        warm path skips. Waiting — bounded near the break-even point —
+        turns N children × O(content) hashing into the seed's one
+        validation. No provenance change: this only gives the parent's
+        done a chance to arrive on the already-open sync stream; the
+        per-piece certified comparison (store.pieces_all_digest_verified)
+        still decides whether the skip engages."""
+        if not LocalTaskStore.completion_digest_applies(
+                self.meta.get("digest", ""), self.content_range is not None):
+            return None  # no completion re-hash would run: nothing to save
+        content = self.store.metadata.content_length
+        if content <= 0:
+            return None
+        if not self.store.pieces_verified_against_digests():
+            # Some piece landed without a verified-against digest: no
+            # certified map can ever engage the skip — waiting is futile.
+            return None
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self._cert_wait_bound(content)
+        disp = self.dispatcher
+        while disp.pending_certifiers():
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                return None
+            disp.certified_event.clear()
+            certified = disp.certified_digests()
+            if certified:
+                return certified
+            try:
+                await asyncio.wait_for(disp.certified_event.wait(), remaining)
+            except asyncio.TimeoutError:
+                return None
+        return disp.certified_digests()
+
+    @staticmethod
+    def _cert_wait_bound(content_length: int) -> float:
+        """Wait budget: 50 ms done-propagation epsilon + 2× the ~1 GBps
+        solo hash estimate. The 2× is deliberate: the alternative to
+        waiting is N children hashing CONCURRENTLY on shared cores (each
+        paying ~N× the solo cost), while the wait is idle CPU that lets
+        the one certifier finish sooner — so the worst case (no done ever
+        arrives) loses ~the hash cost, and the common case saves all N."""
+        return min(3.0, 0.05 + 2 * content_length / 1.0e9)
 
     def _apply_task_meta(self, task_wire: dict) -> None:
         cl = task_wire.get("content_length", -1)
@@ -405,8 +455,11 @@ class PeerTaskConductor:
                 elif kind in ("need_back_source", "schedule_failed"):
                     if kind == "need_back_source":
                         self._need_back_source = True
-                    for p in self.dispatcher.parents.values():
-                        p.blocked = True
+                    # drop_parent (not a bare blocked=True) so both waiter
+                    # classes wake: dispatcher.get() AND a completion-time
+                    # _await_certification that can now never be certified.
+                    for pid in list(self.dispatcher.parents):
+                        self.dispatcher.drop_parent(pid)
                     self._sched_update.set()
         except (asyncio.CancelledError, DfError):
             pass

@@ -59,6 +59,10 @@ class PieceDispatcher:
         self._heap: list[int] = []
         self._max_parent_failures = max_parent_failures
         self._wakeup = asyncio.Event()
+        # Set whenever the certification picture changes (a parent reports
+        # done, or a potential certifier drops): completion-time waiters
+        # (conductor._await_certification) re-evaluate on each set.
+        self.certified_event = asyncio.Event()
 
     @property
     def total_piece_count(self) -> int:
@@ -98,6 +102,7 @@ class PieceDispatcher:
         if p is not None:
             p.blocked = True
         self._wakeup.set()
+        self.certified_event.set()
 
     def active_parents(self) -> list[ParentInfo]:
         return [p for p in self.parents.values() if not p.blocked]
@@ -107,6 +112,7 @@ class PieceDispatcher:
         gate passed (seed: full-digest validation; intermediate peer: its
         own certified chain)."""
         self.done_parents.add(peer_id)
+        self.certified_event.set()
 
     def certified_digests(self) -> "dict[int, str] | None":
         """The piece-digest map of a DONE parent, or None when no parent
@@ -121,6 +127,14 @@ class PieceDispatcher:
             if digests:
                 return digests
         return None
+
+    def pending_certifiers(self) -> bool:
+        """Could a certification still arrive? True while some unblocked
+        parent's sync stream has not yet reported done — its completion
+        gate may pass any moment and its digest map would then certify
+        this peer's re-hash skip."""
+        return any(not p.blocked and pid not in self.done_parents
+                   for pid, p in self.parents.items())
 
     def seed_shared_digests(self, digests: "dict[int, str] | None") -> None:
         """Merge scheduler-RELAYED digests into the shared map only:

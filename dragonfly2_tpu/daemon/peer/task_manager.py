@@ -19,7 +19,11 @@ from dragonfly2_tpu.pkg.errors import Code, DfError, describe
 from dragonfly2_tpu.pkg.piece import Range
 from dragonfly2_tpu.pkg.ratelimit import Limiter
 from dragonfly2_tpu.proto.common import UrlMeta
-from dragonfly2_tpu.storage import StorageManager, TaskStoreMetadata
+from dragonfly2_tpu.storage import (
+    LocalTaskStore,
+    StorageManager,
+    TaskStoreMetadata,
+)
 
 log = dflog.get("peer.task_manager")
 
@@ -946,7 +950,8 @@ class TaskManager:
         checked, anchored at the seed's full validation), or (b) re-hash
         off-loop (a whole-content sha256 of a multi-GB task would freeze
         this daemon's serving for seconds)."""
-        if not req.meta.digest or req.range is not None:
+        if not LocalTaskStore.completion_digest_applies(
+                req.meta.digest, req.range is not None):
             return
         if not store.pieces_all_digest_verified():
             await asyncio.to_thread(store.validate_digest, req.meta.digest)

@@ -335,6 +335,24 @@ class LocalTaskStore:
             self._verified_pieces[num] = rec.digest
         return self._commit_piece_record(rec)
 
+    @staticmethod
+    def completion_digest_applies(digest: str, ranged: bool) -> bool:
+        """Would the completion-time whole-content digest decision run at
+        all? Ranged tasks never (the digest names the full object; the
+        store holds a slice); digestless tasks never. BOTH call sites —
+        task_manager._finalize_content_digest (the decision point) and
+        conductor._await_certification (the wait that tries to turn the
+        decision into a skip) — share this gate so it can never fork."""
+        return bool(digest) and not ranged
+
+    def pieces_verified_against_digests(self) -> bool:
+        """Every landed piece carries a verified-against digest — the
+        necessary precondition for ANY certified map to engage the
+        re-hash skip (pieces_all_digest_verified compares these values).
+        False means a completion-time wait for certification is futile."""
+        with self._meta_lock:
+            return all(n in self._verified_pieces for n in self.metadata.pieces)
+
     def pieces_all_digest_verified(self) -> bool:
         """True when the content is complete and every piece's
         verified-against digest MATCHES a certified parent's map
