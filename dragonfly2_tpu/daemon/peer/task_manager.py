@@ -14,7 +14,7 @@ from typing import AsyncIterator
 
 from dragonfly2_tpu.daemon.peer.broker import PieceBroker, PieceEvent
 from dragonfly2_tpu.daemon.peer.piece_manager import PieceManager
-from dragonfly2_tpu.pkg import aio, dflog, idgen
+from dragonfly2_tpu.pkg import aio, dflog, idgen, metrics
 from dragonfly2_tpu.pkg.errors import Code, DfError, describe
 from dragonfly2_tpu.pkg.piece import Range
 from dragonfly2_tpu.pkg.ratelimit import Limiter
@@ -26,6 +26,14 @@ from dragonfly2_tpu.storage import (
 )
 
 log = dflog.get("peer.task_manager")
+
+# Completion-time whole-content digest decision: "skipped" = the certified
+# piece chain proved it (warm path / cold-race wait succeeded); "hashed" =
+# the O(content) re-hash ran. The skipped:hashed ratio is the fleet-visible
+# measure of how often the certification chain is doing its job.
+COMPLETION_REHASH = metrics.counter(
+    "peer_completion_rehash_total",
+    "Completion-time whole-content digest decisions", ("result",))
 
 
 @dataclass
@@ -953,7 +961,10 @@ class TaskManager:
         if not LocalTaskStore.completion_digest_applies(
                 req.meta.digest, req.range is not None):
             return
-        if not store.pieces_all_digest_verified():
+        if store.pieces_all_digest_verified():
+            COMPLETION_REHASH.labels("skipped").inc()
+        else:
+            COMPLETION_REHASH.labels("hashed").inc()
             await asyncio.to_thread(store.validate_digest, req.meta.digest)
         store.metadata.digest = req.meta.digest
 

@@ -697,6 +697,12 @@ def test_warm_pull_skips_whole_content_rehash(run_async, tmp_path, monkeypatch):
             seed_validations = [c for c in calls if "/seed/" in c]
             assert seed_validations, "seed (anchor) must validate"
 
+            from dragonfly2_tpu.daemon.peer.task_manager import (
+                COMPLETION_REHASH,
+            )
+            skipped_before = COMPLETION_REHASH.labels("skipped")._value.get()
+            hashed_before = COMPLETION_REHASH.labels("hashed")._value.get()
+
             # Child pulls from the done seed: pure P2P, skip engaged.
             r = await dfget_via(p1, url, str(tmp_path / "w1.bin"))
             assert r["state"] == "done", r
@@ -709,6 +715,14 @@ def test_warm_pull_skips_whole_content_rehash(run_async, tmp_path, monkeypatch):
             # The child's store still records the verified digest.
             stores = [s for s in p1.storage.tasks() if s.metadata.done]
             assert stores and stores[0].metadata.digest == SHA
+            # The decision is operator-visible: exactly one skip counted
+            # for this pull, and the hashed branch did not move (deltas
+            # against the pre-pull snapshot — the counter is process-
+            # global across the suite).
+            assert COMPLETION_REHASH.labels("skipped")._value.get() \
+                == skipped_before + 1
+            assert COMPLETION_REHASH.labels("hashed")._value.get() \
+                == hashed_before
         finally:
             for d in daemons:
                 await d.stop()
