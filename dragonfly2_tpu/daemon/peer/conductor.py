@@ -346,14 +346,13 @@ class PeerTaskConductor:
                 raise DfError(Code.ClientPieceDownloadFail,
                               f"p2p download stalled at "
                               f"{self.dispatcher.downloaded_count()} pieces")
-            certified = self.dispatcher.certified_digests()
-            if certified is None:
-                certified = await self._await_certification()
-            if certified:
-                # A completed parent's digest map can certify the
-                # completion-time re-hash skip (the store compares what
-                # each piece was verified against to this map).
-                self.store.certified_digests = certified
+            # A completed parent's digest map can certify the
+            # completion-time re-hash skip (the store compares what each
+            # piece was verified against to the map). Every done parent's
+            # map is tried, and when none verifies yet the bounded wait
+            # keeps running — a corrupt early finisher can't mask an
+            # honest parent whose done is still in flight.
+            await self._await_certification()
             await self._safe_send({
                 "type": "download_finished",
                 "content_length": self.store.metadata.content_length,
@@ -363,7 +362,7 @@ class PeerTaskConductor:
         finally:
             receiver.cancel()
 
-    async def _await_certification(self) -> "dict[int, str] | None":
+    async def _await_certification(self) -> bool:
         """Cold-race closer: in a fan-out the children's last pieces land
         moments before the seed's own completion gate (the seed validates
         the whole-content digest BEFORE its sync streams say done), so
@@ -371,35 +370,37 @@ class PeerTaskConductor:
         warm path skips. Waiting — bounded near the break-even point —
         turns N children × O(content) hashing into the seed's one
         validation. No provenance change: this only gives the parent's
-        done a chance to arrive on the already-open sync stream; the
-        per-piece certified comparison (store.pieces_all_digest_verified)
-        still decides whether the skip engages."""
+        done a chance to arrive on the already-open sync stream;
+        store.apply_certification (the single scan-and-install point)
+        decides whether the skip engages, so only a map that actually
+        certifies ends the wait — a corrupt parent's done must not eat
+        the budget an honest parent's in-flight done could still use.
+        Returns True when a verifying map was installed."""
         if not LocalTaskStore.completion_digest_applies(
                 self.meta.get("digest", ""), self.content_range is not None):
-            return None  # no completion re-hash would run: nothing to save
+            return False  # no completion re-hash would run: nothing to save
         content = self.store.metadata.content_length
         if content <= 0:
-            return None
+            return False
         if not self.store.pieces_verified_against_digests():
             # Some piece landed without a verified-against digest: no
             # certified map can ever engage the skip — waiting is futile.
-            return None
+            return False
         loop = asyncio.get_running_loop()
         deadline = loop.time() + self._cert_wait_bound(content)
         disp = self.dispatcher
         while disp.pending_certifiers():
             remaining = deadline - loop.time()
             if remaining <= 0:
-                return None
+                break  # deadline-edge done still gets the final attempt
             disp.certified_event.clear()
-            certified = disp.certified_digests()
-            if certified:
-                return certified
+            if self.store.apply_certification(disp.certified_digest_maps()):
+                return True
             try:
                 await asyncio.wait_for(disp.certified_event.wait(), remaining)
             except asyncio.TimeoutError:
-                return None
-        return disp.certified_digests()
+                break
+        return self.store.apply_certification(disp.certified_digest_maps())
 
     @staticmethod
     def _cert_wait_bound(content_length: int) -> float:

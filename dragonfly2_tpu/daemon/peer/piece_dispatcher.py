@@ -49,8 +49,8 @@ class PieceDispatcher:
         self._inflight: set[int] = set()
         self.piece_digests: dict[int, str] = {}
         # Per-parent digest maps + the set of parents whose sync stream
-        # reported done (see certified_digests for why provenance, not a
-        # merged view, drives the re-hash-skip decision).
+        # reported done (see certified_digest_maps for why provenance,
+        # not a merged view, drives the re-hash-skip decision).
         self.parent_digests: dict[str, dict[int, str]] = {}
         self.done_parents: set[str] = set()
         # Incremental ready-tracking: O(1) amortized per assignment instead
@@ -114,19 +114,18 @@ class PieceDispatcher:
         self.done_parents.add(peer_id)
         self.certified_event.set()
 
-    def certified_digests(self) -> "dict[int, str] | None":
-        """The piece-digest map of a DONE parent, or None when no parent
-        has reported done. Provenance matters: a still-downloading
-        back-sourcing parent's announced digests are self-computed and
-        uncertified — the re-hash-skip decision must compare the digests
-        pieces were actually verified against to a VALIDATED parent's
-        map, never to the merged view (a corrupt parent's entries would
-        otherwise be laundered by an honest parent's done)."""
-        for pid in self.done_parents:
-            digests = self.parent_digests.get(pid)
-            if digests:
-                return digests
-        return None
+    def certified_digest_maps(self) -> "list[dict[int, str]]":
+        """EVERY done parent's non-empty digest map. Provenance matters:
+        a still-downloading back-sourcing parent's announced digests are
+        self-computed and uncertified — the re-hash-skip decision must
+        compare the digests pieces were actually verified against to a
+        VALIDATED parent's map, never to the merged view (a corrupt
+        parent's entries would otherwise be laundered by an honest
+        parent's done). The consumer (store.apply_certification) tries
+        each map: a corrupt parent that happens to complete first must
+        not mask an honest completed parent's certification."""
+        return [m for pid in self.done_parents
+                if (m := self.parent_digests.get(pid))]
 
     def pending_certifiers(self) -> bool:
         """Could a certification still arrive? True while some unblocked

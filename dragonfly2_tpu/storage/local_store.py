@@ -353,25 +353,47 @@ class LocalTaskStore:
         with self._meta_lock:
             return all(n in self._verified_pieces for n in self.metadata.pieces)
 
-    def pieces_all_digest_verified(self) -> bool:
-        """True when the content is complete and every piece's
-        verified-against digest MATCHES a certified parent's map
-        (``certified_digests`` — the map of a parent whose completion
-        gate passed; seeds validate the full digest before done). The
-        per-piece comparison is what makes provenance stick: pieces
-        verified against a corrupt still-downloading parent's
-        self-computed digests will not match an honest done parent's
-        map, so they force the full re-hash instead of being laundered
-        by it. This is the precondition for skipping the whole-content
-        re-hash on completion (reference parity: Dragonfly2 children
-        trust the verified piece-digest chain, pieceMd5Sign)."""
-        certified = self.certified_digests
-        if not self.is_complete() or not certified:
+    def certifies(self, certified: "dict[int, str] | None") -> bool:
+        """Pure predicate: would this candidate digest map certify the
+        store — content complete and every piece's verified-against
+        digest matching the map? The per-piece comparison is what makes
+        provenance stick: pieces verified against a corrupt
+        still-downloading parent's self-computed digests will not match
+        an honest done parent's map, so they force the full re-hash
+        instead of being laundered by it (reference parity: Dragonfly2
+        children trust the verified piece-digest chain, pieceMd5Sign)."""
+        if not certified or not self.is_complete():
             return False
         with self._meta_lock:
             return all(self._verified_pieces.get(n) is not None
                        and self._verified_pieces[n] == certified.get(n)
                        for n in self.metadata.pieces)
+
+    def apply_certification(self, candidate_maps) -> bool:
+        """Install the first candidate digest map that certifies the
+        store (``certifies``); trying every map means a corrupt parent
+        that completed first cannot mask an honest completed parent's
+        certification. An already-installed verifying map is never
+        downgraded; non-verifying candidates install nothing (the
+        completion decision re-hashes either way). Returns True when a
+        verifying map is installed."""
+        if self.certifies(self.certified_digests):
+            return True
+        for m in candidate_maps:
+            if self.certifies(m):
+                # Snapshot: the candidate is the dispatcher's live
+                # per-parent dict; a later re-announcement must not
+                # mutate the installed certification.
+                self.certified_digests = dict(m)
+                return True
+        return False
+
+    def pieces_all_digest_verified(self) -> bool:
+        """True when the installed ``certified_digests`` map (set at
+        completion from a done parent's own announcements) certifies the
+        store — the precondition for skipping the whole-content re-hash
+        on completion. See ``certifies`` for the provenance argument."""
+        return self.certifies(self.certified_digests)
 
     def _commit_piece_record(self, rec: PieceRecord) -> PieceRecord:
         """The single metadata-commit point for both write paths (in-memory

@@ -392,9 +392,9 @@ def test_dead_scheduler_at_register_degrades_to_back_source(run_async, tmp_path)
 
 
 def test_certified_digests_provenance():
-    """certified_digests returns only a DONE parent's own map — a corrupt
-    still-downloading parent's announced digests must not be certified by
-    an honest parent's completion."""
+    """certified_digest_maps returns only DONE parents' own maps — a
+    corrupt still-downloading parent's announced digests must not be
+    certified by an honest parent's completion."""
     from dragonfly2_tpu.daemon.peer.piece_dispatcher import PieceDispatcher
 
     d = PieceDispatcher()
@@ -402,30 +402,56 @@ def test_certified_digests_provenance():
     d.upsert_parent("honest", "10.0.0.2", 1)
     d.on_parent_pieces("corrupt", [0, 1],
                        digests={0: "crc32c:bad00000", 1: "crc32c:bad00001"})
-    assert d.certified_digests() is None          # nobody done yet
+    assert d.certified_digest_maps() == []        # nobody done yet
     d.on_parent_pieces("honest", [0, 1],
                        digests={0: "crc32c:00000aaa", 1: "crc32c:00000bbb"})
     d.note_parent_done("honest")
-    certified = d.certified_digests()
-    assert certified == {0: "crc32c:00000aaa", 1: "crc32c:00000bbb"}
+    assert d.certified_digest_maps() == [
+        {0: "crc32c:00000aaa", 1: "crc32c:00000bbb"}]
     # The merged view (scheduling convenience) may hold the corrupt
     # values, but certification never reads it.
     assert d.piece_digests[0] in ("crc32c:bad00000", "crc32c:00000aaa")
+    # certified_digest_maps exposes EVERY done parent's map so the store
+    # can pick the one that verifies — done-ness alone does not elect one.
+    d.note_parent_done("corrupt")
+    maps = d.certified_digest_maps()
+    assert {0: "crc32c:00000aaa", 1: "crc32c:00000bbb"} in maps
+    assert {0: "crc32c:bad00000", 1: "crc32c:bad00001"} in maps
+
+
+class _CertStubStore:
+    """Minimal store for _await_certification unit tests: a pluggable
+    certifies predicate plus the REAL apply_certification (one scan-and-
+    install implementation, not a test copy)."""
+
+    from dragonfly2_tpu.storage.local_store import LocalTaskStore as _LTS
+    apply_certification = _LTS.apply_certification
+
+    def __init__(self, content_length: int, pieces_verified: bool, certifies):
+        import types
+
+        self.metadata = types.SimpleNamespace(content_length=content_length)
+        self._pieces_verified = pieces_verified
+        self._certifies = certifies
+        self.certified_digests = None
+
+    def pieces_verified_against_digests(self):
+        return self._pieces_verified
+
+    def certifies(self, m):
+        return bool(m) and self._certifies(m)
 
 
 def _await_cert_conductor(content_length: int, meta: dict, *,
-                          pieces_verified: bool = True):
+                          pieces_verified: bool = True, certifies=None):
     """Minimal conductor for _await_certification unit tests: the method
-    touches only meta, content_range, store (content_length + the
-    verified-pieces precondition) and the dispatcher."""
-    import types
-
+    touches only meta, content_range, the stub store and the dispatcher."""
     from dragonfly2_tpu.daemon.peer.conductor import PeerTaskConductor
 
     c = PeerTaskConductor(
-        task_id="t", peer_id="p", url="http://x/", store=types.SimpleNamespace(
-            metadata=types.SimpleNamespace(content_length=content_length),
-            pieces_verified_against_digests=lambda: pieces_verified),
+        task_id="t", peer_id="p", url="http://x/",
+        store=_CertStubStore(content_length, pieces_verified,
+                             certifies or (lambda m: True)),
         scheduler_client=None, piece_manager=None, host_info={}, meta=meta)
     return c
 
@@ -450,11 +476,38 @@ class TestAwaitCertification:
 
             t = asyncio.ensure_future(late_done())
             t0 = asyncio.get_running_loop().time()
-            certified = await c._await_certification()
+            assert await c._await_certification() is True
             elapsed = asyncio.get_running_loop().time() - t0
             await t
-            assert certified == digests
+            assert c.store.certified_digests == digests
             assert elapsed < 0.5, "wait must end at the done, not the bound"
+
+        run_async(body(), timeout=10)
+
+    def test_corrupt_early_done_does_not_eat_the_budget(self, run_async):
+        async def body():
+            # Corrupt parent done at t=0 (its map doesn't certify); honest
+            # parent's done lands mid-wait — the wait must ride past the
+            # corrupt map and return the honest one.
+            honest = {0: "crc32c:0000000a"}
+            corrupt = {0: "crc32c:deadbeef"}
+            c = _await_cert_conductor(
+                512 << 20, {"digest": "sha256:x"},
+                certifies=lambda m: m == honest)
+            c.dispatcher.upsert_parent("bad", "10.0.0.1", 1)
+            c.dispatcher.upsert_parent("good", "10.0.0.2", 1)
+            c.dispatcher.on_parent_pieces("bad", [0], digests=corrupt)
+            c.dispatcher.note_parent_done("bad")
+
+            async def honest_done():
+                await asyncio.sleep(0.03)
+                c.dispatcher.on_parent_pieces("good", [0], digests=honest)
+                c.dispatcher.note_parent_done("good")
+
+            t = asyncio.ensure_future(honest_done())
+            assert await c._await_certification() is True
+            await t
+            assert c.store.certified_digests == honest
 
         run_async(body(), timeout=10)
 
@@ -475,7 +528,7 @@ class TestAwaitCertification:
             c = _await_cert_conductor(64 << 20, {"digest": "sha256:x"})
             c.dispatcher.upsert_parent("seed", "10.0.0.1", 1)  # never done
             t0 = asyncio.get_running_loop().time()
-            assert await c._await_certification() is None
+            assert await c._await_certification() is False
             elapsed = asyncio.get_running_loop().time() - t0
             assert 0.15 <= elapsed < 1.5, elapsed
 
@@ -489,7 +542,7 @@ class TestAwaitCertification:
                                       pieces_verified=False)
             c.dispatcher.upsert_parent("seed", "10.0.0.1", 1)
             t0 = asyncio.get_running_loop().time()
-            assert await c._await_certification() is None
+            assert await c._await_certification() is False
             assert asyncio.get_running_loop().time() - t0 < 0.05
 
         run_async(body(), timeout=10)
@@ -509,7 +562,7 @@ class TestAwaitCertification:
 
             t = asyncio.ensure_future(demote())
             t0 = asyncio.get_running_loop().time()
-            assert await c._await_certification() is None
+            assert await c._await_certification() is False
             elapsed = asyncio.get_running_loop().time() - t0
             await t
             assert elapsed < 1.0, elapsed
@@ -521,7 +574,7 @@ class TestAwaitCertification:
             c = _await_cert_conductor(64 << 20, {})  # no whole-content digest
             c.dispatcher.upsert_parent("seed", "10.0.0.1", 1)
             t0 = asyncio.get_running_loop().time()
-            assert await c._await_certification() is None
+            assert await c._await_certification() is False
             assert asyncio.get_running_loop().time() - t0 < 0.05
 
         run_async(body(), timeout=10)
@@ -538,7 +591,7 @@ class TestAwaitCertification:
 
             t = asyncio.ensure_future(drop())
             t0 = asyncio.get_running_loop().time()
-            assert await c._await_certification() is None
+            assert await c._await_certification() is False
             elapsed = asyncio.get_running_loop().time() - t0
             await t
             assert elapsed < 1.0, elapsed

@@ -295,3 +295,23 @@ def test_pieces_all_digest_verified_tracking(tmp_path):
     assert not store2.pieces_all_digest_verified()
     store2.certified_digests = good
     assert store2.pieces_all_digest_verified()
+
+    # apply_certification tries every done parent's map: a corrupt early
+    # finisher cannot mask an honest one.
+    corrupt = {0: str(d), 1: "crc32c:deadbeef"}
+    store2.certified_digests = None
+    assert store2.apply_certification([corrupt, good]) is True
+    assert store2.certified_digests == good
+    assert store2.pieces_all_digest_verified()
+    # An installed verifying map is never downgraded by later candidates.
+    assert store2.apply_certification([corrupt]) is True
+    assert store2.certified_digests == good
+    # Only corrupt candidates from scratch: nothing installed — the
+    # completion decision re-hashes either way.
+    store2.certified_digests = None
+    assert store2.apply_certification([corrupt]) is False
+    assert store2.certified_digests is None
+    assert not store2.pieces_all_digest_verified()
+    # Empty candidate list: nothing installed, nothing clobbered.
+    assert store2.apply_certification([]) is False
+    assert store2.certified_digests is None
