@@ -35,6 +35,37 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+
+def _host_hash_gbps(procs: int = 4, mb_each: int = 96) -> "float | None":
+    """Aggregate sha256 GB/s across ``procs`` CONCURRENT subprocesses,
+    timed over the overlapping hash phase only (interpreter startup
+    excluded via in-child wall timestamps). Single-process rates on this
+    VM stay flat (~1.1 GB/s) even in windows where multi-process
+    throughput collapses several-x, so the window-quality signal must
+    itself be multi-process."""
+    reps = mb_each // 16
+    code = ("import hashlib,os,time;"
+            "b=os.urandom(1<<24);"
+            "t0=time.time();"
+            "h=hashlib.sha256();"
+            f"[h.update(b) for _ in range({reps})];"
+            "print(t0, time.time())")
+    try:
+        ps = [subprocess.Popen([sys.executable, "-c", code],
+                               stdout=subprocess.PIPE, text=True)
+              for _ in range(procs)]
+        spans = []
+        for p in ps:
+            out, _ = p.communicate()
+            t0, t1 = (float(x) for x in out.split())
+            spans.append((t0, t1))
+        wall = max(t1 for _, t1 in spans) - min(t0 for t0, _ in spans)
+        return round(procs * reps * (1 << 24) / max(wall, 1e-6) / 1e9, 3)
+    except Exception:
+        # Auxiliary metric only: a failed calibration child (OOM kill,
+        # empty stdout) must never destroy the primary bench result.
+        return None
+
 from aiohttp import web  # noqa: E402
 
 from dragonfly2_tpu.pkg.piece import Range  # noqa: E402
@@ -107,7 +138,8 @@ async def run_bench(total_mb: int, n_peers: int, workdir: str,
                     profile: bool = False,
                     origin_concurrency: int = 4,
                     device_sink: bool = False,
-                    warm_seed: bool = False) -> dict:
+                    warm_seed: bool = False,
+                    host_hash_gbps: "float | None" = None) -> dict:
     # randbytes caps at 2^31 bits; build large content from 16 MiB blocks.
     rng = random.Random(99)
     content = b"".join(rng.randbytes(16 << 20)
@@ -271,6 +303,12 @@ async def run_bench(total_mb: int, n_peers: int, workdir: str,
             "origin_streams": stats["streams"],
             "origin_concurrency": origin_concurrency,
             "host_cores": os.cpu_count(),
+            # Window-quality calibration: AGGREGATE sha256 GB/s over 4
+            # concurrent subprocesses, measured BEFORE the fabric spawned
+            # (this VM's schedulable CPU swings several-x between
+            # measurement windows; the field lets medians be compared
+            # like-for-like instead of mixing fast- and slow-window runs).
+            "host_hash_gbps": host_hash_gbps,
             "device_sink": device_sink,
         }
         if warm_seed:
@@ -321,11 +359,15 @@ def main() -> int:
     import tempfile
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="df-fanout-")
+    # Calibrate BEFORE the fabric exists: ~10 daemon processes contending
+    # with the calibration children would depress the reading.
+    host_hash_gbps = _host_hash_gbps()
     result = asyncio.run(run_bench(args.mb, args.peers, workdir,
                                    profile=args.profile,
                                    origin_concurrency=args.origin_concurrency,
                                    device_sink=args.device_sink,
-                                   warm_seed=args.warm_seed))
+                                   warm_seed=args.warm_seed,
+                                   host_hash_gbps=host_hash_gbps))
     if args.profile:
         for role, text in (result.get("profiles") or {}).items():
             sys.stderr.write(f"\n=== {role} profile (top cumulative, "
