@@ -68,6 +68,7 @@ def _host_hash_gbps(procs: int = 4, mb_each: int = 96) -> "float | None":
 
 from aiohttp import web  # noqa: E402
 
+from dragonfly2_tpu.pkg.hermetic import scrub_accelerator_env  # noqa: E402
 from dragonfly2_tpu.pkg.piece import Range  # noqa: E402
 
 
@@ -89,9 +90,7 @@ def _spawn(args: list[str], log_path: str,
         # Device-sink daemons: a real single-device CPU backend (the
         # jax.Array landing path the TPU sink uses, minus the chip).
         env["JAX_PLATFORMS"] = "cpu"
-        for key in list(env):
-            if key.startswith(("PALLAS_AXON", "AXON_", "TPU_", "LIBTPU")):
-                del env[key]
+        scrub_accelerator_env(env)
     logf = open(log_path, "w")
     return subprocess.Popen(
         [sys.executable, "-m", "dragonfly2_tpu.cli.main", *args],

@@ -1,0 +1,30 @@
+"""Accelerator-plugin environment scrub for hermetic subprocesses.
+
+The sandbox ships a ``sitecustomize`` that dials a TPU relay whenever
+``PALLAS_AXON_POOL_IPS`` is set, so any subprocess that must stay
+device-free (CPU dryruns, bench daemons, E2E children) has to drop every
+accelerator-plugin trigger var before spawning — inheriting even one makes
+the "clean" child block on a wedged tunnel (round-3 failure:
+MULTICHIP_r03 rc=124 with no diagnostic). This is the single shared scrub;
+spawners must not carry private copies of the prefix list, because a new
+trigger prefix added in one copy and missed in another silently regresses
+hermeticity exactly where it is least observable.
+"""
+
+from __future__ import annotations
+
+# Every env-var prefix that can cause an accelerator plugin (axon relay,
+# libtpu) to initialize inside a subprocess that should never touch one.
+ACCELERATOR_ENV_PREFIXES = ("PALLAS_AXON", "AXON_", "TPU_", "LIBTPU")
+
+
+def scrub_accelerator_env(env: dict) -> dict:
+    """Delete accelerator-plugin trigger vars from ``env`` in place.
+
+    Returns the same mapping for call-chaining. Callers that also need a
+    specific JAX platform or XLA flags set them after scrubbing.
+    """
+    for key in list(env):
+        if key.startswith(ACCELERATOR_ENV_PREFIXES):
+            del env[key]
+    return env

@@ -99,12 +99,13 @@ class Peer:
         return self.fsm.current in (PeerState.SUCCEEDED, PeerState.FAILED, PeerState.LEAVE)
 
     def to_wire(self) -> dict:
+        finished_sorted = sorted(self.finished_pieces)
         return {
             "id": self.id,
             "task_id": self.task.id,
             "host": self.host.to_wire(),
             "state": self.state,
-            "finished_pieces": sorted(self.finished_pieces),
+            "finished_pieces": finished_sorted,
             # Digests for the LOWEST-numbered advertised pieces (from
             # this task's piece reports): children pull lowest-first, so
             # this covers the window before the parent's sync snapshot
@@ -114,7 +115,7 @@ class Peer:
             # not re-serialize 25k digests per candidate per reschedule.
             "piece_digests": {
                 n: self.task.pieces[n].digest
-                for n in sorted(self.finished_pieces)[:512]
+                for n in finished_sorted[:512]
                 if n in self.task.pieces and self.task.pieces[n].digest},
             "is_seed": self.is_seed,
             "priority": self.priority,

@@ -379,6 +379,13 @@ class SchedulerService:
 
     def _apply_piece_finished(self, p: dict, task: Task, peer: Peer) -> None:
         info = PieceInfo.from_wire(p)
+        if info.piece_num in peer.finished_pieces:
+            # Duplicate report: the client's flush restores a popped batch
+            # on cancellation even when the send hit the wire (at-least-once
+            # delivery), so application must be idempotent — a re-send must
+            # not re-count the parent's upload or duplicate cost samples.
+            peer.touch()
+            return
         first_piece = not peer.finished_pieces
         peer.add_finished_piece(info.piece_num, info.download_cost_ms)
         task.store_piece(info)

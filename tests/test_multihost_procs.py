@@ -21,6 +21,8 @@ import sys
 
 import pytest
 
+from dragonfly2_tpu.pkg.hermetic import scrub_accelerator_env
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _WORKER = r"""
@@ -109,9 +111,7 @@ def test_two_process_global_assembly(tmp_path):
         })
         # The sandbox sitecustomize dials an accelerator relay when this
         # is set; these workers must stay CPU-pure (see __graft_entry__).
-        for key in list(env):
-            if key.startswith(("PALLAS_AXON", "AXON_", "TPU_", "LIBTPU")):
-                del env[key]
+        scrub_accelerator_env(env)
         try:
             procs.append(subprocess.Popen(
                 [sys.executable, "-c", _WORKER], env=env,

@@ -27,6 +27,7 @@ import time
 import pytest
 from aiohttp import web
 
+from dragonfly2_tpu.pkg.hermetic import scrub_accelerator_env
 from dragonfly2_tpu.pkg.piece import Range
 
 CONTENT = bytes(random.Random(77).randbytes(24 * 1024 * 1024))
@@ -78,11 +79,9 @@ def _spawn(args: list[str], log_path: str,
     if jax_cpu:
         # Device-sink daemon: single-device CPU jax backend, with the
         # sandbox's accelerator-plugin triggers scrubbed (they dial a TPU
-        # relay — see __graft_entry__._cpu_mesh_env).
+        # relay — see pkg/hermetic.py).
         env["JAX_PLATFORMS"] = "cpu"
-        for key in list(env):
-            if key.startswith(("PALLAS_AXON", "AXON_", "TPU_", "LIBTPU")):
-                del env[key]
+        scrub_accelerator_env(env)
     logf = open(log_path, "w")
     return subprocess.Popen(
         [sys.executable, "-m", "dragonfly2_tpu.cli.main", *args],

@@ -306,3 +306,34 @@ class TestDirectPieceVerification:
         task = Task("t-tiny3", "http://x")
         task.content_length = 8
         assert SchedulerService._verify_direct_piece(task, b"whatever")
+
+
+class TestPieceReportIdempotency:
+    def test_duplicate_piece_finished_is_a_noop(self):
+        """The client's report flush is at-least-once (a cancelled flush
+        restores a batch whose send may already have hit the wire), so the
+        scheduler must apply duplicates idempotently: no double upload_count
+        on the parent, no duplicate cost samples skewing bad-node stats."""
+        from dragonfly2_tpu.scheduler.service import SchedulerService
+
+        svc = SchedulerService()
+        task = Task("t-dup", "http://x")
+        parent = make_peer("pp-dup", task, make_host("hp-dup"),
+                           state=PeerState.RUNNING, pieces=4)
+        child = make_peer("pc-dup", task, make_host("hc-dup"),
+                          state=PeerState.RUNNING)
+        svc.peers.load_or_store(parent)
+        svc.peers.load_or_store(child)
+
+        report = {"piece_num": 0, "range_start": 0, "range_size": 256,
+                  "digest": "crc32c:abc", "download_cost_ms": 12,
+                  "dst_peer_id": parent.id}
+        svc._apply_piece_finished(dict(report), task, child)
+        assert parent.host.upload_count == 1
+        assert child.finished_pieces == {0}
+        assert list(child.piece_costs) == [12]
+
+        svc._apply_piece_finished(dict(report), task, child)  # re-delivery
+        assert parent.host.upload_count == 1
+        assert child.finished_pieces == {0}
+        assert list(child.piece_costs) == [12]
