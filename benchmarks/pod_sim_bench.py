@@ -97,6 +97,7 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
     schedule_lat: list[float] = []
     parent_picks = {"intra": 0, "cross": 0}
     healthy_picks = {"intra": 0, "cross": 0}
+    ceiling_picks = {"intra": 0, "total": 0}
     finished: set[int] = set()
     max_lag = 0.0
     killed_slice = 1 if churn else -1
@@ -135,6 +136,35 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
             if kind == "need_back_source":
                 origin_fetches += 1
             elif kind == "normal_task":
+                # Counterfactual ceiling: even a perfect intra-first
+                # scheduler can only hand out as many intra-slice parents
+                # as slice-mates EXIST at this instant — early arrivals in
+                # the register storm have none. Recording min(picks,
+                # mates_present) per handout turns intra_slice_frac into a
+                # conversion rate against what the arrival pattern allows,
+                # instead of an absolute number that silently blends
+                # scheduling quality with arrival timing.
+                parents_in_msg = msg.get("parents") or []
+                npicks = len(parents_in_msg)
+                intra_in_msg = sum(
+                    1 for p in parents_in_msg
+                    if (p.get("host") or {}).get("tpu_slice") == my_slice)
+                task_obj = svc.tasks.load(body["task_id"])
+                mates = 0
+                if task_obj is not None:
+                    for pid in task_obj.slice_index.get(my_slice, ()):
+                        if pid == body["peer_id"]:
+                            continue
+                        q = task_obj.load_peer(pid)
+                        if q is not None and q.fsm.current not in (
+                                "failed", "leave"):
+                            mates += 1
+                # mates is read at response-receipt time; a picked mate
+                # that failed in between would under-count the ceiling, so
+                # the scheduler's own intra picks are the floor.
+                ceiling_picks["intra"] += min(npicks,
+                                              max(mates, intra_in_msg))
+                ceiling_picks["total"] += npicks
                 for p in msg.get("parents") or []:
                     pslice = (p.get("host") or {}).get("tpu_slice", "")
                     key = "intra" if pslice == my_slice else "cross"
@@ -232,6 +262,12 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
         "healthy_intra_slice_frac": round(
             healthy_picks["intra"] / healthy_total, 3)
         if healthy_total else 0.0,
+        "intra_slice_ceiling": round(
+            ceiling_picks["intra"] / ceiling_picks["total"], 3)
+        if ceiling_picks["total"] else 0.0,
+        "intra_conversion": round(
+            parent_picks["intra"] / ceiling_picks["intra"], 3)
+        if ceiling_picks["intra"] else 0.0,
         "killed_peers": len(dead_peer_ids),
         "straggler_parent_picks": straggler_pick_count,
         "straggler_dead_parent_picks": straggler_dead_picks,

@@ -141,7 +141,38 @@ class Scheduling:
         if not candidates:
             return []
         ranked = self.evaluator.evaluate_parents(candidates, peer, task.total_piece_count)
-        return ranked[: self.config.candidate_parent_limit]
+        if my_slice:
+            # ICI-lexicographic rule: ANY serving slice-mate outranks ANY
+            # cross-slice parent. Intra-slice traffic rides ICI (hundreds
+            # of GB/s, no NIC involvement); cross-slice rides the DCN NIC
+            # — an order-of-magnitude gap no weighted-sum edge can
+            # express, so it is a partition, not a weight. Candidates are
+            # serving parents OR warming slice-mates (_is_candidate's
+            # 0-piece relay rule), so the head of the list is intra but
+            # not necessarily producing yet — the all-warming guard
+            # below is load-bearing. The stable partition keeps the
+            # evaluator's order inside each group: slice-mates spread by
+            # free-upload/piece score (warming mates score last), and
+            # cross-slice ingress remains the fallback when the slice has
+            # no serving member yet (its first arrival). This is what
+            # builds the broadcast tree — ~1 DCN ingress per slice, ICI
+            # fan-out inside — that the pod-sim's intra_slice_frac gauges.
+            ranked.sort(key=lambda p: p.host.tpu_slice != my_slice)
+        out = ranked[: self.config.candidate_parent_limit]
+        # A handout must contain ≥1 parent that serves NOW (succeeded,
+        # piece-holding, or back-sourcing). Warming slice-mates may fill
+        # the list in a registration storm, and a handout of only those
+        # leaves the child's first piece hostage to the relay chain's own
+        # schedule — swap the tail slot for the best serving candidate.
+        if out and all(p.fsm.current == PeerState.RUNNING
+                       and p.finished_piece_count() == 0 for p in out):
+            serving = next(
+                (p for p in ranked[self.config.candidate_parent_limit:]
+                 if p.fsm.current != PeerState.RUNNING
+                 or p.finished_piece_count() > 0), None)
+            if serving is not None:
+                out[-1] = serving
+        return out
 
     def _is_candidate(self, parent: Peer, child: Peer, blocklist: set[str]) -> bool:
         """Filter rules (reference filterCandidateParents :500-577)."""
@@ -159,12 +190,29 @@ class Scheduling:
             # running pieceless task and pushes pieces as they land
             # (rpcserver SyncPieceTasks), so handing it out at
             # registration removes a report+wakeup round trip from every
-            # waiting child's time-to-first-piece. BACK_TO_SOURCE only: a
-            # seed-host peer in RUNNING (e.g. a replication pull waiting
-            # for its own parents) produces nothing yet — pointing
-            # children at it would burn their starvation window.
+            # waiting child's time-to-first-piece. Allowed producers:
+            #   - BACK_TO_SOURCE: actively pulling from origin (the
+            #     just-triggered seed);
+            #   - a WARMING SLICE-MATE: RUNNING in the child's own slice
+            #     with its parent edges already wired. Its pieces relay
+            #     down the intra-slice chain (ICI) moments later, and the
+            #     child keeps any serving parents in the same handout, so
+            #     this builds the slice's pipelined broadcast chain
+            #     instead of a 3rd-4th cross-slice (DCN) stream. A
+            #     RUNNING peer with no parents wired (e.g. a seed-host
+            #     replication pull still waiting for its own schedule)
+            #     stays excluded — it produces nothing yet and would burn
+            #     the child's starvation window.
             if parent.fsm.current != PeerState.BACK_TO_SOURCE:
-                return False
+                warming_slice_mate = (
+                    parent.fsm.current == PeerState.RUNNING
+                    and bool(parent.host.tpu_slice)
+                    and parent.host.tpu_slice == child.host.tpu_slice
+                    and child.task.dag.has_vertex(parent.id)
+                    and len(child.task.dag.get_vertex(parent.id).parents) > 0
+                )
+                if not warming_slice_mate:
+                    return False
         if parent.host.free_upload_count() <= 0:
             return False
         if self.evaluator.is_bad_node(parent):

@@ -337,3 +337,86 @@ class TestPieceReportIdempotency:
         assert parent.host.upload_count == 1
         assert child.finished_pieces == {0}
         assert list(child.piece_costs) == [12]
+
+
+class TestICILexicographicRanking:
+    def test_serving_slice_mate_outranks_cross_slice_seed(self):
+        """A 1-piece slice-mate still downloading must rank ahead of a
+        piece-complete cross-slice super seed: intra-slice transfer rides
+        ICI, cross-slice rides the DCN NIC — a partition, not a weight
+        (scheduling.find_candidate_parents)."""
+        s = Scheduling(SchedulingConfig(retry_interval=0.01))
+        t = Task("t-ici", "http://x")
+        t.total_piece_count = 10
+        child = make_peer("child", t,
+                          make_host("hc", tpu_slice="slice-a", idc="pod-1"))
+        make_peer("seed", t,
+                  make_host("hs", host_type=HostType.SUPER_SEED,
+                            tpu_slice="slice-z", idc="pod-1"),
+                  state=PeerState.SUCCEEDED, pieces=10)
+        make_peer("mate", t,
+                  make_host("hm", tpu_slice="slice-a", idc="pod-1"),
+                  state=PeerState.RUNNING, pieces=1)
+        parents = s.find_candidate_parents(child)
+        assert [p.id for p in parents] == ["mate", "seed"]
+
+    def test_sliceless_slice_falls_back_to_cross_ingress(self):
+        """The slice's first arrival has no serving slice-mate: the
+        cross-slice seed must still be handed out (the broadcast tree's
+        one DCN ingress per slice)."""
+        s = Scheduling(SchedulingConfig(retry_interval=0.01))
+        t = Task("t-ici2", "http://x")
+        t.total_piece_count = 10
+        child = make_peer("child", t,
+                          make_host("hc", tpu_slice="slice-a", idc="pod-1"))
+        make_peer("seed", t,
+                  make_host("hs", host_type=HostType.SUPER_SEED,
+                            tpu_slice="slice-z", idc="pod-1"),
+                  state=PeerState.SUCCEEDED, pieces=10)
+        parents = s.find_candidate_parents(child)
+        assert [p.id for p in parents] == ["seed"]
+
+    def test_warming_slice_mate_is_a_candidate(self):
+        """A RUNNING 0-piece slice-mate with its parent edges wired is a
+        valid candidate (the intra-slice relay chain): its pieces arrive
+        over ICI moments later. The same peer with NO parents wired stays
+        excluded — it produces nothing and burns the starvation window."""
+        s = Scheduling(SchedulingConfig(retry_interval=0.01))
+        t = Task("t-warm", "http://x")
+        t.total_piece_count = 10
+        child = make_peer("child", t,
+                          make_host("hc", tpu_slice="slice-a"))
+        seed = make_peer("seed", t,
+                         make_host("hs", host_type=HostType.SUPER_SEED,
+                                   tpu_slice="slice-z"),
+                         state=PeerState.SUCCEEDED, pieces=10)
+        mate = make_peer("mate", t,
+                         make_host("hm", tpu_slice="slice-a"),
+                         state=PeerState.RUNNING, pieces=0)
+        # Not yet wired: excluded.
+        assert [p.id for p in s.find_candidate_parents(child)] == ["seed"]
+        t.add_peer_edge(seed.id, mate.id)  # mate now actively downloading
+        assert [p.id for p in s.find_candidate_parents(child)] == ["mate", "seed"]
+
+    def test_handout_never_only_warming_mates(self):
+        """When warming slice-mates fill the candidate limit, the tail
+        slot must be swapped for a parent that serves NOW — a handout of
+        only 0-piece relays leaves ttfp hostage to the chain."""
+        s = Scheduling(SchedulingConfig(retry_interval=0.01))
+        t = Task("t-warm2", "http://x")
+        t.total_piece_count = 10
+        child = make_peer("child", t,
+                          make_host("hc", tpu_slice="slice-a"))
+        seed = make_peer("seed", t,
+                         make_host("hs", host_type=HostType.SUPER_SEED,
+                                   tpu_slice="slice-z"),
+                         state=PeerState.SUCCEEDED, pieces=10)
+        limit = s.config.candidate_parent_limit
+        for i in range(limit + 1):
+            m = make_peer(f"mate{i}", t,
+                          make_host(f"hm{i}", tpu_slice="slice-a"),
+                          state=PeerState.RUNNING, pieces=0)
+            t.add_peer_edge(seed.id, m.id)
+        parents = s.find_candidate_parents(child)
+        assert len(parents) == limit
+        assert "seed" in [p.id for p in parents]
