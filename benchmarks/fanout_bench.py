@@ -138,6 +138,7 @@ async def run_bench(total_mb: int, n_peers: int, workdir: str,
                     origin_concurrency: int = 4,
                     device_sink: bool = False,
                     warm_seed: bool = False,
+                    slices: int = 0,
                     host_hash_gbps: "float | None" = None) -> dict:
     # randbytes caps at 2^31 bits; build large content from 16 MiB blocks.
     rng = random.Random(99)
@@ -169,13 +170,16 @@ async def run_bench(total_mb: int, n_peers: int, workdir: str,
     origin_port = site._server.sockets[0].getsockname()[1]
 
     sched_port = _free_port()
+    sched_metrics = _free_port() if slices else 0
     procs: list[subprocess.Popen] = []
     names = ["seed"] + [f"peer{i}" for i in range(n_peers)]
     homes = {n: os.path.join(workdir, n) for n in names}
     try:
-        procs.append(_spawn(
-            ["scheduler", "--host", "127.0.0.1", "--port", str(sched_port)],
-            os.path.join(workdir, "sched.log")))
+        sched_args = ["scheduler", "--host", "127.0.0.1",
+                      "--port", str(sched_port)]
+        if slices:
+            sched_args += ["--metrics-port", str(sched_metrics)]
+        procs.append(_spawn(sched_args, os.path.join(workdir, "sched.log")))
         seed_metrics = _free_port() if profile else 0
         peer0_metrics = _free_port() if profile else 0
         seed_args = ["daemon", "--work-home", homes["seed"], "--seed-peer",
@@ -183,10 +187,25 @@ async def run_bench(total_mb: int, n_peers: int, workdir: str,
                      "--piece-concurrency", str(origin_concurrency)]
         if profile:
             seed_args += ["--metrics-port", str(seed_metrics)]
+        if slices:
+            # The seed is the cross-slice ingress: its own slice label is
+            # outside every peer slice, so every seed-sourced handout
+            # counts as cross and intra picks are pure peer↔peer ICI.
+            seed_args += ["--tpu-slice", "slice-seed"]
         procs.append(_spawn(seed_args, os.path.join(workdir, "seed.log")))
+        if slices and slices > n_peers:
+            raise ValueError(f"--slices {slices} > --peers {n_peers}")
         for i in range(n_peers):
             peer_args = ["daemon", "--work-home", homes[f"peer{i}"],
                          "--scheduler", f"127.0.0.1:{sched_port}"]
+            if slices:
+                # Even partition into EXACTLY `slices` contiguous groups
+                # (i*slices//n_peers), so the published "slices" field
+                # always matches the real topology.
+                sid = i * slices // n_peers
+                peer_args += ["--tpu-slice", f"slice-{sid}",
+                              "--tpu-worker-index",
+                              str(i - (sid * n_peers + slices - 1) // slices)]
             if device_sink:
                 peer_args += ["--device-sink"]
             if profile and i == 0:
@@ -313,6 +332,32 @@ async def run_bench(total_mb: int, n_peers: int, workdir: str,
         if warm_seed:
             result["warm_seed"] = True
             result["seed_preheat_s"] = round(seed_warm_s, 2)
+        if slices:
+            # Real-process validation of the ICI-lexicographic rule: the
+            # scheduler's own handout counter, not a sim. The seed carries
+            # an out-of-band slice label, so "cross" = seed ingress +
+            # genuine cross-slice picks.
+            picks = {"intra": 0, "cross": 0, "unlabeled": 0}
+            try:
+                import aiohttp
+
+                from dragonfly2_tpu.pkg.metrics import parse_labeled_samples
+
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(
+                            f"http://127.0.0.1:{sched_metrics}/metrics",
+                            timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                        picks.update(parse_labeled_samples(
+                            await resp.text(),
+                            "dragonfly_tpu_scheduler_parent_picks_total",
+                            "locality"))
+            except Exception as e:  # noqa: BLE001 - diagnostics only
+                picks["scrape_error"] = str(e)
+            result["slices"] = slices
+            result["parent_picks"] = picks
+            labeled = picks["intra"] + picks["cross"]
+            if labeled:
+                result["intra_slice_frac"] = round(picks["intra"] / labeled, 3)
         # The seed is the only origin client; its request fan-in must stay
         # within the configured concurrency (+1 for the initial HEAD-like
         # probe) — against real GCS this is per-task request pressure.
@@ -352,6 +397,9 @@ def main() -> int:
     ap.add_argument("--origin-concurrency", type=int, default=4,
                     help="seed's concurrent origin range streams (asserted "
                          "as the origin's observed request fan-in bound)")
+    ap.add_argument("--slices", type=int, default=0,
+                    help="label peer daemons with N tpu slices and report "
+                         "the scheduler's real intra/cross handout counts")
     ap.add_argument("--workdir", default="")
     args = ap.parse_args()
 
@@ -366,6 +414,7 @@ def main() -> int:
                                    origin_concurrency=args.origin_concurrency,
                                    device_sink=args.device_sink,
                                    warm_seed=args.warm_seed,
+                                   slices=args.slices,
                                    host_hash_gbps=host_hash_gbps))
     if args.profile:
         for role, text in (result.get("profiles") or {}).items():

@@ -207,6 +207,8 @@ def _add_scheduler(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8002)
     p.add_argument("--manager", default="", help="manager drpc addr host:port")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="fixed port for /metrics (0 = ephemeral)")
     p.set_defaults(func=_run_scheduler)
 
 
@@ -222,6 +224,8 @@ def _run_scheduler(args: argparse.Namespace) -> int:
     cfg.server.port = args.port
     if args.manager:
         cfg.manager_addr = args.manager
+    if args.metrics_port:
+        cfg.metrics_port = args.metrics_port
 
     async def run() -> int:
         server = SchedulerServer(cfg)

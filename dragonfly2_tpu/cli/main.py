@@ -173,6 +173,12 @@ def _add_daemon(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--piece-concurrency", type=int, default=0,
                    help="concurrent origin range streams for back-to-source "
                         "(0 = config default; caps origin request fan-in)")
+    p.add_argument("--tpu-slice", default="",
+                   help="ICI domain label for this host (e.g. slice-3); "
+                        "the scheduler prefers parents inside the same "
+                        "slice lexicographically")
+    p.add_argument("--tpu-worker-index", type=int, default=-1,
+                   help="worker index within the slice")
     p.set_defaults(func=_run_daemon)
 
 
@@ -201,6 +207,10 @@ def _run_daemon(args: argparse.Namespace) -> int:
         cfg.proxy.registry_mirror = args.registry_mirror
     if args.alive_time:
         cfg.alive_time = args.alive_time
+    if args.tpu_slice:
+        cfg.host.tpu_slice = args.tpu_slice
+    if args.tpu_worker_index >= 0:
+        cfg.host.tpu_worker_index = args.tpu_worker_index
     if args.object_storage_port >= 0:
         cfg.object_storage.enabled = True
         cfg.object_storage.port = args.object_storage_port

@@ -63,6 +63,28 @@ def histogram(name: str, doc: str, labels: tuple[str, ...] = (), buckets=None) -
     return _get_or_create(Histogram, name, factory)
 
 
+def parse_labeled_samples(text: str, full_name: str,
+                          label: str) -> dict[str, int]:
+    """Parse one labeled metric's samples out of an exposition-format page:
+    ``{label_value: int(sample)}``. The single parser for every scraper in
+    benches/tests — exposition parsing is just fragile enough that two
+    private copies WILL diverge on the first metric rename."""
+    out: dict[str, int] = {}
+    for line in text.splitlines():
+        if line.startswith("#") or "{" not in line:
+            continue
+        name, rest = line.split("{", 1)
+        if name != full_name or "}" not in rest:
+            continue
+        labels_part, _, value = rest.rpartition("}")
+        for kv in labels_part.split(","):
+            k, _, v = kv.partition("=")
+            if k.strip() == label:
+                key = v.strip().strip('"')
+                out[key] = out.get(key, 0) + int(float(value))
+    return out
+
+
 def render() -> tuple[bytes, str]:
     """Render the registry for an HTTP /metrics endpoint."""
     return generate_latest(_registry), CONTENT_TYPE_LATEST

@@ -49,6 +49,12 @@ REGISTER_SCOPE_COUNT = metrics.counter(
     "scheduler_register_size_scope_total",
     "Peer registrations by task size scope shortcut", ("scope",))
 
+PARENT_PICK_COUNT = metrics.counter(
+    "scheduler_parent_picks_total",
+    "Scheduled parent handouts by ICI locality: intra (same tpu_slice), "
+    "cross (different slices), unlabeled (either end without coordinates)",
+    ("locality",))
+
 
 class SchedulerService:
     def __init__(self, config: SchedulerConfig | None = None):
@@ -295,6 +301,13 @@ class SchedulerService:
         if stream is None:
             return
         if result.kind == ScheduleResult.CANDIDATES:
+            for parent in result.parents:
+                if not peer.host.tpu_slice or not parent.host.tpu_slice:
+                    PARENT_PICK_COUNT.labels("unlabeled").inc()
+                elif parent.host.tpu_slice == peer.host.tpu_slice:
+                    PARENT_PICK_COUNT.labels("intra").inc()
+                else:
+                    PARENT_PICK_COUNT.labels("cross").inc()
             self.scheduling.reattach_peer(peer, result.parents)
             if peer.fsm.can("download"):
                 peer.fsm.event("download")

@@ -363,3 +363,20 @@ def test_metrics_server_endpoints(run_async):
             await srv.close()
 
     run_async(run())
+
+
+class TestParseLabeledSamples:
+    def test_parses_only_the_named_metric(self):
+        from dragonfly2_tpu.pkg.metrics import parse_labeled_samples
+
+        text = "\n".join([
+            "# HELP x_total doc",
+            "# TYPE x_total counter",
+            'x_total{locality="intra"} 12.0',
+            'x_total{locality="cross",other="y"} 3',
+            'x_created{locality="intra"} 1.7e+09',
+            'x_total_more{locality="intra"} 99',
+            "no_labels_total 5",
+        ])
+        got = parse_labeled_samples(text, "x_total", "locality")
+        assert got == {"intra": 12, "cross": 3}
