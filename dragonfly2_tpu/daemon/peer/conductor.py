@@ -299,6 +299,11 @@ class PeerTaskConductor:
             if self.on_piece is not None:
                 await self.on_piece(store, rec)
 
+        if LocalTaskStore.completion_digest_applies(
+                self.meta.get("digest", ""), self.content_range is not None):
+            # Self-computed pieces are never certifiable: the completion
+            # re-hash is certain, so overlap it with the transfer.
+            self.store.start_prefix_hasher(self.meta.get("digest", ""))
         await self.piece_manager.download_source(
             self.store, self.url, self.meta.get("header") or {},
             content_range=self.content_range,

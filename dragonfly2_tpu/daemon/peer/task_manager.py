@@ -229,6 +229,12 @@ class TaskManager:
             if req.disable_back_source:
                 raise DfError(Code.ClientBackSourceError,
                               "no scheduler and back-to-source disabled")
+            if LocalTaskStore.completion_digest_applies(
+                    req.meta.digest, req.range is not None):
+                # Back-source pieces are self-computed — no parent map can
+                # ever certify them — so the completion re-hash is certain:
+                # overlap it with the download (storage _PrefixHasher).
+                store.start_prefix_hasher(req.meta.digest)
             await self.piece_manager.download_source(
                 store, req.url, req.meta.header,
                 content_range=req.range,

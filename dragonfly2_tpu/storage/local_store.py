@@ -105,6 +105,119 @@ class TaskStoreMetadata:
         )
 
 
+class _PrefixHasher:
+    """Background contiguous-prefix hasher: overlaps the completion-time
+    whole-content digest with the download itself.
+
+    Started only for back-to-source transfers with a known full-content
+    digest: self-computed piece digests can never be certified by a done
+    parent, so those tasks always pay the completion re-hash (the
+    reference hashes after download completes — digest_reader.go); hashing
+    committed pieces in piece order WHILE later pieces stream turns that
+    serial tail into overlap. P2P children keep the certification skip and
+    never start one of these.
+
+    Owns a private O_RDONLY fd (the store's fd may be GC-closed mid-life).
+    Only committed pieces are read — commitment is the store's byte-
+    finality point. Any anomaly (re-recorded piece below the frontier,
+    short read, fd error) poisons the hasher; ``finish`` then returns None
+    and the caller falls back to the normal full re-hash, so this is an
+    optimization that can only be bypassed, never wrong."""
+
+    def __init__(self, store: "LocalTaskStore", algorithm: str):
+        self.store = store
+        self.algorithm = algorithm
+        self._h = pkgdigest.new_hasher(algorithm)
+        self._next = 0
+        self._err: str | None = None
+        self._cv = threading.Condition()
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"prefix-hash-{store.metadata.task_id[:12]}")
+        self._thread.start()
+
+    # Called from _commit_piece_record (under the store's _meta_lock; lock
+    # order store._meta_lock → self._cv, and _run never takes _meta_lock).
+    def piece_recorded(self, num: int, replaced: bool) -> None:
+        with self._cv:
+            # <=, not <: _next is also the piece currently being hashed
+            # OUTSIDE the lock — a re-record there would hash a torn mix
+            # of old and new bytes without this poison.
+            if replaced and num <= self._next:
+                self._err = f"piece {num} re-recorded at/behind the frontier"
+                self._stop = True
+            self._cv.notify()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify()
+
+    def _run(self) -> None:
+        try:
+            fd = os.open(self.store._data_path, os.O_RDONLY)
+        except OSError as e:
+            with self._cv:
+                self._err = str(e)
+                self._cv.notify()
+            return
+        try:
+            while True:
+                with self._cv:
+                    while True:
+                        if self._stop:
+                            return
+                        m = self.store.metadata
+                        rec = m.pieces.get(self._next)
+                        if rec is not None:
+                            break
+                        if (m.total_piece_count >= 0
+                                and self._next >= m.total_piece_count):
+                            return  # drained
+                        # Timed wait: total_piece_count can be set by
+                        # update_task without a piece commit notifying.
+                        self._cv.wait(timeout=2.0)
+                remaining, off = rec.size, rec.offset
+                while remaining > 0:
+                    chunk = os.pread(fd, min(remaining, 4 << 20), off)
+                    if not chunk:
+                        raise OSError(f"short read at piece {rec.num}")
+                    self._h.update(chunk)  # GIL released for >2 KiB
+                    off += len(chunk)
+                    remaining -= len(chunk)
+                with self._cv:
+                    self._next += 1
+                    self._cv.notify()
+        except Exception as e:  # noqa: BLE001 - poisons; caller re-hashes
+            with self._cv:
+                self._err = str(e)
+                self._cv.notify()
+        finally:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+
+    def finish(self, timeout: float = 60.0) -> str | None:
+        """Wait for the frontier to drain; hex digest, or None on any
+        error/timeout (caller falls back to the full re-hash)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while True:
+                if self._err is not None or self._stop:
+                    return None
+                total = self.store.metadata.total_piece_count
+                if total >= 0 and self._next >= total:
+                    break
+                left = deadline - time.monotonic()
+                if left <= 0 or not self._cv.wait(timeout=min(left, 2.0)):
+                    if time.monotonic() >= deadline:
+                        return None
+        self._thread.join(timeout=5.0)
+        return self._h.hexdigest()
+
+
 class LocalTaskStore:
     """Synchronous piece IO over one data file. Writes go through the page
     cache (pwrite); metadata saves are atomic (tmp+rename)."""
@@ -139,6 +252,9 @@ class LocalTaskStore:
         # native crc+pwrite runs GIL-free and offset-disjoint, but fd
         # creation and metadata record/serialize must serialize.
         self._meta_lock = threading.Lock()
+        # Optional background contiguous-prefix hasher (back-source tasks
+        # with a known content digest — see _PrefixHasher).
+        self._prefix_hasher: _PrefixHasher | None = None
 
     # -- pinning: GC must not reclaim a store mid-download/upload ----------
 
@@ -191,6 +307,10 @@ class LocalTaskStore:
                 self._fd = None
 
     def destroy(self) -> None:
+        ph = self._prefix_hasher
+        if ph is not None:
+            self._prefix_hasher = None
+            ph.stop()
         self.close()
         shutil.rmtree(self.dir, ignore_errors=True)
 
@@ -335,6 +455,23 @@ class LocalTaskStore:
             self._verified_pieces[num] = rec.digest
         return self._commit_piece_record(rec)
 
+    def start_prefix_hasher(self, expected_digest: str) -> None:
+        """Begin hashing the contiguous piece prefix in the background so
+        ``validate_digest`` at completion is (near-)free. Idempotent;
+        silently a no-op for unknown algorithms. Callers gate on
+        ``completion_digest_applies`` — only tasks that will actually run
+        the completion digest decision should pay for this."""
+        if self._prefix_hasher is not None or not expected_digest:
+            return
+        try:
+            algorithm = pkgdigest.parse(expected_digest).algorithm
+            # The hasher opens its own O_RDONLY fd immediately; make sure
+            # the data file exists even before the first piece write.
+            self._ensure_fd()
+            self._prefix_hasher = _PrefixHasher(self, algorithm)
+        except (ValueError, StorageError, OSError):
+            return
+
     @staticmethod
     def completion_digest_applies(digest: str, ranged: bool) -> bool:
         """Would the completion-time whole-content digest decision run at
@@ -407,6 +544,9 @@ class LocalTaskStore:
             self.touch()
             if existing is None:
                 self._unsaved_pieces += 1
+            ph = self._prefix_hasher
+            if ph is not None:
+                ph.piece_recorded(rec.num, existing is not None)
         if existing is None:
             self._piece_recorded_save()
         obs = self.observer
@@ -475,6 +615,10 @@ class LocalTaskStore:
         self.save_metadata()
 
     def mark_invalid(self) -> None:
+        ph = self._prefix_hasher
+        if ph is not None:
+            self._prefix_hasher = None
+            ph.stop()
         self.metadata.invalid = True
         self.save_metadata()
 
@@ -484,6 +628,27 @@ class LocalTaskStore:
         digest string (reference local_storage.go:247)."""
         want = expected or self.metadata.digest
         algorithm = pkgdigest.parse(want).algorithm if want else pkgdigest.ALGORITHM_SHA256
+        ph = self._prefix_hasher
+        if ph is not None and ph.algorithm == algorithm:
+            self._prefix_hasher = None
+            # The drain wait scales with content size: even a fully lagged
+            # hasher re-reads from page cache and is faster than the cold
+            # full re-hash below, so waiting is always cheaper than
+            # falling through on a mere timeout.
+            cl = self.metadata.content_length
+            prefix_hex = ph.finish(
+                timeout=max(60.0, cl / (50 << 20)) if cl > 0 else 60.0)
+            if prefix_hex is not None:
+                actual = f"{algorithm}:{prefix_hex}"
+                if want and actual != want:
+                    raise StorageError(
+                        f"content digest mismatch: want {want}, got {actual}",
+                        Code.ClientPieceDownloadFail)
+                return actual
+            # Poisoned/timed-out hasher: fall through to the full re-hash
+            # — and stop the thread so a merely-lagging hasher does not
+            # keep pread'ing in parallel with the re-hash below.
+            ph.stop()
         h = pkgdigest.new_hasher(algorithm)
         fd = self._ensure_fd()
         for n in sorted(self.metadata.pieces):

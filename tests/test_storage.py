@@ -7,6 +7,7 @@ import pytest
 from dragonfly2_tpu.pkg import digest as pkgdigest
 from dragonfly2_tpu.pkg.errors import StorageError
 from dragonfly2_tpu.storage import StorageManager, StorageOption, TaskStoreMetadata
+from dragonfly2_tpu.storage.local_store import LocalTaskStore
 
 
 def make_manager(tmp_path, **kw):
@@ -315,3 +316,74 @@ def test_pieces_all_digest_verified_tracking(tmp_path):
     # Empty candidate list: nothing installed, nothing clobbered.
     assert store2.apply_certification([]) is False
     assert store2.certified_digests is None
+
+
+class TestPrefixHasher:
+    """Hash-as-you-backsource: the contiguous-prefix hasher must produce
+    the same completion digest as the full re-hash, and any anomaly must
+    poison it into the fallback path, never a wrong digest."""
+
+    def _content(self, n=3 * 65536 + 123):
+        import random
+        return bytes(random.Random(5).randbytes(n))
+
+    def test_out_of_order_pieces_match_full_hash(self, tmp_path):
+        import hashlib
+
+        content = self._content()
+        piece = 65536
+        store = LocalTaskStore(str(tmp_path / "s1"),
+                               meta("t-ph1", piece_size=piece,
+                                    content_length=len(content)))
+        want = "sha256:" + hashlib.sha256(content).hexdigest()
+        store.start_prefix_hasher(want)
+        assert store._prefix_hasher is not None
+        order = [2, 0, 3, 1]
+        for n in order:
+            store.write_piece(n, content[n * piece:(n + 1) * piece])
+        assert store.is_complete()
+        assert store.validate_digest(want) == want
+        assert store._prefix_hasher is None  # consumed
+
+    def test_mismatch_still_raises(self, tmp_path):
+        content = self._content()
+        piece = 65536
+        store = LocalTaskStore(str(tmp_path / "s2"),
+                               meta("t-ph2", piece_size=piece,
+                                    content_length=len(content)))
+        want = "sha256:" + "0" * 64
+        store.start_prefix_hasher(want)
+        for n in range(4):
+            store.write_piece(n, content[n * piece:(n + 1) * piece])
+        with pytest.raises(StorageError):
+            store.validate_digest(want)
+
+    def test_rerecorded_piece_poisons_to_fallback(self, tmp_path):
+        import hashlib
+        import time as _time
+
+        content = self._content()
+        piece = 65536
+        store = LocalTaskStore(str(tmp_path / "s3"),
+                               meta("t-ph3", piece_size=piece,
+                                    content_length=len(content)))
+        want = "sha256:" + hashlib.sha256(content).hexdigest()
+        store.start_prefix_hasher(want)
+        store.write_piece(0, content[:piece])
+        # Let the hasher pass piece 0, then re-record it behind the
+        # frontier: the hasher must poison, and validate_digest must
+        # fall back to the (still correct) full re-hash.
+        deadline = _time.monotonic() + 5
+        while (store._prefix_hasher._next < 1
+               and _time.monotonic() < deadline):
+            _time.sleep(0.01)
+        assert store._prefix_hasher._next >= 1
+        for n in range(4):
+            store.write_piece(n, content[n * piece:(n + 1) * piece])
+        assert store.validate_digest(want) == want
+
+    def test_unknown_algorithm_is_noop(self, tmp_path):
+        store = LocalTaskStore(str(tmp_path / "s4"),
+                               meta("t-ph4", piece_size=4, content_length=8))
+        store.start_prefix_hasher("whirlpool999:beef")
+        assert store._prefix_hasher is None
