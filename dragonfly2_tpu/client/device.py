@@ -59,6 +59,7 @@ class DeviceResult:
 async def download_to_device(daemon, url: str, *, digest: str = "",
                              tag: str = "", application: str = "",
                              header: dict | None = None,
+                             range_header: str = "",
                              dtype=None, shape=None,
                              mesh=None, axis_name: str = "d",
                              claim: bool = True):
@@ -70,19 +71,31 @@ async def download_to_device(daemon, url: str, *, digest: str = "",
     ``claim``: take ownership of the sink (the manager forgets it — HBM is
     released when the caller drops the arrays). With ``claim=False`` the
     sink stays resident for other consumers until its TTL.
+
+    ``range_header`` ("a-b" or "bytes=a-b"): land only that byte slice of
+    the object — a distinct ranged task (P2P-deduped among peers pulling
+    the SAME range). Ranged landings verify by the per-piece digest chain
+    only; a whole-content ``digest`` cannot apply to a slice.
     """
     from dragonfly2_tpu.daemon.peer.task_manager import FileTaskRequest
+    from dragonfly2_tpu.pkg.piece import Range
 
     tm = daemon.task_manager
     if tm.device_sinks is None:
         raise DfError(Code.BadRequest,
                       "daemon has no device sink (set tpu_sink.enabled)")
+    rng = ""
+    if range_header:
+        rng = (range_header if range_header.startswith("bytes=")
+               else f"bytes={range_header}")
     req = FileTaskRequest(
         url=url, output="",
         meta=UrlMeta(digest=digest, tag=tag, application=application,
-                     header=header or {}),
+                     header=header or {}, range=rng),
         device="tpu",
     )
+    if rng:
+        req.range = Range.parse_http(rng)
     final = None
     async for progress in tm.start_file_task(req):
         if progress.state == "failed":
@@ -109,3 +122,147 @@ async def download_to_device(daemon, url: str, *, digest: str = "",
     if mesh is not None:
         return result.shard_to_mesh(mesh, axis_name)
     return result
+
+
+async def fetch_safetensors_header(daemon, url: str, *, tag: str = "",
+                                   application: str = "",
+                                   header: dict | None = None):
+    """The checkpoint's parsed safetensors header via two tiny ranged
+    pulls through the fabric (8-byte length prefix, then exactly the
+    header). Both are ordinary ranged tasks, so a 256-host pod fetching
+    the same header costs ~one origin touch. Returns (header_dict,
+    data_start_abs)."""
+    import numpy as np
+
+    from dragonfly2_tpu.ops import safetensors as st
+
+    prefix = await download_to_device(
+        daemon, url, tag=tag, application=application, header=header,
+        range_header="0-7")
+    n = int.from_bytes(np.asarray(prefix.as_bytes_array()).tobytes(),
+                       "little")
+    if n <= 0 or n > (1 << 27):
+        raise st.SafetensorsError(f"implausible header length {n}")
+    head = await download_to_device(
+        daemon, url, tag=tag, application=application, header=header,
+        range_header=f"8-{8 + n - 1}")
+    head_bytes = np.asarray(head.as_bytes_array()).tobytes()
+    header_dict, _ = st.parse_header(
+        n.to_bytes(8, "little") + head_bytes)
+    return header_dict, 8 + n
+
+
+async def download_sharded(daemon, url: str, *,
+                           names: list[str] | None = None,
+                           selector=None,
+                           shardings: dict | None = None,
+                           tag: str = "", application: str = "",
+                           header: dict | None = None,
+                           coalesce_gap: int = 4 << 20):
+    """Pull ONLY this host's tensors of a safetensors checkpoint through
+    the fabric, landing straight in HBM: the sharded-pod pattern where a
+    host needs its pipeline stage / expert shard, not all 140 GB.
+
+    Every host in the same shard group issues byte-identical ranged tasks
+    (same task ids), so the fabric dedupes origin traffic per RANGE, not
+    per object — with 16 pipeline stages, origin serves ~1/16th of the
+    checkpoint once per stage group instead of the whole file per host.
+    No reference analog: Dragonfly2 has no notion of partial-object
+    device placement (dfget terminates at the filesystem, whole-file).
+
+    ``names``: explicit tensor list, or ``selector(name, meta) -> bool``
+    over header entries. ``shardings``: tensor name → jax Sharding,
+    applied via device_put after landing. Adjacent selected spans closer
+    than ``coalesce_gap`` bytes merge into one ranged task (fewer tasks;
+    the gap bytes ride along).
+
+    Ranged landings verify by the per-piece digest chain (announced by
+    serving parents, anchored at the range seed's self-hash); a
+    whole-content digest cannot apply to slices.
+    """
+    from dragonfly2_tpu.ops import safetensors as st
+
+    header_dict, data_start = await fetch_safetensors_header(
+        daemon, url, tag=tag, application=application, header=header)
+
+    picked: list[tuple[int, int, str]] = []
+    for name, meta in header_dict.items():
+        if name == "__metadata__":
+            continue
+        if names is not None and name not in names:
+            continue
+        if selector is not None and not selector(name, meta):
+            continue
+        offsets = meta.get("data_offsets") if isinstance(meta, dict) else None
+        if (not isinstance(offsets, list) or len(offsets) != 2
+                or not all(isinstance(o, int) for o in offsets)
+                or offsets[1] < offsets[0]):
+            raise st.SafetensorsError(f"{name}: bad data_offsets")
+        picked.append((data_start + offsets[0], data_start + offsets[1], name))
+    if names is not None:
+        missing = set(names) - {n for _, _, n in picked}
+        if missing:
+            raise st.SafetensorsError(
+                f"tensors not in checkpoint: {sorted(missing)}")
+    if shardings:
+        # Validate BEFORE any early return: a selector typo plus a
+        # shardings dict must fail loudly, not hand back {} silently.
+        unknown = [n for n in shardings
+                   if n not in {t[2] for t in picked}]
+        if unknown:
+            raise st.SafetensorsError(
+                f"shardings reference tensors not loaded: {unknown}")
+
+    out: dict = {}
+    # Zero-element tensors (legal: a 0 dim, data_offsets [s, s]) carry no
+    # bytes — synthesize them instead of building an inverted range.
+    nonempty = []
+    for start, end, name in picked:
+        if end > start:
+            nonempty.append((start, end, name))
+            continue
+        import jax.numpy as jnp
+
+        sub = {name: {**header_dict[name], "data_offsets": [0, 0]}}
+        out.update(st.tensor_views(jnp.zeros((0,), dtype="uint8"),
+                                   sub, 0, [name]))
+    if not nonempty and not out:
+        return {}
+
+    nonempty.sort()
+    spans: list[list] = []  # [start, end, [names...]]
+    for start, end, name in nonempty:
+        if spans and start - spans[-1][1] <= coalesce_gap:
+            spans[-1][1] = max(spans[-1][1], end)
+            spans[-1][2].append(name)
+        else:
+            spans.append([start, end, [name]])
+
+    async def pull_span(start: int, end: int, span_names: list) -> dict:
+        result = await download_to_device(
+            daemon, url, tag=tag, application=application, header=header,
+            range_header=f"{start}-{end - 1}")
+        u8 = result.as_bytes_array()
+        # Rebase the span's tensors onto the slice: tensor_views validates
+        # and bitcasts exactly as for a full-content landing.
+        sub_header = {
+            n: {**header_dict[n],
+                "data_offsets": [
+                    data_start + header_dict[n]["data_offsets"][0] - start,
+                    data_start + header_dict[n]["data_offsets"][1] - start]}
+            for n in span_names}
+        return st.tensor_views(u8, sub_header, 0, span_names)
+
+    import asyncio
+
+    # Independent spans pull concurrently (scattered shards — e.g. MoE
+    # expert weights — are max-of-spans, not sum-of-spans).
+    for views in await asyncio.gather(*[pull_span(s, e, ns)
+                                        for s, e, ns in spans]):
+        out.update(views)
+    if shardings:  # unknown names already rejected above, pre-download
+        import jax
+
+        for name, sharding in shardings.items():
+            out[name] = jax.device_put(out[name], sharding)
+    return out

@@ -188,8 +188,12 @@ class TaskManager:
         """Run the download into ``store``; returns from_p2p. Publishes piece
         events to the broker so SyncPieceTasks children see pieces live."""
 
-        sink_wanted = (req.device == "tpu" and self.device_sinks is not None
-                       and req.range is None)
+        # Ranged tasks land too: the store's piece grid is slice-relative
+        # (download_source treats the range as the content), so the sink's
+        # geometry is simply the slice's. This is what sharded checkpoint
+        # pulls ride — each host lands only its own tensors' byte ranges
+        # (client/device.py download_sharded).
+        sink_wanted = (req.device == "tpu" and self.device_sinks is not None)
 
         async def on_piece(st, rec) -> None:
             m = st.metadata
@@ -451,7 +455,10 @@ class TaskManager:
         # 1b. Ranged request: serve the slice off the whole-content parent
         # task when its pieces cover the range — completed OR partial
         # (reference peertask_reuse.go:234 + FindPartialCompletedTask).
-        if req.meta.range:
+        # Device requests skip this: the export path is file-only, and a
+        # fresh ranged task (below) lands into the sink; the local parent
+        # keeps serving its pieces to other peers either way.
+        if req.meta.range and req.device != "tpu":
             parent_id = req.parent_task_id()
             parent = (self.storage.find_completed_task(parent_id)
                       or self.storage.find_partial_completed_task(parent_id))
@@ -1007,7 +1014,7 @@ class TaskManager:
         raises: silently handing back a bad buffer would defeat
         verify-on-land. The DISK store stays valid either way — callers
         must fail only the requesting stream, not the task."""
-        if req.device != "tpu" or req.range is not None:
+        if req.device != "tpu":
             return False
         if self.device_sinks is None:
             log.warning("device=tpu requested but sink disabled "

@@ -412,3 +412,189 @@ def test_device_trigger_dedups_onto_running_plain_seed(run_async, tmp_path):
             await origin.cleanup()
 
     run_async(body(), timeout=120)
+
+
+def test_ranged_download_lands_slice_in_device_buffer(run_async, tmp_path):
+    """A ranged device pull lands exactly the byte slice in HBM, and a
+    second peer pulling the SAME range rides P2P off the first (the
+    shard-group dedup download_sharded is built on)."""
+
+    async def body():
+        origin, oport, stats = await start_origin()
+        sched = await start_scheduler()
+        url = f"http://127.0.0.1:{oport}/blob"
+        start, length = 4096, 2 * 1024 * 1024 + 123
+        rng = f"{start}-{start + length - 1}"
+        daemons = []
+        try:
+            p1 = await _start_sink_daemon(tmp_path, "p1", sched.port())
+            p2 = await _start_sink_daemon(tmp_path, "p2", sched.port())
+            daemons += [p1, p2]
+
+            r1 = await device_lib.download_to_device(
+                p1, url, range_header=rng)
+            assert r1.content_length == length
+            assert r1.sink.verified
+            assert (bytes(np.asarray(r1.as_bytes_array()))
+                    == CONTENT[start:start + length])
+            served_after_first = stats["blob_bytes"]
+
+            r2 = await device_lib.download_to_device(
+                p2, url, range_header=rng)
+            assert (bytes(np.asarray(r2.as_bytes_array()))
+                    == CONTENT[start:start + length])
+            assert r2.from_p2p, "same-range peer must dedup via P2P"
+            # The second pull must not have re-touched the origin.
+            assert stats["blob_bytes"] == served_after_first
+        finally:
+            for d in daemons:
+                await d.stop()
+            await sched.stop()
+            await origin.cleanup()
+
+    run_async(body(), timeout=120)
+
+
+def test_download_sharded_fetches_only_selected_tensors(run_async, tmp_path):
+    """download_sharded: the host lands only its tensors' byte ranges
+    (origin traffic ~= header + selected spans, far below the file size)
+    and every returned tensor is bit-exact."""
+
+    async def body():
+        from aiohttp import web
+
+        from dragonfly2_tpu.pkg.piece import Range as _Range
+        from tests.test_safetensors import make_safetensors
+
+        rng_np = np.random.RandomState(11)
+        tensors = {
+            # Two big far-apart tensors + two small ones; select a subset
+            # whose spans are well under half the file.
+            "layer0.w": rng_np.randn(256, 256).astype(np.float32),   # 256 KiB
+            "layer1.w": rng_np.randn(512, 512).astype(np.float32),   # 1 MiB
+            "layer2.w": rng_np.randn(512, 512).astype(np.float32),   # 1 MiB
+            "layer3.b": rng_np.randn(4096).astype(np.float32),       # 16 KiB
+        }
+        dtypes = {k: "F32" for k in tensors}
+        ckpt = make_safetensors(tensors, dtypes)
+        stats = {"bytes": 0}
+
+        async def blob(request):
+            hdr = request.headers.get("Range")
+            if hdr:
+                r = _Range.parse_http(hdr, len(ckpt))
+                data = ckpt[r.start:r.start + r.length]
+                stats["bytes"] += len(data)
+                return web.Response(status=206, body=data, headers={
+                    "Content-Range":
+                        f"bytes {r.start}-{r.start + r.length - 1}/{len(ckpt)}",
+                    "Accept-Ranges": "bytes"})
+            stats["bytes"] += len(ckpt)
+            return web.Response(body=ckpt,
+                                headers={"Accept-Ranges": "bytes"})
+
+        app = web.Application()
+        app.router.add_get("/ckpt.safetensors", blob)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        oport = site._server.sockets[0].getsockname()[1]
+        sched = await start_scheduler()
+        url = f"http://127.0.0.1:{oport}/ckpt.safetensors"
+        daemons = []
+        try:
+            peer = await _start_sink_daemon(tmp_path, "shards", sched.port())
+            daemons.append(peer)
+
+            got = await device_lib.download_sharded(
+                peer, url, names=["layer0.w", "layer3.b"],
+                coalesce_gap=4096)
+            assert set(got) == {"layer0.w", "layer3.b"}
+            np.testing.assert_array_equal(
+                np.asarray(got["layer0.w"]), tensors["layer0.w"])
+            np.testing.assert_array_equal(
+                np.asarray(got["layer3.b"]), tensors["layer3.b"])
+            # Origin economy: header + the two selected spans (+ piece
+            # rounding), NOT the ~2 MiB of unselected middle tensors.
+            selected = (tensors["layer0.w"].nbytes
+                        + tensors["layer3.b"].nbytes)
+            assert stats["bytes"] < selected + 256 * 1024, (
+                stats["bytes"], selected)
+
+            # selector variant: every F32 tensor whose name ends in .b
+            got_b = await device_lib.download_sharded(
+                peer, url, selector=lambda n, m: n.endswith(".b"))
+            assert set(got_b) == {"layer3.b"}
+        finally:
+            for d in daemons:
+                await d.stop()
+            await sched.stop()
+            await runner.cleanup()
+
+    run_async(body(), timeout=180)
+
+
+def test_download_sharded_zero_element_and_bad_shardings(run_async, tmp_path):
+    """Edge cases: a zero-element tensor synthesizes without a range pull,
+    and a shardings dict referencing unselected tensors fails loudly even
+    when the selector matches nothing."""
+
+    async def body():
+        import pytest
+        from aiohttp import web
+
+        from dragonfly2_tpu.ops.safetensors import SafetensorsError
+        from dragonfly2_tpu.pkg.piece import Range as _Range
+        from tests.test_safetensors import make_safetensors
+
+        tensors = {
+            "empty.t": np.zeros((0, 8), dtype=np.float32),
+            "real.t": np.arange(64, dtype=np.float32),
+        }
+        ckpt = make_safetensors(tensors, {k: "F32" for k in tensors})
+
+        async def blob(request):
+            hdr = request.headers.get("Range")
+            if hdr:
+                r = _Range.parse_http(hdr, len(ckpt))
+                return web.Response(
+                    status=206, body=ckpt[r.start:r.start + r.length],
+                    headers={"Content-Range":
+                             f"bytes {r.start}-{r.start + r.length - 1}"
+                             f"/{len(ckpt)}",
+                             "Accept-Ranges": "bytes"})
+            return web.Response(body=ckpt,
+                                headers={"Accept-Ranges": "bytes"})
+
+        app = web.Application()
+        app.router.add_get("/z.safetensors", blob)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        oport = site._server.sockets[0].getsockname()[1]
+        sched = await start_scheduler()
+        url = f"http://127.0.0.1:{oport}/z.safetensors"
+        daemons = []
+        try:
+            peer = await _start_sink_daemon(tmp_path, "zedge", sched.port())
+            daemons.append(peer)
+
+            got = await device_lib.download_sharded(
+                peer, url, names=["empty.t", "real.t"])
+            assert np.asarray(got["empty.t"]).shape == (0, 8)
+            np.testing.assert_array_equal(
+                np.asarray(got["real.t"]), tensors["real.t"])
+
+            with pytest.raises(SafetensorsError, match="shardings reference"):
+                await device_lib.download_sharded(
+                    peer, url, selector=lambda n, m: n.startswith("nope"),
+                    shardings={"real.t": None})
+        finally:
+            for d in daemons:
+                await d.stop()
+            await sched.stop()
+            await runner.cleanup()
+
+    run_async(body(), timeout=120)
