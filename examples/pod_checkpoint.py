@@ -74,6 +74,24 @@ def main() -> None:
     print(f"mesh={dict(mesh.shape)} w1.sharding={params['w1'].sharding.spec} "
           f"forward-pass exact: OK")
 
+    # Sharded pull (production: client.device.download_sharded) — a host
+    # that only holds pipeline stage 1 fetches ONLY w2's byte range as a
+    # ranged device task; here the equivalent slice lands in its own sink.
+    header, data_start = json.loads(
+        content[8:8 + struct.unpack("<Q", content[:8])[0]]), \
+        8 + struct.unpack("<Q", content[:8])[0]
+    b, e = header["w2"]["data_offsets"]
+    span = content[data_start + b:data_start + e]
+    shard_sink = HBMSink(len(span), piece, batch_pieces=4)
+    for n in range((len(span) + piece - 1) // piece):
+        shard_sink.land_piece(n, span[n * piece:(n + 1) * piece])
+    assert shard_sink.complete() and shard_sink.verify()
+    w2 = np.asarray(shard_sink.as_bytes_array()).view(np.float32)
+    np.testing.assert_array_equal(w2.reshape(128, 32), ref["w2"])
+    print(f"sharded pull: stage host landed {len(span)} of "
+          f"{len(content)} bytes ({len(span) * 100 // len(content)}%) "
+          "— w2 bit-exact: OK")
+
 
 if __name__ == "__main__":
     main()
