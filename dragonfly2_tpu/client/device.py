@@ -84,10 +84,7 @@ async def download_to_device(daemon, url: str, *, digest: str = "",
     if tm.device_sinks is None:
         raise DfError(Code.BadRequest,
                       "daemon has no device sink (set tpu_sink.enabled)")
-    rng = ""
-    if range_header:
-        rng = (range_header if range_header.startswith("bytes=")
-               else f"bytes={range_header}")
+    rng = Range.normalize_header(range_header) if range_header else ""
     req = FileTaskRequest(
         url=url, output="",
         meta=UrlMeta(digest=digest, tag=tag, application=application,

@@ -95,6 +95,20 @@ class Range:
     start: int
     length: int
 
+    @staticmethod
+    def normalize_header(value: str) -> str:
+        """Canonical ``bytes=a-b`` form, validated. This string is TASK
+        IDENTITY (task_id_v1 hashes it verbatim), so every producer of a
+        ranged task — preheat jobs, client device pulls, dfget — must
+        normalize through this one function or warmed ranges stop
+        deduping with client pulls. Raises ValueError on malformed or
+        suffix spans (suffix needs a content length no producer has)."""
+        if not value:
+            return ""
+        v = value if value.startswith("bytes=") else f"bytes={value}"
+        Range.parse_http(v)
+        return v
+
     @classmethod
     def parse_http(cls, header: str, content_length: int = -1) -> "Range | None":
         """Parse single-range ``bytes=a-b`` / ``bytes=a-`` / ``bytes=-n``."""

@@ -182,7 +182,10 @@ UNARY: dict[str, Msg] = {
         disable_back_source=F(bool),
         # preheat-to-device: "tpu" additionally lands the content in the
         # triggered daemon's HBM sink (north-star pod-wide warm-up)
-        device=F(str)),
+        device=F(str),
+        # sharded preheat: warm only this byte range ("bytes=a-b") — a
+        # distinct ranged task; stage groups preheat their own spans
+        range=F(str)),
     "Peer.StatTask": Msg("PeerStatTask", task_id=F(str, required=True)),
     "Peer.DeleteTask": Msg("PeerDeleteTask", task_id=F(str, required=True)),
 

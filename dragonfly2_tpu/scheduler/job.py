@@ -20,6 +20,7 @@ import time
 from typing import Any
 
 from dragonfly2_tpu.pkg import dflog, idgen
+from dragonfly2_tpu.pkg.piece import Range
 from dragonfly2_tpu.scheduler.resource import TaskState
 
 log = dflog.get("scheduler.job")
@@ -125,10 +126,36 @@ class JobWorker:
         filters = args.get("filtered_query_params", "")
         if isinstance(filters, list):
             filters = "&".join(filters)
+        # Sharded preheat: ranges ("a-b" or "bytes=a-b") make each span
+        # its own ranged task per URL — stage groups warm only their own
+        # byte spans (the job-level face of client.device.download_sharded;
+        # daemons already accept ranged triggers, start_seed_task).
+        # Validate HERE, fail fast with the span named: a bad span sent to
+        # the daemons would error inside their spawned seed tasks after
+        # the trigger already ACKed, burning the full wait timeout with
+        # no diagnostic.
+        raw = args.get("ranges")
+        if raw is None:
+            raw = [args["range"]] if args.get("range") else []
+        if isinstance(raw, str) or not isinstance(raw, (list, tuple)):
+            return FAILURE, {
+                "error": f"ranges must be a list of spans, got {type(raw).__name__}"}
+        ranges: list[str] = []
+        for r in raw:
+            try:
+                norm = Range.normalize_header(r) if isinstance(r, str) else ""
+                if not norm:
+                    raise ValueError("empty span")
+            except ValueError as e:
+                return FAILURE, {"error": f"bad range {r!r}: {e}"}
+            ranges.append(norm)
+        if not ranges:
+            ranges = [""]
 
-        async def one_url(url: str) -> dict:
+        async def one_url(url: str, rng: str = "") -> dict:
             task_id = idgen.task_id_v1(
-                url, tag=tag, application=application, filters=filters)
+                url, tag=tag, application=application, filters=filters,
+                range_header=rng)
             spec = {
                 "task_id": task_id, "url": url, "tag": tag,
                 "application": application,
@@ -140,6 +167,8 @@ class JobWorker:
                 # without a sink degrade to disk-only warm-up.
                 "device": args.get("device", ""),
             }
+            if rng:
+                spec["range"] = rng
             # Concurrent fan-out: unreachable hosts cost one RPC timeout in
             # total, not one per host (reference preheatAllPeers fans via
             # goroutines, job.go:398).
@@ -148,10 +177,14 @@ class JobWorker:
                 for h in targets))
             triggered = sum(1 for r in results if r)
             done = await self._wait_task(task_id, timeout) if triggered else False
-            return {"url": url, "task_id": task_id, "triggered": triggered,
-                    "targets": len(targets), "succeeded": done}
+            out = {"url": url, "task_id": task_id, "triggered": triggered,
+                   "targets": len(targets), "succeeded": done}
+            if rng:
+                out["range"] = rng
+            return out
 
-        per_url = list(await asyncio.gather(*(one_url(u) for u in urls)))
+        per_url = list(await asyncio.gather(*(
+            one_url(u, r) for u in urls for r in ranges)))
         ok_all = all(r["triggered"] > 0 and r["succeeded"] for r in per_url)
         return (SUCCESS if ok_all else FAILURE), {"preheat": per_url, "scope": scope}
 

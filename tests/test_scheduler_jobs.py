@@ -134,3 +134,83 @@ def test_sync_peers_job_populates_manager_table(run_async, tmp_path):
             await runner.cleanup()
 
     run_async(run())
+
+
+def test_sharded_preheat_ranges(run_async, tmp_path):
+    """Sharded preheat: args.ranges warms each byte span as its own
+    ranged task — the seed ends up holding exactly the slices, so a
+    stage group warms only its tensors' spans (the job-level face of
+    download_sharded)."""
+
+    async def run():
+        import tests.test_p2p_e2e as e2e
+
+        runner, port, stats = await start_origin()
+        manager, sched, seed = await _cluster(tmp_path)
+        try:
+            url = f"http://127.0.0.1:{port}/blob"
+            cluster_id = sched.announcer.registered["scheduler_cluster_id"]
+            spans = ["0-65535", "1048576-2097151"]
+            job = manager.service.jobs.enqueue_job(
+                "preheat", {"url": url, "ranges": spans,
+                            "scope": "all_seed_peers", "timeout": 20.0},
+                [cluster_id])
+            assert await _wait(lambda: manager.db.get("jobs", job["id"])
+                               ["state"] in ("SUCCESS", "FAILURE"), 30.0)
+            row = manager.db.get("jobs", job["id"])
+            assert row["state"] == "SUCCESS", row
+            results = row["result"]["group_results"][0]["preheat"]
+            assert {r["range"] for r in results} == {
+                "bytes=0-65535", "bytes=1048576-2097151"}
+
+            # The seed holds each RANGED task's bytes (slice-exact), and
+            # served well under the whole file from origin.
+            for span in spans:
+                task_id = idgen.task_id_v1(
+                    url, range_header=f"bytes={span}")
+                store = seed.task_manager.storage.try_get(task_id)
+                assert store is not None and store.metadata.done, span
+                a, b = (int(x) for x in span.split("-"))
+                assert store.metadata.content_length == b - a + 1
+            assert stats["blob_bytes"] < len(e2e.CONTENT), stats
+        finally:
+            await seed.stop()
+            await sched.stop()
+            await manager.stop()
+            await runner.cleanup()
+
+    run_async(run())
+
+
+def test_sharded_preheat_rejects_bad_ranges(run_async, tmp_path):
+    """Malformed spans must fail the job immediately with the span named
+    — not burn the wait timeout against tasks that can never exist."""
+
+    async def run():
+        runner, port, stats = await start_origin()
+        manager, sched, seed = await _cluster(tmp_path)
+        try:
+            url = f"http://127.0.0.1:{port}/blob"
+            cluster_id = sched.announcer.registered["scheduler_cluster_id"]
+            for bad in ({"ranges": "0-65535"},          # str, not list
+                        {"ranges": ["10-5"]},           # inverted
+                        {"ranges": ["-1024"]},          # suffix span
+                        {"range": "nonsense"}):
+                job = manager.service.jobs.enqueue_job(
+                    "preheat", {"url": url, "timeout": 20.0, **bad},
+                    [cluster_id])
+                assert await _wait(
+                    lambda: manager.db.get("jobs", job["id"])["state"]
+                    in ("SUCCESS", "FAILURE"), 10.0)
+                row = manager.db.get("jobs", job["id"])
+                assert row["state"] == "FAILURE", bad
+                err = row["result"]["group_results"][0]["error"]
+                assert "range" in err, (bad, err)
+            assert stats["blob_streams"] == 0  # nothing ever triggered
+        finally:
+            await seed.stop()
+            await sched.stop()
+            await manager.stop()
+            await runner.cleanup()
+
+    run_async(run())
