@@ -50,6 +50,7 @@ INDEX_HTML = """<!doctype html>
   <form onsubmit="return createPreheat(this)">
     <select name="ptype"><option>file</option><option>image</option></select>
     <input name="url" placeholder="preheat url" size="40" required>
+    <input name="ranges" placeholder="ranges a-b,c-d (optional)" size="24">
     <label><input type="checkbox" name="device"> land in TPU HBM</label>
     <button>trigger preheat</button> <span class="err" id="job-msg"></span>
   </form>
@@ -95,9 +96,11 @@ function createCluster(f) {
       () => post("scheduler-clusters", {name: f.name.value}));
 }
 function createPreheat(f) {
-  return formAction("job-msg", () => post("jobs",
-      {type: "preheat", args: {type: f.ptype.value, url: f.url.value,
-                               device: f.device.checked ? "tpu" : ""}}));
+  const args = {type: f.ptype.value, url: f.url.value,
+                device: f.device.checked ? "tpu" : ""};
+  const spans = f.ranges.value.split(",").map(s => s.trim()).filter(Boolean);
+  if (spans.length) args.ranges = spans;  // sharded preheat: one task/span
+  return formAction("job-msg", () => post("jobs", {type: "preheat", args}));
 }
 function createUser(f) {
   return formAction("user-msg", () => post("users/signup",

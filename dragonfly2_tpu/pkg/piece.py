@@ -105,9 +105,11 @@ class Range:
         suffix spans (suffix needs a content length no producer has)."""
         if not value:
             return ""
-        v = value if value.startswith("bytes=") else f"bytes={value}"
-        Range.parse_http(v)
-        return v
+        v = value if value.strip().startswith("bytes=") else f"bytes={value}"
+        r = Range.parse_http(v)
+        # Re-emit, never echo: ' 0 - 5' and '007-100' must hash like
+        # their canonical forms or equal ranges get distinct task ids.
+        return r.to_http()
 
     @classmethod
     def parse_http(cls, header: str, content_length: int = -1) -> "Range | None":

@@ -598,3 +598,40 @@ def test_download_sharded_zero_element_and_bad_shardings(run_async, tmp_path):
             await runner.cleanup()
 
     run_async(body(), timeout=120)
+
+
+def test_dfget_ranged_device_over_the_wire(run_async, tmp_path):
+    """Entry-point parity for sharded pulls: dfget with range= AND
+    device="tpu" over the daemon's RPC socket reports device_verified,
+    writes the slice-exact file, and leaves the ranged sink resident."""
+
+    async def body():
+        origin, oport, _ = await start_origin()
+        sched = await start_scheduler()
+        url = f"http://127.0.0.1:{oport}/blob"
+        start, end = 8192, 8192 + 1024 * 1024 - 1
+        daemons = []
+        try:
+            peer = await _start_sink_daemon(tmp_path, "rwire", sched.port())
+            daemons.append(peer)
+
+            r = await dfget_lib.download(dfget_lib.DfgetConfig(
+                url=url, output=str(tmp_path / "slice"),
+                daemon_sock=peer.config.unix_sock,
+                meta=UrlMeta(range=f"bytes={start}-{end}"), device="tpu",
+                allow_source_fallback=False, timeout=60.0))
+            assert r["state"] == "done", r
+            assert r["device_verified"], r
+            assert ((tmp_path / "slice").read_bytes()
+                    == CONTENT[start:end + 1])
+            sink = peer.task_manager.device_sinks.get(r["task_id"])
+            assert sink is not None and sink.verified
+            assert (bytes(np.asarray(sink.as_bytes_array()))
+                    == CONTENT[start:end + 1])
+        finally:
+            for d in daemons:
+                await d.stop()
+            await sched.stop()
+            await origin.cleanup()
+
+    run_async(body(), timeout=120)

@@ -380,3 +380,23 @@ class TestParseLabeledSamples:
         ])
         got = parse_labeled_samples(text, "x_total", "locality")
         assert got == {"intra": 12, "cross": 3}
+
+
+class TestRangeNormalizeHeader:
+    def test_canonicalizes_equivalent_spans(self):
+        from dragonfly2_tpu.pkg.piece import Range
+
+        for raw in ("0-65535", "bytes=0-65535", " 0 - 65535 ",
+                    "bytes=000-65535"):
+            assert Range.normalize_header(raw) == "bytes=0-65535", raw
+        assert Range.normalize_header("5-") == "bytes=5-"
+        assert Range.normalize_header("") == ""
+
+    def test_rejects_malformed(self):
+        import pytest
+
+        from dragonfly2_tpu.pkg.piece import Range
+
+        for bad in ("10-5", "-1024", "nonsense", "1,2-3"):
+            with pytest.raises(ValueError):
+                Range.normalize_header(bad)
