@@ -93,21 +93,33 @@ async def download_to_device(daemon, url: str, *, digest: str = "",
     )
     if rng:
         req.range = Range.parse_http(rng)
-    final = None
-    async for progress in tm.start_file_task(req):
-        if progress.state == "failed":
-            raise DfError.from_wire(progress.error or {})
-        if progress.state == "done":
-            final = progress
-    if final is None:
-        raise DfError(Code.UnknownError, "download ended without a result")
-    if not final.device_verified:
-        raise DfError(Code.ClientPieceDownloadFail,
-                      "content did not land in the device sink "
-                      "(sink cap reached or pieces misaligned)")
-    task_id = final.task_id
-    sink = (tm.device_sinks.take(task_id) if claim
-            else tm.device_sinks.get(task_id))
+    sink = None
+    for attempt in range(2):
+        final = None
+        async with tm.device_sinks.admit():
+            async for progress in tm.start_file_task(req):
+                if progress.state == "failed":
+                    raise DfError.from_wire(progress.error or {})
+                if progress.state == "done":
+                    final = progress
+        if final is None:
+            raise DfError(Code.UnknownError, "download ended without a result")
+        if not final.device_verified:
+            raise DfError(Code.ClientPieceDownloadFail,
+                          "content did not land in the device sink "
+                          "(sink cap reached or pieces misaligned)")
+        task_id = final.task_id
+        sink = (tm.device_sinks.take(task_id) if claim
+                else tm.device_sinks.get(task_id))
+        if sink is not None:
+            break
+        # Claim raced away: concurrent callers of the SAME task (dedup)
+        # share one landing, and another claimer took it first. The task
+        # is complete on disk, so one re-run rides the reuse path, which
+        # backfills and re-verifies a fresh sink from the store.
+        if attempt == 0:
+            log.info("device sink claimed by a concurrent caller; "
+                     "rebuilding from store", task=task_id[:16])
     if sink is None:
         raise DfError(Code.UnknownError, "device sink vanished after verify")
     result = DeviceResult(task_id=task_id,
@@ -253,7 +265,11 @@ async def download_sharded(daemon, url: str, *,
     import asyncio
 
     # Independent spans pull concurrently (scattered shards — e.g. MoE
-    # expert weights — are max-of-spans, not sum-of-spans).
+    # expert weights — are max-of-spans, not sum-of-spans). In-flight
+    # spans are bounded by the daemon's shared sink admission
+    # (DeviceSinkManager.admit, acquired inside download_to_device), so
+    # wide pulls — and CONCURRENT sharded pulls — cannot trip the
+    # HBM-resident cap's disk-only degradation.
     for views in await asyncio.gather(*[pull_span(s, e, ns)
                                         for s, e, ns in spans]):
         out.update(views)

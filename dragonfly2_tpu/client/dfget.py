@@ -13,7 +13,6 @@ from typing import AsyncIterator, Callable
 
 from dragonfly2_tpu.pkg import dflog, tracing
 from dragonfly2_tpu.pkg.errors import Code, DfError
-from dragonfly2_tpu.pkg.piece import Range
 from dragonfly2_tpu.pkg.types import NetAddr
 from dragonfly2_tpu.proto.common import UrlMeta
 from dragonfly2_tpu.rpc import Client
@@ -36,12 +35,12 @@ class DfgetConfig:
 
 
 async def download(cfg: DfgetConfig, on_progress: Callable[[dict], None] | None = None) -> dict:
-    """Single download via the daemon; returns the final progress frame."""
-    if cfg.meta.range:
-        # Canonical form BEFORE anything hashes it: the range header is
-        # task identity (Range.normalize_header), so dfget pulls must
-        # dedup with preheat-warmed and device-pulled ranges.
-        cfg.meta.range = Range.normalize_header(cfg.meta.range)
+    """Single download via the daemon; returns the final progress frame.
+
+    Range canonicalization happens at the daemon's wire chokepoint
+    (rpcserver), not here: the source-fallback path wants the raw form
+    (suffix ranges are valid plain HTTP), and mutating the caller's
+    UrlMeta would surprise config reuse."""
     with tracing.span("dfget.download", url=cfg.url) as sp:
         if cfg.recursive:
             return await _download_recursive(cfg, on_progress)
@@ -99,7 +98,11 @@ async def _download_from_source(cfg: DfgetConfig) -> dict:
     client = get_client(cfg.url)
     req = SourceRequest(cfg.url, dict(cfg.meta.header))
     if cfg.meta.range:
-        req = req.with_range(Range.normalize_header(cfg.meta.range))
+        # Raw prefixing, not normalize_header: no task id exists on this
+        # path, and suffix ranges ('bytes=-N') are valid plain HTTP here.
+        req = req.with_range(
+            cfg.meta.range if cfg.meta.range.startswith("bytes=")
+            else f"bytes={cfg.meta.range}")
     resp = await client.download(req)
     out = os.path.abspath(cfg.output)
     os.makedirs(os.path.dirname(out), exist_ok=True)

@@ -106,9 +106,23 @@ class DeviceSinkManager:
     """Owns the per-task sinks a daemon is landing. Selected per request
     (FileTaskRequest.device == "tpu"); gated by TPUSinkOption.enabled."""
 
+    def admit(self):
+        """Admission bound for CLIENT-API device pulls: an async context
+        holding one HBM-sink slot (one below ``max_tasks``, so an
+        unrelated RPC-path device task is never starved). Shared across
+        every download_to_device/download_sharded on this daemon —
+        per-call bounds compose into cap overruns when calls run
+        concurrently. RPC-path requests deliberately do not admit: their
+        contract is graceful disk-only degradation at the cap, while the
+        client API's contract is a verified device landing or an error."""
+        if self._admission is None:
+            self._admission = asyncio.Semaphore(max(1, self.max_tasks - 1))
+        return self._admission
+
     def __init__(self, *, mesh_shape: list[int] | None = None,
                  batch_pieces: int = 8, max_tasks: int = 4,
                  ttl: float = 600.0, device=None):
+        self._admission = None
         self.mesh_shape = list(mesh_shape or [])
         self.batch_pieces = batch_pieces
         self.max_tasks = max_tasks
@@ -171,9 +185,22 @@ class DeviceSinkManager:
                 piece_size: int) -> TaskDeviceSink | None:
         self._expire()
         if len(self._sinks) >= self.max_tasks:
-            log.warning("device sink cap reached; landing to disk only",
-                        task=task_id[:16], cap=self.max_tasks)
-            return None
+            # Residents are cached conveniences — the disk store stays
+            # authoritative — so a verified, unclaimed sink yields its
+            # HBM to a NEW landing rather than failing it (oldest first).
+            # Mid-landing sinks are never evicted.
+            evictable = sorted(
+                (s for s in self._sinks.values() if s.verified),
+                key=lambda s: s.created_at)
+            if evictable:
+                victim = evictable[0]
+                log.info("evicting resident device sink for new landing",
+                         evicted=victim.task_id[:16], task=task_id[:16])
+                del self._sinks[victim.task_id]
+            else:
+                log.warning("device sink cap reached; landing to disk only",
+                            task=task_id[:16], cap=self.max_tasks)
+                return None
         try:
             sink = TaskDeviceSink(task_id, content_length, piece_size,
                                   device=self._device,

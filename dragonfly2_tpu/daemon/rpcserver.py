@@ -78,8 +78,12 @@ class DaemonRpcServer:
             # Canonicalize at the wire chokepoint: the header is task
             # identity, and raw RPC clients must dedup with dfget /
             # preheat / device pulls of the same span.
-            req.meta.range = Range.normalize_header(req.meta.range)
-            req.range = Range.parse_http(req.meta.range)
+            try:
+                req.meta.range = Range.normalize_header(req.meta.range)
+                req.range = Range.parse_http(req.meta.range)
+            except ValueError as e:
+                raise DfError(Code.BadRequest,
+                              f"bad range {req.meta.range!r}: {e}")
         async for progress in self.task_manager.start_file_task(req):
             await stream.send(progress.to_wire())
 

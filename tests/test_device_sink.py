@@ -635,3 +635,170 @@ def test_dfget_ranged_device_over_the_wire(run_async, tmp_path):
             await origin.cleanup()
 
     run_async(body(), timeout=120)
+
+
+def test_download_sharded_more_spans_than_sink_cap(run_async, tmp_path):
+    """A sharded pull with more spans than the daemon's HBM-resident sink
+    cap must succeed: in-flight spans are bounded below the cap instead
+    of tripping the cap's disk-only degradation."""
+
+    async def body():
+        from aiohttp import web
+
+        from dragonfly2_tpu.pkg.piece import Range as _Range
+        from tests.test_safetensors import make_safetensors
+
+        rng_np = np.random.RandomState(21)
+        # 8 tensors with forced gaps so no two spans coalesce; the
+        # daemon's default sink cap is 4.
+        tensors = {}
+        for i in range(8):
+            tensors[f"t{i}.w"] = rng_np.randn(4096).astype(np.float32)
+            tensors[f"gap{i}"] = rng_np.randn(65536).astype(np.float32)
+        ckpt = make_safetensors(tensors, {k: "F32" for k in tensors})
+
+        async def blob(request):
+            hdr = request.headers.get("Range")
+            if hdr:
+                r = _Range.parse_http(hdr, len(ckpt))
+                return web.Response(
+                    status=206, body=ckpt[r.start:r.start + r.length],
+                    headers={"Content-Range":
+                             f"bytes {r.start}-{r.start + r.length - 1}"
+                             f"/{len(ckpt)}",
+                             "Accept-Ranges": "bytes"})
+            return web.Response(body=ckpt,
+                                headers={"Accept-Ranges": "bytes"})
+
+        app = web.Application()
+        app.router.add_get("/cap.safetensors", blob)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        oport = site._server.sockets[0].getsockname()[1]
+        sched = await start_scheduler()
+        url = f"http://127.0.0.1:{oport}/cap.safetensors"
+        daemons = []
+        try:
+            peer = await _start_sink_daemon(tmp_path, "cap8", sched.port())
+            daemons.append(peer)
+            assert peer.task_manager.device_sinks.max_tasks == 4
+
+            wanted = [f"t{i}.w" for i in range(8)]
+            got = await device_lib.download_sharded(
+                peer, url, names=wanted, coalesce_gap=0)
+            assert set(got) == set(wanted)
+            for name in wanted:
+                np.testing.assert_array_equal(
+                    np.asarray(got[name]), tensors[name])
+        finally:
+            for d in daemons:
+                await d.stop()
+            await sched.stop()
+            await runner.cleanup()
+
+    run_async(body(), timeout=180)
+
+
+def test_concurrent_sharded_pulls_share_admission(run_async, tmp_path):
+    """Two concurrent download_sharded calls on ONE daemon must both
+    succeed: admission is a per-daemon bound (DeviceSinkManager.admit),
+    not a per-call semaphore that composes into cap overruns."""
+
+    async def body():
+        import asyncio
+
+        from aiohttp import web
+
+        from dragonfly2_tpu.pkg.piece import Range as _Range
+        from tests.test_safetensors import make_safetensors
+
+        rng_np = np.random.RandomState(31)
+        tensors = {}
+        for i in range(4):
+            tensors[f"a{i}"] = rng_np.randn(4096).astype(np.float32)
+            tensors[f"pad{i}"] = rng_np.randn(65536).astype(np.float32)
+        ckpt = make_safetensors(tensors, {k: "F32" for k in tensors})
+
+        async def blob(request):
+            hdr = request.headers.get("Range")
+            if hdr:
+                r = _Range.parse_http(hdr, len(ckpt))
+                return web.Response(
+                    status=206, body=ckpt[r.start:r.start + r.length],
+                    headers={"Content-Range":
+                             f"bytes {r.start}-{r.start + r.length - 1}"
+                             f"/{len(ckpt)}",
+                             "Accept-Ranges": "bytes"})
+            return web.Response(body=ckpt,
+                                headers={"Accept-Ranges": "bytes"})
+
+        app = web.Application()
+        app.router.add_get("/adm.safetensors", blob)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        oport = site._server.sockets[0].getsockname()[1]
+        sched = await start_scheduler()
+        url = f"http://127.0.0.1:{oport}/adm.safetensors"
+        daemons = []
+        try:
+            peer = await _start_sink_daemon(tmp_path, "adm", sched.port())
+            daemons.append(peer)
+            g1, g2 = await asyncio.gather(
+                device_lib.download_sharded(
+                    peer, url, names=["a0", "a1"], coalesce_gap=0),
+                device_lib.download_sharded(
+                    peer, url, names=["a2", "a3"], coalesce_gap=0))
+            for name, got in list(g1.items()) + list(g2.items()):
+                np.testing.assert_array_equal(
+                    np.asarray(got), tensors[name])
+        finally:
+            for d in daemons:
+                await d.stop()
+            await sched.stop()
+            await runner.cleanup()
+
+    run_async(body(), timeout=180)
+
+
+def test_resident_sinks_evict_for_new_landing(run_async, tmp_path):
+    """Verified, unclaimed resident sinks yield their HBM to NEW device
+    landings (oldest first) instead of tripping the cap's disk-only
+    degradation — residents are caches; the disk store is authoritative."""
+
+    async def body():
+        origin, oport, _ = await start_origin()
+        sched = await start_scheduler()
+        url = f"http://127.0.0.1:{oport}/blob"
+        daemons = []
+        try:
+            peer = await _start_sink_daemon(tmp_path, "evict", sched.port())
+            daemons.append(peer)
+            peer.task_manager.device_sinks.max_tasks = 2
+
+            # Two unclaimed ranged pulls fill the cap with residents.
+            r1 = await device_lib.download_to_device(
+                peer, url, range_header="0-65535", claim=False)
+            r2 = await device_lib.download_to_device(
+                peer, url, range_header="65536-131071", claim=False)
+            sinks = peer.task_manager.device_sinks
+            assert sinks.get(r1.task_id) is not None
+            assert sinks.get(r2.task_id) is not None
+
+            # A third pull must succeed by evicting the OLDEST resident.
+            r3 = await device_lib.download_to_device(
+                peer, url, range_header="131072-196607", claim=False)
+            assert (bytes(np.asarray(r3.as_bytes_array()))
+                    == CONTENT[131072:196608])
+            assert sinks.get(r1.task_id) is None, "oldest must be evicted"
+            assert sinks.get(r2.task_id) is not None
+        finally:
+            for d in daemons:
+                await d.stop()
+            await sched.stop()
+            await origin.cleanup()
+
+    run_async(body(), timeout=120)
