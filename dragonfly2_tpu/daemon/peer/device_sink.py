@@ -302,13 +302,15 @@ class DeviceSinkManager:
             del self._sinks[tid]
 
     def default_mesh(self):
-        """Mesh over local devices per TPUSinkOption.mesh_shape (or all
-        devices on one axis when unset)."""
+        """Mesh over LOCAL devices per TPUSinkOption.mesh_shape (or all
+        local devices on one axis when unset) — the sink's shard_to_mesh
+        spreads over this host's chips; under jax.distributed the global
+        list would include non-addressable devices."""
         import numpy as np
         import jax
         from jax.sharding import Mesh
 
-        devices = jax.devices()
+        devices = jax.local_devices()
         if self.mesh_shape:
             n = int(np.prod(self.mesh_shape))
             names = tuple(f"d{i}" for i in range(len(self.mesh_shape)))

@@ -189,7 +189,11 @@ class HBMSink:
         self.total_pieces = max(
             1, (content_length + piece_size - 1) // piece_size)
         self.padded_words = self.total_pieces * self.piece_words
-        self.device = device or jax.devices()[0]
+        # local_devices, not devices: under jax.distributed the global
+        # list leads with process 0's devices, and staging to another
+        # process's device is an INVALID_ARGUMENT copy error. Identical
+        # off-pod (local == global).
+        self.device = device or jax.local_devices()[0]
         self.host_checksums: dict[int, tuple[int, int]] = {}
         self.landed: set[int] = set()
         self.batch_pieces = batch_pieces

@@ -130,3 +130,230 @@ def test_two_process_global_assembly(tmp_path):
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"p{pid} rc={p.returncode}:\n{out[-3000:]}"
         assert f"MULTIHOST_OK p{pid}" in out, out[-2000:]
+
+
+_ORIGIN = r"""
+import asyncio, os, sys
+sys.path.insert(0, os.environ["DF_REPO"])
+from aiohttp import web
+from dragonfly2_tpu.pkg.piece import Range
+
+CKPT = open(os.environ["DF_CKPT"], "rb").read()
+stats = {"bytes": 0}
+
+async def blob(request):
+    rng = request.headers.get("Range")
+    if rng:
+        r = Range.parse_http(rng, len(CKPT))
+        stats["bytes"] += r.length
+        return web.Response(status=206, body=CKPT[r.start:r.start + r.length],
+            headers={"Content-Range":
+                     f"bytes {r.start}-{r.start + r.length - 1}/{len(CKPT)}",
+                     "Accept-Ranges": "bytes"})
+    stats["bytes"] += len(CKPT)
+    return web.Response(body=CKPT, headers={"Accept-Ranges": "bytes"})
+
+async def served(request):
+    return web.json_response(stats)
+
+_waiters = {"n": 0, "event": asyncio.Event()}
+
+async def barrier(request):
+    # Aligns the workers between their (skewed) fabric phases and their
+    # first cross-process collective, whose deadline is much shorter
+    # than the possible compile/download skew on a contended core.
+    want = int(request.query.get("n", "2"))
+    _waiters["n"] += 1
+    if _waiters["n"] >= want:
+        _waiters["event"].set()
+    await _waiters["event"].wait()
+    return web.Response(text="go")
+
+async def main():
+    app = web.Application()
+    app.router.add_get("/ckpt.safetensors", blob)
+    app.router.add_get("/stats", served)
+    app.router.add_get("/barrier", barrier)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    print("PORT", site._server.sockets[0].getsockname()[1], flush=True)
+    await asyncio.sleep(600)
+
+asyncio.run(main())
+"""
+
+_SHARD_WORKER = r"""
+import asyncio, os, sys
+sys.path.insert(0, os.environ["DF_REPO"])
+
+import numpy as np
+import jax
+
+from dragonfly2_tpu.parallel import multihost
+
+pid = int(os.environ["DF_PROC_ID"])
+nprocs = int(os.environ["DF_NUM_PROCS"])
+
+multihost.initialize_distributed(
+    coordinator_address=os.environ["DF_COORD"],
+    num_processes=nprocs, process_id=pid)
+assert jax.process_count() == nprocs
+
+from jax.sharding import Mesh
+
+from dragonfly2_tpu.client import device as device_lib
+from dragonfly2_tpu.daemon.config import DaemonConfig
+from dragonfly2_tpu.daemon.daemon import Daemon
+
+
+async def pull_my_shard():
+    cfg = DaemonConfig()
+    cfg.work_home = os.environ["DF_HOME"]
+    cfg.__post_init__()
+    cfg.host.hostname = f"shardhost{pid}"
+    cfg.host.ip = "127.0.0.1"
+    cfg.scheduler.addrs = [os.environ["DF_SCHED"]]
+    cfg.gc_interval = 3600
+    cfg.tpu_sink.enabled = True
+    d = Daemon(cfg)
+    await d.start()
+    try:
+        got = await device_lib.download_sharded(
+            d, os.environ["DF_URL"], names=[f"shard{pid}"])
+        return np.asarray(got[f"shard{pid}"])
+    finally:
+        await d.stop()
+
+
+local = asyncio.run(pull_my_shard())
+rows, cols = local.shape
+
+# Align with the other worker before the first cross-process collective:
+# fabric-phase skew (downloads + XLA compiles on a contended core) can
+# exceed the collective's deadline.
+import urllib.request
+
+base = os.environ["DF_URL"].rsplit("/", 1)[0]
+urllib.request.urlopen(f"{base}/barrier?n={nprocs}", timeout=180).read()
+
+devices = np.array(jax.devices())
+mesh = Mesh(devices.reshape(devices.size), ("d",))
+arr = multihost.global_from_local_shards(mesh, local, axis_name="d")
+assert arr.shape == (rows * nprocs, cols), arr.shape
+
+# The logical weight is arange over the full matrix: a global reduction
+# (cross-process XLA collective) checks every shard landed in its slot.
+total = rows * nprocs * cols
+want_sum = float(np.arange(total, dtype=np.float64).sum())
+got_sum = float(jax.jit(lambda a: a.sum())(arr))
+# Relative tolerances: x64 is disabled in the workers, and a shard in
+# the wrong slot shifts the weighted sum by whole percents.
+assert abs(got_sum - want_sum) < 1e-4 * want_sum, (got_sum, want_sum)
+w = np.linspace(1.0, 2.0, rows * nprocs, dtype=np.float32)[:, None]
+want_w = float((np.arange(total, dtype=np.float64)
+                .reshape(rows * nprocs, cols) * w).sum())
+got_w = float(jax.jit(lambda a: (a * w).sum())(arr))
+assert abs(got_w - want_w) < 1e-4 * want_w, (got_w, want_w)
+
+print(f"SHARDED_POD_OK p{pid}")
+"""
+
+
+@pytest.mark.slow
+def test_sharded_pod_pull_end_to_end(tmp_path):
+    """The full north-star chain across REAL process boundaries: a
+    safetensors checkpoint at an origin; a scheduler process; two
+    jax.distributed worker processes that each embed a daemon, pull ONLY
+    their own shard via download_sharded (ranged device tasks through
+    the fabric), and assemble the shards into one pod-global jax.Array
+    verified by cross-process collectives. Origin must serve each byte
+    ~once across BOTH workers (the shared header spans dedup via P2P)."""
+    import json as _json
+    import struct
+    import urllib.request
+
+    import numpy as np
+
+    rows, cols = 64, 32
+    full = np.arange(rows * 2 * cols, dtype=np.float32).reshape(rows * 2, cols)
+    header = {}
+    blobs = []
+    off = 0
+    for pid in range(2):
+        raw = full[pid * rows:(pid + 1) * rows].tobytes()
+        header[f"shard{pid}"] = {"dtype": "F32", "shape": [rows, cols],
+                                 "data_offsets": [off, off + len(raw)]}
+        blobs.append(raw)
+        off += len(raw)
+    hj = _json.dumps(header).encode()
+    ckpt = struct.pack("<Q", len(hj)) + hj + b"".join(blobs)
+    ckpt_path = str(tmp_path / "ckpt.safetensors")
+    with open(ckpt_path, "wb") as f:
+        f.write(ckpt)
+
+    base_env = scrub_accelerator_env(dict(os.environ))
+    base_env["DF_REPO"] = REPO
+    base_env.pop("XLA_FLAGS", None)
+    base_env["JAX_PLATFORMS"] = "cpu"
+
+    sched_port = _free_port()
+    try:
+        origin = subprocess.Popen(
+            [sys.executable, "-c", _ORIGIN],
+            env={**base_env, "DF_CKPT": ckpt_path},
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        sched = subprocess.Popen(
+            [sys.executable, "-m", "dragonfly2_tpu.cli.main", "scheduler",
+             "--host", "127.0.0.1", "--port", str(sched_port)],
+            env={**base_env, "PYTHONPATH": REPO},
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    except OSError as e:
+        pytest.skip(f"cannot spawn subprocess: {e}")
+    workers = []
+    try:
+        # stderr merges into stdout: skim warnings until the PORT line.
+        oport = None
+        for _ in range(50):
+            line = origin.stdout.readline().strip()
+            if line.startswith("PORT "):
+                oport = int(line.split()[1])
+                break
+        assert oport is not None, "origin never printed its port"
+        url = f"http://127.0.0.1:{oport}/ckpt.safetensors"
+
+        coord = f"127.0.0.1:{_free_port()}"
+        for pid in range(2):
+            env = dict(base_env)
+            env.update({
+                "DF_COORD": coord, "DF_PROC_ID": str(pid),
+                "DF_NUM_PROCS": "2", "DF_SCHED": f"127.0.0.1:{sched_port}",
+                "DF_URL": url, "DF_HOME": str(tmp_path / f"w{pid}"),
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            })
+            workers.append(subprocess.Popen(
+                [sys.executable, "-c", _SHARD_WORKER], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+        outs = []
+        for p in workers:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+        failures = [
+            f"w{pid} rc={p.returncode}:\n{out[-9000:]}"
+            for pid, (p, out) in enumerate(zip(workers, outs))
+            if p.returncode != 0 or f"SHARDED_POD_OK p{pid}" not in out]
+        assert not failures, "\n\n=====\n".join(failures)
+
+        # Origin economy across the pod: both workers' bytes together stay
+        # under ~1.2 copies of the checkpoint (each shard once + headers).
+        with urllib.request.urlopen(f"http://127.0.0.1:{oport}/stats",
+                                    timeout=10) as resp:
+            served = _json.loads(resp.read())["bytes"]
+        assert served <= int(len(ckpt) * 1.2), (served, len(ckpt))
+    finally:
+        for p in workers:
+            if p.poll() is None:
+                p.kill()
+        origin.kill()
+        sched.kill()
