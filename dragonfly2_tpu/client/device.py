@@ -269,10 +269,14 @@ async def download_sharded(daemon, url: str, *,
     # spans are bounded by the daemon's shared sink admission
     # (DeviceSinkManager.admit, acquired inside download_to_device), so
     # wide pulls — and CONCURRENT sharded pulls — cannot trip the
-    # HBM-resident cap's disk-only degradation.
-    for views in await asyncio.gather(*[pull_span(s, e, ns)
-                                        for s, e, ns in spans]):
-        out.update(views)
+    # HBM-resident cap's disk-only degradation. TaskGroup, not bare
+    # gather: a failed span must CANCEL its siblings — orphaned pulls
+    # would keep downloading multi-GB ranges, holding admission slots
+    # and HBM, against a result nobody will consume.
+    async with asyncio.TaskGroup() as tg:
+        tasks = [tg.create_task(pull_span(s, e, ns)) for s, e, ns in spans]
+    for t in tasks:
+        out.update(t.result())
     if shardings:  # unknown names already rejected above, pre-download
         import jax
 

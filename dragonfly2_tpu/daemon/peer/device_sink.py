@@ -18,8 +18,10 @@ download path still backpressures on landing.
 
 Lifecycle: sinks are created lazily at the first landed piece (task
 metadata — length and piece size — is unknown at request time), verified
-at completion, and held for a TTL for the consuming process to claim
-(``take``). Failed or aborted tasks discard their sink immediately;
+at completion, and held up to a TTL for the consuming process to claim
+(``take``) — under cap pressure a verified resident past its claim
+grace may be evicted early for a new landing (the disk store stays
+authoritative). Failed or aborted tasks discard their sink immediately;
 unclaimed sinks expire so HBM is not leaked. The disk store remains
 authoritative for upload/reuse — the sink is an *additional* terminal,
 which is what lets other peers still fetch pieces from this host.
@@ -68,6 +70,7 @@ class TaskDeviceSink:
                             batch_pieces=batch_pieces)
         self.created_at = time.time()
         self.verified = False
+        self.verified_at = 0.0
         # Host-side piece digests at land time: lets a later finalize
         # detect that the store's content changed under a resident sink.
         self.piece_digests: dict[int, str] = {}
@@ -89,6 +92,7 @@ class TaskDeviceSink:
             raise DeviceSinkError(str(e)) from e
         SINK_VERIFY_COUNT.labels("ok").inc()
         self.verified = True
+        self.verified_at = time.time()
 
     # Consumption — delegates to the HBMSink.
 
@@ -123,6 +127,7 @@ class DeviceSinkManager:
                  batch_pieces: int = 8, max_tasks: int = 4,
                  ttl: float = 600.0, device=None):
         self._admission = None
+        self.claim_grace_s = 10.0   # see _create's eviction rule
         self.mesh_shape = list(mesh_shape or [])
         self.batch_pieces = batch_pieces
         self.max_tasks = max_tasks
@@ -188,9 +193,14 @@ class DeviceSinkManager:
             # Residents are cached conveniences — the disk store stays
             # authoritative — so a verified, unclaimed sink yields its
             # HBM to a NEW landing rather than failing it (oldest first).
-            # Mid-landing sinks are never evicted.
+            # Mid-landing sinks are never evicted, and a freshly verified
+            # sink gets a claim grace: its requester is typically between
+            # verify and take() (both await points), and evicting there
+            # would strand a successful download in a lose-the-sink loop.
+            now = time.time()
             evictable = sorted(
-                (s for s in self._sinks.values() if s.verified),
+                (s for s in self._sinks.values()
+                 if s.verified and now - s.verified_at > self.claim_grace_s),
                 key=lambda s: s.created_at)
             if evictable:
                 victim = evictable[0]

@@ -604,7 +604,10 @@ class TaskManager:
             application=spec.get("application", ""),
             header=spec.get("header") or {},
             filter="&".join(spec.get("filters") or []),
-            range=spec.get("range", ""),
+            # Canonical form before ANYTHING hashes it: a raw trigger span
+            # ('0-7') must land under the same task id as client pulls of
+            # 'bytes=0-7' or the warmed store never dedups.
+            range=Range.normalize_header(spec.get("range", "")),
         )
         # seed=False: run as a normal peer (persistent-cache replication —
         # the scheduler wants this host to PULL from peers, not re-seed from

@@ -629,8 +629,14 @@ class LocalTaskStore:
         want = expected or self.metadata.digest
         algorithm = pkgdigest.parse(want).algorithm if want else pkgdigest.ALGORITHM_SHA256
         ph = self._prefix_hasher
-        if ph is not None and ph.algorithm == algorithm:
+        if ph is not None:
+            # Detach unconditionally: an algorithm-mismatched hasher must
+            # not keep pread'ing in parallel with the re-hash below.
             self._prefix_hasher = None
+            if ph.algorithm != algorithm:
+                ph.stop()
+                ph = None
+        if ph is not None:
             # The drain wait scales with content size: even a fully lagged
             # hasher re-reads from page cache and is faster than the cold
             # full re-hash below, so waiting is always cheaper than

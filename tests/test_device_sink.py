@@ -778,6 +778,9 @@ def test_resident_sinks_evict_for_new_landing(run_async, tmp_path):
             peer = await _start_sink_daemon(tmp_path, "evict", sched.port())
             daemons.append(peer)
             peer.task_manager.device_sinks.max_tasks = 2
+            # Disable the claim grace: this test's residents are seconds
+            # old, and eviction under pressure is what's being proven.
+            peer.task_manager.device_sinks.claim_grace_s = 0.0
 
             # Two unclaimed ranged pulls fill the cap with residents.
             r1 = await device_lib.download_to_device(
