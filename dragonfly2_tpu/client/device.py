@@ -161,6 +161,52 @@ async def fetch_safetensors_header(daemon, url: str, *, tag: str = "",
     return header_dict, 8 + n
 
 
+async def _pull_ranges(daemon, url: str, ranges, *, tag: str = "",
+                       application: str = "",
+                       header: dict | None = None) -> dict:
+    """Pull each ``(start, end)`` byte range as its own ranged device
+    task, concurrently under the daemon's shared sink admission; returns
+    ``{(start, end): u8_array}``. The single pull engine for
+    download_sharded and download_global — their task ids and coalesce
+    behavior must never fork. A failed range CANCELS its siblings
+    (orphaned pulls would keep downloading against a dead result), and
+    the first real failure re-raises UNWRAPPED so callers keep the plain
+    DfError/SafetensorsError contract rather than an ExceptionGroup."""
+    import asyncio
+
+    landed: dict = {}
+
+    async def pull(s0: int, s1: int) -> None:
+        result = await download_to_device(
+            daemon, url, tag=tag, application=application, header=header,
+            range_header=f"{s0}-{s1 - 1}")
+        landed[(s0, s1)] = result.as_bytes_array()
+
+    try:
+        async with asyncio.TaskGroup() as tg:
+            for s0, s1 in ranges:
+                tg.create_task(pull(s0, s1))
+    except BaseExceptionGroup as eg:
+        raise eg.exceptions[0] from eg
+    return landed
+
+
+def _validated_span(name: str, meta, data_start: int) -> tuple[int, int]:
+    """(absolute_start, absolute_end) of a tensor's bytes, with the
+    malformed-header failure modes surfaced as SafetensorsError."""
+    from dragonfly2_tpu.ops import safetensors as st
+
+    if not isinstance(meta, dict):
+        raise st.SafetensorsError(f"{name}: entry must be an object")
+    offsets = meta.get("data_offsets")
+    if (not isinstance(offsets, list) or len(offsets) != 2
+            or not all(isinstance(o, int) and not isinstance(o, bool)
+                       for o in offsets)
+            or offsets[1] < offsets[0] or offsets[0] < 0):
+        raise st.SafetensorsError(f"{name}: bad data_offsets {offsets!r}")
+    return data_start + offsets[0], data_start + offsets[1]
+
+
 async def download_sharded(daemon, url: str, *,
                            names: list[str] | None = None,
                            selector=None,
@@ -202,12 +248,8 @@ async def download_sharded(daemon, url: str, *,
             continue
         if selector is not None and not selector(name, meta):
             continue
-        offsets = meta.get("data_offsets") if isinstance(meta, dict) else None
-        if (not isinstance(offsets, list) or len(offsets) != 2
-                or not all(isinstance(o, int) for o in offsets)
-                or offsets[1] < offsets[0]):
-            raise st.SafetensorsError(f"{name}: bad data_offsets")
-        picked.append((data_start + offsets[0], data_start + offsets[1], name))
+        start, end = _validated_span(name, meta, data_start)
+        picked.append((start, end, name))
     if names is not None:
         missing = set(names) - {n for _, _, n in picked}
         if missing:
@@ -247,11 +289,14 @@ async def download_sharded(daemon, url: str, *,
         else:
             spans.append([start, end, [name]])
 
-    async def pull_span(start: int, end: int, span_names: list) -> dict:
-        result = await download_to_device(
-            daemon, url, tag=tag, application=application, header=header,
-            range_header=f"{start}-{end - 1}")
-        u8 = result.as_bytes_array()
+    # Independent spans pull concurrently (scattered shards — e.g. MoE
+    # expert weights — are max-of-spans, not sum-of-spans), bounded by
+    # the daemon's shared sink admission inside _pull_ranges.
+    landed = await _pull_ranges(daemon, url, [(s, e) for s, e, _ in spans],
+                                tag=tag, application=application,
+                                header=header)
+    for start, end, span_names in spans:
+        u8 = landed[(start, end)]
         # Rebase the span's tensors onto the slice: tensor_views validates
         # and bitcasts exactly as for a full-content landing.
         sub_header = {
@@ -260,26 +305,142 @@ async def download_sharded(daemon, url: str, *,
                     data_start + header_dict[n]["data_offsets"][0] - start,
                     data_start + header_dict[n]["data_offsets"][1] - start]}
             for n in span_names}
-        return st.tensor_views(u8, sub_header, 0, span_names)
-
-    import asyncio
-
-    # Independent spans pull concurrently (scattered shards — e.g. MoE
-    # expert weights — are max-of-spans, not sum-of-spans). In-flight
-    # spans are bounded by the daemon's shared sink admission
-    # (DeviceSinkManager.admit, acquired inside download_to_device), so
-    # wide pulls — and CONCURRENT sharded pulls — cannot trip the
-    # HBM-resident cap's disk-only degradation. TaskGroup, not bare
-    # gather: a failed span must CANCEL its siblings — orphaned pulls
-    # would keep downloading multi-GB ranges, holding admission slots
-    # and HBM, against a result nobody will consume.
-    async with asyncio.TaskGroup() as tg:
-        tasks = [tg.create_task(pull_span(s, e, ns)) for s, e, ns in spans]
-    for t in tasks:
-        out.update(t.result())
+        out.update(st.tensor_views(u8, sub_header, 0, span_names))
     if shardings:  # unknown names already rejected above, pre-download
         import jax
 
         for name, sharding in shardings.items():
             out[name] = jax.device_put(out[name], sharding)
+    return out
+
+
+async def download_global(daemon, url: str,
+                          shardings: dict, *,
+                          tag: str = "", application: str = "",
+                          header: dict | None = None):
+    """Global sharded checkpoint load through the fabric: for each tensor,
+    pull ONLY the byte ranges this process's devices actually hold under
+    its jax Sharding, land them as ranged device tasks, and assemble true
+    global ``jax.Array``s with ``make_array_from_single_device_arrays``.
+
+    The pod pattern this completes: every host computes the same plan
+    from (header x shardings); hosts holding the same shard issue
+    byte-identical ranged tasks, so origin traffic dedupes per shard
+    RANGE across the pod — a TP=16 row-sharded matrix costs the origin
+    one copy TOTAL, each 1/16th fetched once and fanned over P2P.
+
+    Leading-axis shards (a slice on axis 0, all trailing axes full) map
+    to contiguous byte ranges and are pulled exactly; any other layout
+    falls back to pulling that tensor's full span once per host and
+    slicing on device. Adjacent shard ranges on one host coalesce into
+    single tasks. ``shardings``: tensor name -> jax.sharding.Sharding
+    (tensors not named are not loaded).
+    """
+    import numpy as np
+
+    import jax
+
+    from dragonfly2_tpu.ops import safetensors as st
+
+    header_dict, data_start = await fetch_safetensors_header(
+        daemon, url, tag=tag, application=application, header=header)
+
+    missing = [n for n in shardings if n not in header_dict]
+    if missing:
+        raise st.SafetensorsError(
+            f"tensors not in checkpoint: {sorted(missing)}")
+
+    # Plan: per (tensor, local device) -> the absolute byte span it needs
+    # plus how to carve the shard out of that span once landed.
+    #   (name, dev, span_start, span_end, shard_shape | None, idx | None)
+    plan = []
+    spans_needed: set[tuple[int, int]] = set()
+    for name, sharding in shardings.items():
+        meta = header_dict[name]
+        begin, end = _validated_span(name, meta, data_start)
+        shape_raw = meta.get("shape")
+        if (not isinstance(shape_raw, list)
+                or not all(isinstance(d, int) and not isinstance(d, bool)
+                           and d >= 0 for d in shape_raw)):
+            raise st.SafetensorsError(f"{name}: bad shape {shape_raw!r}")
+        shape = tuple(shape_raw)
+        nbytes = end - begin
+        count = int(np.prod(shape)) if shape else 1
+        itemsize = nbytes // max(1, count)
+        row_bytes = (int(np.prod(shape[1:])) if len(shape) > 1 else 1) * itemsize
+        idx_map = sharding.devices_indices_map(shape)
+        for dev in sharding.addressable_devices:
+            idx = idx_map[dev]
+
+            def _dim(sl, size):
+                start, stop, step = sl.indices(size)
+                return max(0, -(-(stop - start) // step))
+
+            shard_shape = tuple(
+                _dim(sl, dim) if isinstance(sl, slice) else 1
+                for sl, dim in zip(idx, shape))
+            lead = idx[0] if idx else slice(None)
+            contiguous = (
+                len(shape) >= 1 and nbytes > 0
+                and isinstance(lead, slice) and lead.step in (None, 1)
+                and all(isinstance(s, slice)
+                        and s == slice(None) for s in idx[1:]))
+            if contiguous:
+                r0 = lead.start or 0
+                r1 = shape[0] if lead.stop is None else lead.stop
+                span = (begin + r0 * row_bytes, begin + r1 * row_bytes)
+                plan.append((name, dev, span[0], span[1], shard_shape, None))
+            else:
+                span = (begin, end)   # whole tensor; slice on device
+                plan.append((name, dev, begin, end, shard_shape, idx))
+            if span[1] > span[0]:
+                spans_needed.add(span)
+
+    # Coalesce touching spans into super-ranges → one ranged task each.
+    merged: list[list[int]] = []
+    for s0, s1 in sorted(spans_needed):
+        if merged and s0 <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], s1)
+        else:
+            merged.append([s0, s1])
+
+    landed = await _pull_ranges(daemon, url, [tuple(m) for m in merged],
+                                tag=tag, application=application,
+                                header=header)
+
+    def super_range(a: int, b: int) -> tuple[int, int]:
+        for s0, s1 in merged:
+            if s0 <= a and b <= s1:
+                return (s0, s1)
+        raise st.SafetensorsError("internal: span not covered")  # pragma: no cover
+
+    out: dict[str, object] = {}
+    by_name: dict[str, list] = {}
+    for name, dev, a, b, shard_shape, idx in plan:
+        meta = header_dict[name]
+        if b <= a:
+            # Zero-element shard: synthesize through the same validated
+            # dtype path as real carves (tensor_views rejects unknown
+            # dtypes as SafetensorsError, never a bare KeyError).
+            sub = {name: {**meta, "shape": list(shard_shape),
+                          "data_offsets": [0, 0]}}
+            shard = st.tensor_views(jax.numpy.zeros((0,), dtype="uint8"),
+                                    sub, 0, [name])[name]
+        elif idx is not None:
+            # Fallback: the whole tensor landed; carve the (possibly
+            # non-contiguous) shard on device.
+            s0, s1 = super_range(a, b)
+            sub = {name: {**meta, "data_offsets": [a - s0, b - s0]}}
+            shard = st.tensor_views(landed[(s0, s1)], sub, 0, [name])[name]
+            shard = shard[idx]
+        else:
+            s0, s1 = super_range(a, b)
+            sub = {name: {**meta, "shape": list(shard_shape),
+                          "data_offsets": [a - s0, b - s0]}}
+            shard = st.tensor_views(landed[(s0, s1)], sub, 0, [name])[name]
+        by_name.setdefault(name, []).append(jax.device_put(shard, dev))
+    for name, sharding in shardings.items():
+        shape = tuple(header_dict[name].get("shape") or ())
+        out[name] = jax.make_array_from_single_device_arrays(
+            shape, sharding, by_name[name])
     return out

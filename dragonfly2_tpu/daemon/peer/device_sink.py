@@ -198,10 +198,17 @@ class DeviceSinkManager:
             # verify and take() (both await points), and evicting there
             # would strand a successful download in a lose-the-sink loop.
             now = time.time()
-            evictable = sorted(
-                (s for s in self._sinks.values()
-                 if s.verified and now - s.verified_at > self.claim_grace_s),
-                key=lambda s: s.created_at)
+            verified = sorted((s for s in self._sinks.values() if s.verified),
+                              key=lambda s: s.created_at)
+            # Grace is a PREFERENCE, not a guarantee: evict out-of-grace
+            # residents first, but when every resident is freshly
+            # verified (e.g. an RPC preheat just warmed max_tasks sinks)
+            # still evict the oldest rather than hard-failing the new
+            # landing — the displaced claimer's retry rebuilds from the
+            # authoritative disk store.
+            evictable = ([s for s in verified
+                          if now - s.verified_at > self.claim_grace_s]
+                         or verified)
             if evictable:
                 victim = evictable[0]
                 log.info("evicting resident device sink for new landing",

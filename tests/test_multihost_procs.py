@@ -201,14 +201,18 @@ multihost.initialize_distributed(
     num_processes=nprocs, process_id=pid)
 assert jax.process_count() == nprocs
 
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dragonfly2_tpu.client import device as device_lib
 from dragonfly2_tpu.daemon.config import DaemonConfig
 from dragonfly2_tpu.daemon.daemon import Daemon
 
+devices = np.array(jax.devices())
+mesh = Mesh(devices.reshape(devices.size), ("d",))
+sharding = NamedSharding(mesh, P("d", None))
 
-async def pull_my_shard():
+
+async def pull_my_shards():
     cfg = DaemonConfig()
     cfg.work_home = os.environ["DF_HOME"]
     cfg.__post_init__()
@@ -220,15 +224,17 @@ async def pull_my_shard():
     d = Daemon(cfg)
     await d.start()
     try:
-        got = await device_lib.download_sharded(
-            d, os.environ["DF_URL"], names=[f"shard{pid}"])
-        return np.asarray(got[f"shard{pid}"])
+        # download_global: THIS process pulls only the byte ranges its
+        # local devices hold under the global sharding, and the result
+        # is already a pod-global jax.Array.
+        got = await device_lib.download_global(
+            d, os.environ["DF_URL"], {"w": sharding})
+        return got["w"]
     finally:
         await d.stop()
 
 
-local = asyncio.run(pull_my_shard())
-rows, cols = local.shape
+arr = asyncio.run(pull_my_shards())
 
 # Align with the other worker before the first cross-process collective:
 # fabric-phase skew (downloads + XLA compiles on a contended core) can
@@ -238,10 +244,9 @@ import urllib.request
 base = os.environ["DF_URL"].rsplit("/", 1)[0]
 urllib.request.urlopen(f"{base}/barrier?n={nprocs}", timeout=180).read()
 
-devices = np.array(jax.devices())
-mesh = Mesh(devices.reshape(devices.size), ("d",))
-arr = multihost.global_from_local_shards(mesh, local, axis_name="d")
-assert arr.shape == (rows * nprocs, cols), arr.shape
+rows = arr.shape[0] // nprocs
+cols = arr.shape[1]
+assert arr.sharding.is_equivalent_to(sharding, len(arr.shape))
 
 # The logical weight is arange over the full matrix: a global reduction
 # (cross-process XLA collective) checks every shard landed in its slot.
@@ -276,19 +281,13 @@ def test_sharded_pod_pull_end_to_end(tmp_path):
 
     import numpy as np
 
-    rows, cols = 64, 32
-    full = np.arange(rows * 2 * cols, dtype=np.float32).reshape(rows * 2, cols)
-    header = {}
-    blobs = []
-    off = 0
-    for pid in range(2):
-        raw = full[pid * rows:(pid + 1) * rows].tobytes()
-        header[f"shard{pid}"] = {"dtype": "F32", "shape": [rows, cols],
-                                 "data_offsets": [off, off + len(raw)]}
-        blobs.append(raw)
-        off += len(raw)
+    rows, cols = 128, 32     # one logical weight; 4 global devices shard rows
+    full = np.arange(rows * cols, dtype=np.float32).reshape(rows, cols)
+    raw = full.tobytes()
+    header = {"w": {"dtype": "F32", "shape": [rows, cols],
+                    "data_offsets": [0, len(raw)]}}
     hj = _json.dumps(header).encode()
-    ckpt = struct.pack("<Q", len(hj)) + hj + b"".join(blobs)
+    ckpt = struct.pack("<Q", len(hj)) + hj + raw
     ckpt_path = str(tmp_path / "ckpt.safetensors")
     with open(ckpt_path, "wb") as f:
         f.write(ckpt)
