@@ -94,32 +94,42 @@ async def download_to_device(daemon, url: str, *, digest: str = "",
     if rng:
         req.range = Range.parse_http(rng)
     sink = None
-    for attempt in range(2):
-        final = None
-        async with tm.device_sinks.admit():
-            async for progress in tm.start_file_task(req):
-                if progress.state == "failed":
-                    raise DfError.from_wire(progress.error or {})
-                if progress.state == "done":
-                    final = progress
-        if final is None:
-            raise DfError(Code.UnknownError, "download ended without a result")
-        if not final.device_verified:
-            raise DfError(Code.ClientPieceDownloadFail,
-                          "content did not land in the device sink "
-                          "(sink cap reached or pieces misaligned)")
-        task_id = final.task_id
-        sink = (tm.device_sinks.take(task_id) if claim
-                else tm.device_sinks.get(task_id))
-        if sink is not None:
-            break
-        # Claim raced away: concurrent callers of the SAME task (dedup)
-        # share one landing, and another claimer took it first. The task
-        # is complete on disk, so one re-run rides the reuse path, which
-        # backfills and re-verifies a fresh sink from the store.
-        if attempt == 0:
-            log.info("device sink claimed by a concurrent caller; "
-                     "rebuilding from store", task=task_id[:16])
+    # The task id is deterministic: announce the imminent claim so the
+    # verify→take window can never lose the sink to cap-pressure
+    # eviction (protect), only to a concurrent claimer of the same task.
+    expected_id = req.task_id()
+    tm.device_sinks.protect(expected_id)
+    try:
+        for attempt in range(2):
+            final = None
+            async with tm.device_sinks.admit():
+                async for progress in tm.start_file_task(req):
+                    if progress.state == "failed":
+                        raise DfError.from_wire(progress.error or {})
+                    if progress.state == "done":
+                        final = progress
+            if final is None:
+                raise DfError(Code.UnknownError,
+                              "download ended without a result")
+            if not final.device_verified:
+                raise DfError(Code.ClientPieceDownloadFail,
+                              "content did not land in the device sink "
+                              "(sink cap reached or pieces misaligned)")
+            task_id = final.task_id
+            sink = (tm.device_sinks.take(task_id) if claim
+                    else tm.device_sinks.get(task_id))
+            if sink is not None:
+                break
+            # Claim raced away: concurrent callers of the SAME task
+            # (dedup) share one landing, and another claimer took it
+            # first. The task is complete on disk, so one re-run rides
+            # the reuse path, which backfills and re-verifies a fresh
+            # sink from the store.
+            if attempt == 0:
+                log.info("device sink claimed by a concurrent caller; "
+                         "rebuilding from store", task=task_id[:16])
+    finally:
+        tm.device_sinks.unprotect(expected_id)
     if sink is None:
         raise DfError(Code.UnknownError, "device sink vanished after verify")
     result = DeviceResult(task_id=task_id,

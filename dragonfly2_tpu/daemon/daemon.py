@@ -252,6 +252,13 @@ class Daemon:
 
     async def start(self) -> None:
         """Bring every service up (non-blocking)."""
+        # Hold the process-global source registry for this daemon's
+        # lifetime: the LAST in-process daemon to stop closes the pooled
+        # origin sessions (shutdown hygiene without breaking siblings'
+        # in-flight streams).
+        from dragonfly2_tpu.source.client import default_registry
+
+        self._source_registry = default_registry().retain()
         # Warm the native data-plane probe off-loop: a cold first import
         # compiles the C++ library (seconds of g++), which must not freeze
         # the event loop at the first piece write on the hot path.
@@ -346,6 +353,10 @@ class Daemon:
         await self.rpc.close()
         if self.task_manager.device_sinks is not None:
             self.task_manager.device_sinks.close()
+        registry = getattr(self, "_source_registry", None)
+        if registry is not None:
+            self._source_registry = None
+            await registry.release()
         self.storage.close()
         self._stopped.set()
 

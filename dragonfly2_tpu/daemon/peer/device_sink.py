@@ -128,6 +128,11 @@ class DeviceSinkManager:
                  ttl: float = 600.0, device=None):
         self._admission = None
         self.claim_grace_s = 10.0   # see _create's eviction rule
+        # Task ids a client pull has announced it WILL claim (set before
+        # the landing starts, cleared after take) — never evicted.
+        # Refcounted: concurrent claimers of one deduped task each hold
+        # a reference; the first to finish must not strip the others'.
+        self._protected: dict[str, int] = {}
         self.mesh_shape = list(mesh_shape or [])
         self.batch_pieces = batch_pieces
         self.max_tasks = max_tasks
@@ -198,14 +203,18 @@ class DeviceSinkManager:
             # verify and take() (both await points), and evicting there
             # would strand a successful download in a lose-the-sink loop.
             now = time.time()
-            verified = sorted((s for s in self._sinks.values() if s.verified),
-                              key=lambda s: s.created_at)
+            verified = sorted(
+                (s for s in self._sinks.values()
+                 if s.verified and s.task_id not in self._protected),
+                key=lambda s: s.created_at)
             # Grace is a PREFERENCE, not a guarantee: evict out-of-grace
-            # residents first, but when every resident is freshly
-            # verified (e.g. an RPC preheat just warmed max_tasks sinks)
-            # still evict the oldest rather than hard-failing the new
-            # landing — the displaced claimer's retry rebuilds from the
-            # authoritative disk store.
+            # residents first, but when every (unprotected) resident is
+            # freshly verified (e.g. an RPC preheat just warmed max_tasks
+            # sinks) still evict the oldest rather than hard-failing the
+            # new landing. Sinks a client pull has announced it will
+            # claim (protect/unprotect) are never candidates — evicting
+            # one strands a completed, verified download in a
+            # lose-the-sink retry loop.
             evictable = ([s for s in verified
                           if now - s.verified_at > self.claim_grace_s]
                          or verified)
@@ -294,6 +303,19 @@ class DeviceSinkManager:
         return False
 
     # -- consumption / lifecycle ------------------------------------------
+
+    def protect(self, task_id: str) -> None:
+        """Announce an imminent claim: the sink for ``task_id`` (existing
+        or about to land) is exempt from cap-pressure eviction until
+        ``unprotect``. Callers must pair with unprotect in a finally."""
+        self._protected[task_id] = self._protected.get(task_id, 0) + 1
+
+    def unprotect(self, task_id: str) -> None:
+        n = self._protected.get(task_id, 0) - 1
+        if n > 0:
+            self._protected[task_id] = n
+        else:
+            self._protected.pop(task_id, None)
 
     def get(self, task_id: str) -> TaskDeviceSink | None:
         return self._sinks.get(task_id)

@@ -118,6 +118,20 @@ class ResourceClient(abc.ABC):
 class Registry:
     def __init__(self):
         self._clients: dict[str, ResourceClient] = {}
+        self._retains = 0
+
+    def retain(self) -> "Registry":
+        """A long-lived user (an in-process daemon) takes a reference;
+        ``release`` closes pooled sessions only when the LAST user goes
+        away — closing earlier would kill in-flight origin streams of
+        the other daemons sharing this process-global registry."""
+        self._retains += 1
+        return self
+
+    async def release(self) -> None:
+        self._retains = max(0, self._retains - 1)
+        if self._retains == 0:
+            await self.close_all()
 
     def register(self, scheme: str, client: ResourceClient) -> None:
         self._clients[scheme.lower()] = client
@@ -150,6 +164,20 @@ class Registry:
 
     def schemes(self) -> list[str]:
         return sorted(self._clients)
+
+    async def close_all(self) -> None:
+        """Close every client's pooled connections (daemon shutdown
+        hygiene — otherwise lazily-created sessions leak to interpreter
+        exit). Safe with multiple in-process daemons: clients rebuild
+        their session on next use."""
+        for client in list(self._clients.values()):
+            close = getattr(client, "close", None)
+            if close is None:
+                continue
+            try:
+                await close()
+            except Exception:  # noqa: BLE001 - shutdown best-effort
+                pass
 
 
 _default = Registry()

@@ -74,23 +74,81 @@ def main() -> None:
     print(f"mesh={dict(mesh.shape)} w1.sharding={params['w1'].sharding.spec} "
           f"forward-pass exact: OK")
 
-    # Sharded pull (production: client.device.download_sharded) — a host
-    # that only holds pipeline stage 1 fetches ONLY w2's byte range as a
-    # ranged device task; here the equivalent slice lands in its own sink.
-    header, data_start = json.loads(
-        content[8:8 + struct.unpack("<Q", content[:8])[0]]), \
-        8 + struct.unpack("<Q", content[:8])[0]
-    b, e = header["w2"]["data_offsets"]
-    span = content[data_start + b:data_start + e]
-    shard_sink = HBMSink(len(span), piece, batch_pieces=4)
-    for n in range((len(span) + piece - 1) // piece):
-        shard_sink.land_piece(n, span[n * piece:(n + 1) * piece])
-    assert shard_sink.complete() and shard_sink.verify()
-    w2 = np.asarray(shard_sink.as_bytes_array()).view(np.float32)
-    np.testing.assert_array_equal(w2.reshape(128, 32), ref["w2"])
-    print(f"sharded pull: stage host landed {len(span)} of "
-          f"{len(content)} bytes ({len(span) * 100 // len(content)}%) "
-          "— w2 bit-exact: OK")
+    # Global sharded load through the REAL fabric: origin + scheduler +
+    # sink daemon in this process, then client.device.download_global
+    # pulls only the byte ranges the mesh's devices hold and hands back
+    # global arrays directly — the production checkpoint-loading API.
+    import asyncio
+
+    asyncio.run(fabric_global_load(content, ref, mesh))
+
+
+async def fabric_global_load(content: bytes, ref, mesh) -> None:
+    import socket
+    import tempfile
+
+    from aiohttp import web
+
+    from dragonfly2_tpu.client import device as device_lib
+    from dragonfly2_tpu.daemon.config import DaemonConfig
+    from dragonfly2_tpu.daemon.daemon import Daemon
+    from dragonfly2_tpu.pkg.piece import Range
+    from dragonfly2_tpu.scheduler.config import SchedulerConfig
+    from dragonfly2_tpu.scheduler.server import SchedulerServer
+
+    served = {"bytes": 0}
+
+    async def blob(request):
+        rng = request.headers.get("Range")
+        if rng:
+            r = Range.parse_http(rng, len(content))
+            served["bytes"] += r.length
+            return web.Response(
+                status=206, body=content[r.start:r.start + r.length],
+                headers={"Content-Range":
+                         f"bytes {r.start}-{r.start + r.length - 1}"
+                         f"/{len(content)}",
+                         "Accept-Ranges": "bytes"})
+        served["bytes"] += len(content)
+        return web.Response(body=content,
+                            headers={"Accept-Ranges": "bytes"})
+
+    app = web.Application()
+    app.router.add_get("/ckpt.safetensors", blob)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    oport = site._server.sockets[0].getsockname()[1]
+
+    scfg = SchedulerConfig()
+    scfg.server.port = 0
+    scfg.scheduling.retry_interval = 0.05
+    sched = SchedulerServer(scfg)
+    await sched.start()
+
+    dcfg = DaemonConfig()
+    dcfg.work_home = tempfile.mkdtemp(prefix="df-example-")
+    dcfg.__post_init__()
+    dcfg.host.hostname = socket.gethostname()
+    dcfg.host.ip = "127.0.0.1"
+    dcfg.scheduler.addrs = [f"127.0.0.1:{sched.port()}"]
+    dcfg.tpu_sink.enabled = True
+    daemon = Daemon(dcfg)
+    await daemon.start()
+    try:
+        params = await device_lib.download_global(
+            daemon, f"http://127.0.0.1:{oport}/ckpt.safetensors",
+            {"w2": NamedSharding(mesh, P("tp", None))})
+        np.testing.assert_array_equal(np.asarray(params["w2"]), ref["w2"])
+        print(f"download_global: w2 pulled as per-device row ranges "
+              f"({served['bytes']} origin bytes for a "
+              f"{len(content)}-byte checkpoint), global sharding "
+              f"{params['w2'].sharding.spec} — bit-exact: OK")
+    finally:
+        await daemon.stop()
+        await sched.stop()
+        await runner.cleanup()
 
 
 if __name__ == "__main__":

@@ -40,6 +40,41 @@ async def _start_sink_daemon(tmp_path, name, scheduler_port, *, seed=False,
     await d.start()
     return d
 
+async def start_content_origin(content: bytes):
+    """One ranged origin for every sharded/global test: serves ``content``
+    with 206 Range support and counts served bytes. Returns
+    (runner, url, stats). The single copy — range semantics fixes must
+    not need five edits."""
+    from aiohttp import web
+
+    from dragonfly2_tpu.pkg.piece import Range as _Range
+
+    stats = {"bytes": 0}
+
+    async def blob(request):
+        hdr = request.headers.get("Range")
+        if hdr:
+            r = _Range.parse_http(hdr, len(content))
+            data = content[r.start:r.start + r.length]
+            stats["bytes"] += len(data)
+            return web.Response(status=206, body=data, headers={
+                "Content-Range":
+                    f"bytes {r.start}-{r.start + r.length - 1}/{len(content)}",
+                "Accept-Ranges": "bytes"})
+        stats["bytes"] += len(content)
+        return web.Response(body=content,
+                            headers={"Accept-Ranges": "bytes"})
+
+    app = web.Application()
+    app.router.add_get("/content", blob)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}/content", stats
+
+
 
 def test_p2p_download_lands_in_device_buffer(run_async, tmp_path):
     """Seed + peer: the peer's P2P download lands in HBM piece-by-piece,
@@ -463,7 +498,6 @@ def test_download_sharded_fetches_only_selected_tensors(run_async, tmp_path):
     async def body():
         from aiohttp import web
 
-        from dragonfly2_tpu.pkg.piece import Range as _Range
         from tests.test_safetensors import make_safetensors
 
         rng_np = np.random.RandomState(11)
@@ -477,31 +511,8 @@ def test_download_sharded_fetches_only_selected_tensors(run_async, tmp_path):
         }
         dtypes = {k: "F32" for k in tensors}
         ckpt = make_safetensors(tensors, dtypes)
-        stats = {"bytes": 0}
-
-        async def blob(request):
-            hdr = request.headers.get("Range")
-            if hdr:
-                r = _Range.parse_http(hdr, len(ckpt))
-                data = ckpt[r.start:r.start + r.length]
-                stats["bytes"] += len(data)
-                return web.Response(status=206, body=data, headers={
-                    "Content-Range":
-                        f"bytes {r.start}-{r.start + r.length - 1}/{len(ckpt)}",
-                    "Accept-Ranges": "bytes"})
-            stats["bytes"] += len(ckpt)
-            return web.Response(body=ckpt,
-                                headers={"Accept-Ranges": "bytes"})
-
-        app = web.Application()
-        app.router.add_get("/ckpt.safetensors", blob)
-        runner = web.AppRunner(app, access_log=None)
-        await runner.setup()
-        site = web.TCPSite(runner, "127.0.0.1", 0)
-        await site.start()
-        oport = site._server.sockets[0].getsockname()[1]
+        runner, url, stats = await start_content_origin(ckpt)
         sched = await start_scheduler()
-        url = f"http://127.0.0.1:{oport}/ckpt.safetensors"
         daemons = []
         try:
             peer = await _start_sink_daemon(tmp_path, "shards", sched.port())
@@ -545,7 +556,6 @@ def test_download_sharded_zero_element_and_bad_shardings(run_async, tmp_path):
         from aiohttp import web
 
         from dragonfly2_tpu.ops.safetensors import SafetensorsError
-        from dragonfly2_tpu.pkg.piece import Range as _Range
         from tests.test_safetensors import make_safetensors
 
         tensors = {
@@ -554,28 +564,8 @@ def test_download_sharded_zero_element_and_bad_shardings(run_async, tmp_path):
         }
         ckpt = make_safetensors(tensors, {k: "F32" for k in tensors})
 
-        async def blob(request):
-            hdr = request.headers.get("Range")
-            if hdr:
-                r = _Range.parse_http(hdr, len(ckpt))
-                return web.Response(
-                    status=206, body=ckpt[r.start:r.start + r.length],
-                    headers={"Content-Range":
-                             f"bytes {r.start}-{r.start + r.length - 1}"
-                             f"/{len(ckpt)}",
-                             "Accept-Ranges": "bytes"})
-            return web.Response(body=ckpt,
-                                headers={"Accept-Ranges": "bytes"})
-
-        app = web.Application()
-        app.router.add_get("/z.safetensors", blob)
-        runner = web.AppRunner(app, access_log=None)
-        await runner.setup()
-        site = web.TCPSite(runner, "127.0.0.1", 0)
-        await site.start()
-        oport = site._server.sockets[0].getsockname()[1]
+        runner, url, stats = await start_content_origin(ckpt)
         sched = await start_scheduler()
-        url = f"http://127.0.0.1:{oport}/z.safetensors"
         daemons = []
         try:
             peer = await _start_sink_daemon(tmp_path, "zedge", sched.port())
@@ -645,7 +635,6 @@ def test_download_sharded_more_spans_than_sink_cap(run_async, tmp_path):
     async def body():
         from aiohttp import web
 
-        from dragonfly2_tpu.pkg.piece import Range as _Range
         from tests.test_safetensors import make_safetensors
 
         rng_np = np.random.RandomState(21)
@@ -657,28 +646,8 @@ def test_download_sharded_more_spans_than_sink_cap(run_async, tmp_path):
             tensors[f"gap{i}"] = rng_np.randn(65536).astype(np.float32)
         ckpt = make_safetensors(tensors, {k: "F32" for k in tensors})
 
-        async def blob(request):
-            hdr = request.headers.get("Range")
-            if hdr:
-                r = _Range.parse_http(hdr, len(ckpt))
-                return web.Response(
-                    status=206, body=ckpt[r.start:r.start + r.length],
-                    headers={"Content-Range":
-                             f"bytes {r.start}-{r.start + r.length - 1}"
-                             f"/{len(ckpt)}",
-                             "Accept-Ranges": "bytes"})
-            return web.Response(body=ckpt,
-                                headers={"Accept-Ranges": "bytes"})
-
-        app = web.Application()
-        app.router.add_get("/cap.safetensors", blob)
-        runner = web.AppRunner(app, access_log=None)
-        await runner.setup()
-        site = web.TCPSite(runner, "127.0.0.1", 0)
-        await site.start()
-        oport = site._server.sockets[0].getsockname()[1]
+        runner, url, stats = await start_content_origin(ckpt)
         sched = await start_scheduler()
-        url = f"http://127.0.0.1:{oport}/cap.safetensors"
         daemons = []
         try:
             peer = await _start_sink_daemon(tmp_path, "cap8", sched.port())
@@ -711,7 +680,6 @@ def test_concurrent_sharded_pulls_share_admission(run_async, tmp_path):
 
         from aiohttp import web
 
-        from dragonfly2_tpu.pkg.piece import Range as _Range
         from tests.test_safetensors import make_safetensors
 
         rng_np = np.random.RandomState(31)
@@ -721,28 +689,8 @@ def test_concurrent_sharded_pulls_share_admission(run_async, tmp_path):
             tensors[f"pad{i}"] = rng_np.randn(65536).astype(np.float32)
         ckpt = make_safetensors(tensors, {k: "F32" for k in tensors})
 
-        async def blob(request):
-            hdr = request.headers.get("Range")
-            if hdr:
-                r = _Range.parse_http(hdr, len(ckpt))
-                return web.Response(
-                    status=206, body=ckpt[r.start:r.start + r.length],
-                    headers={"Content-Range":
-                             f"bytes {r.start}-{r.start + r.length - 1}"
-                             f"/{len(ckpt)}",
-                             "Accept-Ranges": "bytes"})
-            return web.Response(body=ckpt,
-                                headers={"Accept-Ranges": "bytes"})
-
-        app = web.Application()
-        app.router.add_get("/adm.safetensors", blob)
-        runner = web.AppRunner(app, access_log=None)
-        await runner.setup()
-        site = web.TCPSite(runner, "127.0.0.1", 0)
-        await site.start()
-        oport = site._server.sockets[0].getsockname()[1]
+        runner, url, stats = await start_content_origin(ckpt)
         sched = await start_scheduler()
-        url = f"http://127.0.0.1:{oport}/adm.safetensors"
         daemons = []
         try:
             peer = await _start_sink_daemon(tmp_path, "adm", sched.port())
@@ -818,7 +766,6 @@ def test_download_global_sharded_arrays(run_async, tmp_path):
         from aiohttp import web
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-        from dragonfly2_tpu.pkg.piece import Range as _Range
         from tests.test_safetensors import make_safetensors
 
         rng_np = np.random.RandomState(41)
@@ -828,32 +775,8 @@ def test_download_global_sharded_arrays(run_async, tmp_path):
             "rep.b": rng_np.randn(128).astype(np.float32),
         }
         ckpt = make_safetensors(tensors, {k: "F32" for k in tensors})
-        stats = {"bytes": 0}
-
-        async def blob(request):
-            hdr = request.headers.get("Range")
-            if hdr:
-                r = _Range.parse_http(hdr, len(ckpt))
-                stats["bytes"] += r.length
-                return web.Response(
-                    status=206, body=ckpt[r.start:r.start + r.length],
-                    headers={"Content-Range":
-                             f"bytes {r.start}-{r.start + r.length - 1}"
-                             f"/{len(ckpt)}",
-                             "Accept-Ranges": "bytes"})
-            stats["bytes"] += len(ckpt)
-            return web.Response(body=ckpt,
-                                headers={"Accept-Ranges": "bytes"})
-
-        app = web.Application()
-        app.router.add_get("/g.safetensors", blob)
-        runner = web.AppRunner(app, access_log=None)
-        await runner.setup()
-        site = web.TCPSite(runner, "127.0.0.1", 0)
-        await site.start()
-        oport = site._server.sockets[0].getsockname()[1]
+        runner, url, stats = await start_content_origin(ckpt)
         sched = await start_scheduler()
-        url = f"http://127.0.0.1:{oport}/g.safetensors"
         daemons = []
         try:
             peer = await _start_sink_daemon(tmp_path, "glob", sched.port())
