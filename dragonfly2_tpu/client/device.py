@@ -145,30 +145,39 @@ async def download_to_device(daemon, url: str, *, digest: str = "",
 
 async def fetch_safetensors_header(daemon, url: str, *, tag: str = "",
                                    application: str = "",
-                                   header: dict | None = None):
-    """The checkpoint's parsed safetensors header via two tiny ranged
-    pulls through the fabric (8-byte length prefix, then exactly the
-    header). Both are ordinary ranged tasks, so a 256-host pod fetching
-    the same header costs ~one origin touch. Returns (header_dict,
-    data_start_abs)."""
+                                   header: dict | None = None,
+                                   prefix_guess: int = 256 << 10):
+    """The checkpoint's parsed safetensors header via ONE guessed-size
+    ranged pull (length prefix + header almost always fit in the guess;
+    a second exact pull covers the rare huge header). Ranged tasks are
+    byte-identical pod-wide, so a 256-host pod fetching the same header
+    costs ~one origin touch and ONE fabric round trip per host instead
+    of two. Returns (header_dict, data_start_abs)."""
     import numpy as np
 
     from dragonfly2_tpu.ops import safetensors as st
 
-    prefix = await download_to_device(
+    first = await download_to_device(
         daemon, url, tag=tag, application=application, header=header,
-        range_header="0-7")
-    n = int.from_bytes(np.asarray(prefix.as_bytes_array()).tobytes(),
-                       "little")
+        range_header=f"0-{prefix_guess - 1}")
+    got = np.asarray(first.as_bytes_array()).tobytes()
+    if len(got) < 8:
+        raise st.SafetensorsError(f"file shorter ({len(got)}B) than the "
+                                  "safetensors length prefix")
+    n = int.from_bytes(got[:8], "little")
     if n <= 0 or n > (1 << 27):
         raise st.SafetensorsError(f"implausible header length {n}")
-    head = await download_to_device(
-        daemon, url, tag=tag, application=application, header=header,
-        range_header=f"8-{8 + n - 1}")
-    head_bytes = np.asarray(head.as_bytes_array()).tobytes()
-    header_dict, _ = st.parse_header(
-        n.to_bytes(8, "little") + head_bytes)
-    return header_dict, 8 + n
+    prefix_u8 = first.as_bytes_array()
+    if 8 + n > len(got):
+        rest = await download_to_device(
+            daemon, url, tag=tag, application=application, header=header,
+            range_header=f"{len(got)}-{8 + n - 1}")
+        got += np.asarray(rest.as_bytes_array()).tobytes()
+    header_dict, _ = st.parse_header(got[:8 + n])
+    # The guess surplus beyond the header is REAL tensor data already in
+    # HBM: callers carve spans inside it instead of re-pulling (see
+    # download_sharded/download_global).
+    return header_dict, 8 + n, prefix_u8
 
 
 async def _pull_ranges(daemon, url: str, ranges, *, tag: str = "",
@@ -247,8 +256,9 @@ async def download_sharded(daemon, url: str, *,
     """
     from dragonfly2_tpu.ops import safetensors as st
 
-    header_dict, data_start = await fetch_safetensors_header(
+    header_dict, data_start, prefix_u8 = await fetch_safetensors_header(
         daemon, url, tag=tag, application=application, header=header)
+    plen = int(prefix_u8.shape[0])
 
     picked: list[tuple[int, int, str]] = []
     for name, meta in header_dict.items():
@@ -301,19 +311,25 @@ async def download_sharded(daemon, url: str, *,
 
     # Independent spans pull concurrently (scattered shards — e.g. MoE
     # expert weights — are max-of-spans, not sum-of-spans), bounded by
-    # the daemon's shared sink admission inside _pull_ranges.
-    landed = await _pull_ranges(daemon, url, [(s, e) for s, e, _ in spans],
+    # the daemon's shared sink admission inside _pull_ranges. Spans that
+    # the header-guess landing already covers carve from it for free.
+    landed = await _pull_ranges(daemon, url,
+                                [(s, e) for s, e, _ in spans if e > plen],
                                 tag=tag, application=application,
                                 header=header)
+    coverage = list(landed.items())
+    if plen:
+        coverage.append(((0, plen), prefix_u8))
     for start, end, span_names in spans:
-        u8 = landed[(start, end)]
+        u8, base = next((u, c0) for (c0, c1), u in coverage
+                        if c0 <= start and end <= c1)
         # Rebase the span's tensors onto the slice: tensor_views validates
         # and bitcasts exactly as for a full-content landing.
         sub_header = {
             n: {**header_dict[n],
                 "data_offsets": [
-                    data_start + header_dict[n]["data_offsets"][0] - start,
-                    data_start + header_dict[n]["data_offsets"][1] - start]}
+                    data_start + header_dict[n]["data_offsets"][0] - base,
+                    data_start + header_dict[n]["data_offsets"][1] - base]}
             for n in span_names}
         out.update(st.tensor_views(u8, sub_header, 0, span_names))
     if shardings:  # unknown names already rejected above, pre-download
@@ -352,8 +368,9 @@ async def download_global(daemon, url: str,
 
     from dragonfly2_tpu.ops import safetensors as st
 
-    header_dict, data_start = await fetch_safetensors_header(
+    header_dict, data_start, prefix_u8 = await fetch_safetensors_header(
         daemon, url, tag=tag, application=application, header=header)
+    plen = int(prefix_u8.shape[0])
 
     missing = [n for n in shardings if n not in header_dict]
     if missing:
@@ -414,12 +431,17 @@ async def download_global(daemon, url: str,
         else:
             merged.append([s0, s1])
 
-    landed = await _pull_ranges(daemon, url, [tuple(m) for m in merged],
+    # Ranges the header-guess landing already covers carve from it free.
+    pull_list = [tuple(m) for m in merged if m[1] > plen]
+    landed = await _pull_ranges(daemon, url, pull_list,
                                 tag=tag, application=application,
                                 header=header)
+    if plen:
+        landed[(0, plen)] = prefix_u8
+    coverage = pull_list + ([(0, plen)] if plen else [])
 
     def super_range(a: int, b: int) -> tuple[int, int]:
-        for s0, s1 in merged:
+        for s0, s1 in coverage:
             if s0 <= a and b <= s1:
                 return (s0, s1)
         raise st.SafetensorsError("internal: span not covered")  # pragma: no cover

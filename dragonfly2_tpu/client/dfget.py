@@ -92,6 +92,19 @@ async def _daemon_download(cfg: DfgetConfig, on_progress) -> dict:
 
 async def _download_from_source(cfg: DfgetConfig) -> dict:
     """Daemon-less direct fetch (reference dfget.go:141 downloadFromSource)."""
+    from dragonfly2_tpu.source.client import default_registry
+
+    # Hold the process-global registry for the stream's lifetime: an
+    # embedded daemon stopping concurrently must not close the shared
+    # session under this in-flight direct fetch.
+    registry = default_registry().retain()
+    try:
+        return await _download_from_source_inner(cfg)
+    finally:
+        await registry.release()
+
+
+async def _download_from_source_inner(cfg: DfgetConfig) -> dict:
     from dragonfly2_tpu.source import Request as SourceRequest
     from dragonfly2_tpu.source import get_client
 

@@ -252,13 +252,6 @@ class Daemon:
 
     async def start(self) -> None:
         """Bring every service up (non-blocking)."""
-        # Hold the process-global source registry for this daemon's
-        # lifetime: the LAST in-process daemon to stop closes the pooled
-        # origin sessions (shutdown hygiene without breaking siblings'
-        # in-flight streams).
-        from dragonfly2_tpu.source.client import default_registry
-
-        self._source_registry = default_registry().retain()
         # Warm the native data-plane probe off-loop: a cold first import
         # compiles the C++ library (seconds of g++), which must not freeze
         # the event loop at the first piece write on the hot path.
@@ -314,6 +307,13 @@ class Daemon:
             )
             await self.announcer.start()
         self.gc.serve()
+        # LAST step — nothing fallible may follow: a failed start would
+        # leak the refcount and permanently disable the process's
+        # shutdown hygiene. The last in-process daemon to stop closes
+        # the shared pooled origin sessions.
+        from dragonfly2_tpu.source.client import default_registry
+
+        self._source_registry = default_registry().retain()
         log.info(
             "daemon up",
             sock=self.config.unix_sock,

@@ -102,9 +102,10 @@ async def fabric_global_load(content: bytes, ref, mesh) -> None:
         rng = request.headers.get("Range")
         if rng:
             r = Range.parse_http(rng, len(content))
-            served["bytes"] += r.length
+            data = content[r.start:r.start + r.length]
+            served["bytes"] += len(data)   # count SERVED, not requested
             return web.Response(
-                status=206, body=content[r.start:r.start + r.length],
+                status=206, body=data,
                 headers={"Content-Range":
                          f"bytes {r.start}-{r.start + r.length - 1}"
                          f"/{len(content)}",
