@@ -152,7 +152,9 @@ async def fetch_safetensors_header(daemon, url: str, *, tag: str = "",
     a second exact pull covers the rare huge header). Ranged tasks are
     byte-identical pod-wide, so a 256-host pod fetching the same header
     costs ~one origin touch and ONE fabric round trip per host instead
-    of two. Returns (header_dict, data_start_abs)."""
+    of two. Returns ``(header_dict, data_start_abs, prefix_u8)`` —
+    the landed guess bytes, whose surplus beyond the header is real
+    tensor data callers carve spans from."""
     import numpy as np
 
     from dragonfly2_tpu.ops import safetensors as st
@@ -232,7 +234,8 @@ async def download_sharded(daemon, url: str, *,
                            shardings: dict | None = None,
                            tag: str = "", application: str = "",
                            header: dict | None = None,
-                           coalesce_gap: int = 4 << 20):
+                           coalesce_gap: int = 4 << 20,
+                           prefix_guess: int = 256 << 10):
     """Pull ONLY this host's tensors of a safetensors checkpoint through
     the fabric, landing straight in HBM: the sharded-pod pattern where a
     host needs its pipeline stage / expert shard, not all 140 GB.
@@ -257,7 +260,8 @@ async def download_sharded(daemon, url: str, *,
     from dragonfly2_tpu.ops import safetensors as st
 
     header_dict, data_start, prefix_u8 = await fetch_safetensors_header(
-        daemon, url, tag=tag, application=application, header=header)
+        daemon, url, tag=tag, application=application, header=header,
+        prefix_guess=prefix_guess)
     plen = int(prefix_u8.shape[0])
 
     picked: list[tuple[int, int, str]] = []
@@ -343,7 +347,8 @@ async def download_sharded(daemon, url: str, *,
 async def download_global(daemon, url: str,
                           shardings: dict, *,
                           tag: str = "", application: str = "",
-                          header: dict | None = None):
+                          header: dict | None = None,
+                          prefix_guess: int = 256 << 10):
     """Global sharded checkpoint load through the fabric: for each tensor,
     pull ONLY the byte ranges this process's devices actually hold under
     its jax Sharding, land them as ranged device tasks, and assemble true
@@ -369,7 +374,8 @@ async def download_global(daemon, url: str,
     from dragonfly2_tpu.ops import safetensors as st
 
     header_dict, data_start, prefix_u8 = await fetch_safetensors_header(
-        daemon, url, tag=tag, application=application, header=header)
+        daemon, url, tag=tag, application=application, header=header,
+        prefix_guess=prefix_guess)
     plen = int(prefix_u8.shape[0])
 
     missing = [n for n in shardings if n not in header_dict]

@@ -252,6 +252,22 @@ class Daemon:
 
     async def start(self) -> None:
         """Bring every service up (non-blocking)."""
+        # Retain FIRST: services go live mid-start, and a sibling
+        # daemon's stop() must not close the shared origin sessions under
+        # a request that raced in. A failed start releases in the
+        # except — both hygiene properties hold.
+        from dragonfly2_tpu.source.client import default_registry
+
+        self._source_registry = default_registry().retain()
+        try:
+            await self._start_inner()
+        except BaseException:
+            registry, self._source_registry = self._source_registry, None
+            if registry is not None:
+                await registry.release()
+            raise
+
+    async def _start_inner(self) -> None:
         # Warm the native data-plane probe off-loop: a cold first import
         # compiles the C++ library (seconds of g++), which must not freeze
         # the event loop at the first piece write on the hot path.
@@ -307,13 +323,6 @@ class Daemon:
             )
             await self.announcer.start()
         self.gc.serve()
-        # LAST step — nothing fallible may follow: a failed start would
-        # leak the refcount and permanently disable the process's
-        # shutdown hygiene. The last in-process daemon to stop closes
-        # the shared pooled origin sessions.
-        from dragonfly2_tpu.source.client import default_registry
-
-        self._source_registry = default_registry().retain()
         log.info(
             "daemon up",
             sock=self.config.unix_sock,

@@ -1,0 +1,47 @@
+"""Shared in-process test/bench fixtures.
+
+Shipping these in the package (not under tests/) lets benches and
+examples reuse them without cross-importing test modules — and keeps ONE
+copy of the ranged-origin HTTP handler, whose 206/Content-Range
+semantics have already needed coordinated fixes across private copies
+twice (served-vs-requested byte counting, clamped Content-Range ends).
+"""
+
+from __future__ import annotations
+
+
+async def start_range_origin(content: bytes):
+    """An aiohttp origin serving ``content`` with single-range 206
+    support and served-byte accounting. Returns ``(runner, url, stats)``
+    — ``await runner.cleanup()`` when done; ``stats["bytes"]`` counts
+    bytes actually served (ranges clamped to the content)."""
+    from aiohttp import web
+
+    from dragonfly2_tpu.pkg.piece import Range
+
+    stats = {"bytes": 0, "streams": 0}
+
+    async def blob(request):
+        stats["streams"] += 1
+        hdr = request.headers.get("Range")
+        if hdr:
+            r = Range.parse_http(hdr, len(content))
+            data = content[r.start:r.start + r.length]
+            stats["bytes"] += len(data)
+            return web.Response(status=206, body=data, headers={
+                "Content-Range":
+                    f"bytes {r.start}-{r.start + len(data) - 1}"
+                    f"/{len(content)}",
+                "Accept-Ranges": "bytes"})
+        stats["bytes"] += len(content)
+        return web.Response(body=content,
+                            headers={"Accept-Ranges": "bytes"})
+
+    app = web.Application()
+    app.router.add_get("/content", blob)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}/content", stats

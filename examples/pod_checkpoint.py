@@ -87,40 +87,14 @@ async def fabric_global_load(content: bytes, ref, mesh) -> None:
     import socket
     import tempfile
 
-    from aiohttp import web
-
     from dragonfly2_tpu.client import device as device_lib
     from dragonfly2_tpu.daemon.config import DaemonConfig
     from dragonfly2_tpu.daemon.daemon import Daemon
-    from dragonfly2_tpu.pkg.piece import Range
+    from dragonfly2_tpu.pkg.testing import start_range_origin
     from dragonfly2_tpu.scheduler.config import SchedulerConfig
     from dragonfly2_tpu.scheduler.server import SchedulerServer
 
-    served = {"bytes": 0}
-
-    async def blob(request):
-        rng = request.headers.get("Range")
-        if rng:
-            r = Range.parse_http(rng, len(content))
-            data = content[r.start:r.start + r.length]
-            served["bytes"] += len(data)   # count SERVED, not requested
-            return web.Response(
-                status=206, body=data,
-                headers={"Content-Range":
-                         f"bytes {r.start}-{r.start + r.length - 1}"
-                         f"/{len(content)}",
-                         "Accept-Ranges": "bytes"})
-        served["bytes"] += len(content)
-        return web.Response(body=content,
-                            headers={"Accept-Ranges": "bytes"})
-
-    app = web.Application()
-    app.router.add_get("/ckpt.safetensors", blob)
-    runner = web.AppRunner(app, access_log=None)
-    await runner.setup()
-    site = web.TCPSite(runner, "127.0.0.1", 0)
-    await site.start()
-    oport = site._server.sockets[0].getsockname()[1]
+    runner, url, served = await start_range_origin(content)
 
     scfg = SchedulerConfig()
     scfg.server.port = 0
@@ -139,7 +113,7 @@ async def fabric_global_load(content: bytes, ref, mesh) -> None:
     await daemon.start()
     try:
         params = await device_lib.download_global(
-            daemon, f"http://127.0.0.1:{oport}/ckpt.safetensors",
+            daemon, url,
             {"w2": NamedSharding(mesh, P("tp", None))})
         np.testing.assert_array_equal(np.asarray(params["w2"]), ref["w2"])
         print(f"download_global: w2 pulled as per-device row ranges "

@@ -40,39 +40,7 @@ async def _start_sink_daemon(tmp_path, name, scheduler_port, *, seed=False,
     await d.start()
     return d
 
-async def start_content_origin(content: bytes):
-    """One ranged origin for every sharded/global test: serves ``content``
-    with 206 Range support and counts served bytes. Returns
-    (runner, url, stats). The single copy — range semantics fixes must
-    not need five edits."""
-    from aiohttp import web
-
-    from dragonfly2_tpu.pkg.piece import Range as _Range
-
-    stats = {"bytes": 0}
-
-    async def blob(request):
-        hdr = request.headers.get("Range")
-        if hdr:
-            r = _Range.parse_http(hdr, len(content))
-            data = content[r.start:r.start + r.length]
-            stats["bytes"] += len(data)
-            return web.Response(status=206, body=data, headers={
-                "Content-Range":
-                    f"bytes {r.start}-{r.start + r.length - 1}/{len(content)}",
-                "Accept-Ranges": "bytes"})
-        stats["bytes"] += len(content)
-        return web.Response(body=content,
-                            headers={"Accept-Ranges": "bytes"})
-
-    app = web.Application()
-    app.router.add_get("/content", blob)
-    runner = web.AppRunner(app, access_log=None)
-    await runner.setup()
-    site = web.TCPSite(runner, "127.0.0.1", 0)
-    await site.start()
-    port = site._server.sockets[0].getsockname()[1]
-    return runner, f"http://127.0.0.1:{port}/content", stats
+from dragonfly2_tpu.pkg.testing import start_range_origin as start_content_origin  # noqa: E501 - one shared ranged origin
 
 
 
@@ -876,7 +844,11 @@ def test_download_global_2d_mesh(run_async, tmp_path):
 
             mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "tp"))
             sharding = NamedSharding(mesh, P("tp", None))
-            got = await device_lib.download_global(peer, url, {"w": sharding})
+            # Tiny prefix guess: forces the REAL ranged-pull/coalesce/
+            # super_range path (a 256K guess would swallow this file and
+            # leave download_global's pull machinery untested).
+            got = await device_lib.download_global(peer, url, {"w": sharding},
+                                                   prefix_guess=1024)
             arr = got["w"]
             assert arr.shape == (64, 16)
             np.testing.assert_array_equal(np.asarray(arr), tensors["w"])
