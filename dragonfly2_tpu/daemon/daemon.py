@@ -194,6 +194,9 @@ class Daemon:
             limiter=limiter if limiter is not None else self.task_manager.limiter,
             on_piece=on_piece,
             disable_back_source=disable_back_source,
+            local_range_source=(
+                lambda s, cb, _req=request:
+                self.task_manager.import_range_from_local_parent(s, _req, cb)),
         )
 
     async def _resolve_schedulers_from_manager(self) -> None:

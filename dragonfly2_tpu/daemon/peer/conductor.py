@@ -57,6 +57,7 @@ class PeerTaskConductor:
         limiter: Limiter | None = None,
         on_piece=None,
         disable_back_source: bool = False,
+        local_range_source=None,
     ):
         self.task_id = task_id
         self.peer_id = peer_id
@@ -71,6 +72,11 @@ class PeerTaskConductor:
         self.limiter = limiter or Limiter()
         self.on_piece = on_piece
         self.disable_back_source = disable_back_source
+        # async (store, on_piece) -> bool: fill a ranged store from a
+        # LOCAL covering parent task instead of origin (task_manager
+        # import_range_from_local_parent) — the warm-seed path for
+        # scheduler-triggered ranged seeds.
+        self.local_range_source = local_range_source
         # Ranged task (task id encodes the range): the content of THIS task
         # is the slice, and a back-source demotion must fetch exactly it —
         # dropping the range here once fetched (and emitted) the whole
@@ -274,15 +280,6 @@ class PeerTaskConductor:
             })
             return
 
-        if self.disable_back_source:
-            # dfget --disable-back-source / dfcache export: origin is off
-            # the table, fail instead (reference peertask_conductor
-            # needBackSource vs disableBackSource handling).
-            raise DfError(Code.ClientBackSourceError,
-                          "scheduler demanded back-to-source but it is disabled")
-
-        BACK_SOURCE_COUNT.inc()
-        log.info("back-to-source", task=self.task_id[:16], seed=self.is_seed)
         started_sent = False
 
         async def on_piece(store: LocalTaskStore, rec) -> None:
@@ -299,16 +296,37 @@ class PeerTaskConductor:
             if self.on_piece is not None:
                 await self.on_piece(store, rec)
 
-        if LocalTaskStore.completion_digest_applies(
-                self.meta.get("digest", ""), self.content_range is not None):
-            # Self-computed pieces are never certifiable: the completion
-            # re-hash is certain, so overlap it with the transfer.
-            self.store.start_prefix_hasher(self.meta.get("digest", ""))
-        await self.piece_manager.download_source(
-            self.store, self.url, self.meta.get("header") or {},
-            content_range=self.content_range,
-            on_piece=on_piece, limiter=self.limiter,
-        )
+        # A ranged slice a LOCAL parent store covers imports warm — the
+        # scheduler-triggered ranged seed on a preheated host never
+        # re-touches origin. This is not a back-source: it runs BEFORE
+        # the disable gate (origin stays off the table) and is neither
+        # counted nor logged as one.
+        imported = (self.content_range is not None
+                    and self.local_range_source is not None
+                    and await self.local_range_source(self.store, on_piece))
+        if not imported:
+            if self.disable_back_source:
+                # dfget --disable-back-source / dfcache export: origin is
+                # off the table, fail instead (reference
+                # peertask_conductor needBackSource vs disableBackSource).
+                raise DfError(Code.ClientBackSourceError,
+                              "scheduler demanded back-to-source but it "
+                              "is disabled")
+            BACK_SOURCE_COUNT.inc()
+            log.info("back-to-source", task=self.task_id[:16],
+                     seed=self.is_seed)
+            if LocalTaskStore.completion_digest_applies(
+                    self.meta.get("digest", ""),
+                    self.content_range is not None):
+                # Self-computed pieces are never certifiable: the
+                # completion re-hash is certain; overlap it with the
+                # transfer.
+                self.store.start_prefix_hasher(self.meta.get("digest", ""))
+            await self.piece_manager.download_source(
+                self.store, self.url, self.meta.get("header") or {},
+                content_range=self.content_range,
+                on_piece=on_piece, limiter=self.limiter,
+            )
         await self._safe_send({
             "type": "download_finished",
             "content_length": self.store.metadata.content_length,

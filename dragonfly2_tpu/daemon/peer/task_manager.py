@@ -15,8 +15,12 @@ from typing import AsyncIterator
 from dragonfly2_tpu.daemon.peer.broker import PieceBroker, PieceEvent
 from dragonfly2_tpu.daemon.peer.piece_manager import PieceManager
 from dragonfly2_tpu.pkg import aio, dflog, idgen, metrics
-from dragonfly2_tpu.pkg.errors import Code, DfError, describe
-from dragonfly2_tpu.pkg.piece import Range
+from dragonfly2_tpu.pkg.errors import Code, DfError, StorageError, describe
+from dragonfly2_tpu.pkg.piece import (
+    Range,
+    compute_piece_count,
+    compute_piece_size,
+)
 from dragonfly2_tpu.pkg.ratelimit import Limiter
 from dragonfly2_tpu.proto.common import UrlMeta
 from dragonfly2_tpu.storage import (
@@ -230,6 +234,13 @@ class TaskManager:
                         raise
                     log.warning("pex download failed, falling back to source",
                                 task_id=task_id[:16], error=str(e))
+            # A ranged task whose slice a LOCAL parent store already
+            # covers imports it without touching origin (not a
+            # back-source at all — allowed even when origin is off
+            # the table).
+            if await self.import_range_from_local_parent(store, req,
+                                                         on_piece):
+                return False
             if req.disable_back_source:
                 raise DfError(Code.ClientBackSourceError,
                               "no scheduler and back-to-source disabled")
@@ -459,18 +470,12 @@ class TaskManager:
         # fresh ranged task (below) lands into the sink; the local parent
         # keeps serving its pieces to other peers either way.
         if req.meta.range and req.device != "tpu":
-            parent_id = req.parent_task_id()
-            parent = (self.storage.find_completed_task(parent_id)
-                      or self.storage.find_partial_completed_task(parent_id))
-            rng = None
-            if parent is not None and parent.metadata.piece_size > 0:
-                rng = Range.parse_http(req.meta.range,
-                                       parent.metadata.content_length)
-            if (rng is not None and rng.length > 0
-                    and parent.covers_range(rng.start, rng.length)):
+            covering = self._covering_local_parent(req)
+            if covering is not None:
+                parent, rng = covering
                 log.info("reusing ranged slice from parent task",
-                         parent=parent_id[:16], start=rng.start,
-                         length=rng.length)
+                         parent=parent.metadata.task_id[:16],
+                         start=rng.start, length=rng.length)
                 with parent:
                     await asyncio.to_thread(parent.export_range, req.output,
                                             rng.start, rng.length)
@@ -482,7 +487,7 @@ class TaskManager:
             # Miss: the ranged task downloads just its delta below; with
             # prefetch on, the whole task starts in the background so the
             # next overlapping range hits the parent store.
-            self._maybe_prefetch(parent_id, req)
+            self._maybe_prefetch(req.parent_task_id(), req)
 
         # 2. Dedup: piggyback on a running conductor for the same task
         # (reference getOrCreatePeerTaskConductor :201).
@@ -962,6 +967,73 @@ class TaskManager:
         resident sink could otherwise shadow a later retry's bytes."""
         if req.device and self.device_sinks is not None:
             self.device_sinks.discard(task_id)
+
+    def _covering_local_parent(self, req):
+        """(parent_store, resolved_range) when a LOCAL completed/partial
+        parent task covers ``req``'s range, else None. The ONE
+        parent-coverage gate — the ranged-reuse export (step 1b) and the
+        ranged import share it, so their eligibility can never fork."""
+        if not req.meta.range:
+            return None
+        parent_id = req.parent_task_id()
+        parent = (self.storage.find_completed_task(parent_id)
+                  or self.storage.find_partial_completed_task(parent_id))
+        if parent is None or parent.metadata.piece_size <= 0:
+            return None
+        try:
+            rng = Range.parse_http(req.meta.range,
+                                   parent.metadata.content_length)
+        except ValueError:
+            return None
+        if (rng is None or rng.length <= 0
+                or not parent.covers_range(rng.start, rng.length)):
+            return None
+        return parent, rng
+
+    async def import_range_from_local_parent(self, store, req, on_piece) -> bool:
+        """Ranged back-source shortcut: when THIS daemon already holds a
+        whole-content (or covering partial) parent task, the slice
+        imports from the local store instead of touching origin.
+
+        This is what makes plain whole-file preheats compose with
+        sharded pulls: a ranged task is a distinct task id, so without
+        this every span the scheduler triggers on a warm seed would
+        re-fetch from origin despite the seed holding every byte.
+        Imported pieces flow through ``on_piece`` like downloaded ones
+        (piece reports, device-sink landings, progress). Returns True
+        when the ranged store completed from the parent; any import
+        failure (e.g. a parent truncated under its metadata) returns
+        False so the caller falls back to origin — the pre-feature
+        recovery path must survive the optimization."""
+        covering = self._covering_local_parent(req)
+        if covering is None:
+            return False
+        parent, rng = covering
+        piece_size = store.metadata.piece_size or compute_piece_size(rng.length)
+        store.update_task(content_length=rng.length, piece_size=piece_size,
+                          total_piece_count=compute_piece_count(
+                              rng.length, piece_size))
+        log.info("ranged task imports from local parent",
+                 task=store.metadata.task_id[:16],
+                 parent=parent.metadata.task_id[:16],
+                 start=rng.start, length=rng.length)
+        try:
+            with parent:  # pin: GC must not reclaim the parent mid-import
+                for n in range(store.metadata.total_piece_count):
+                    if n in store.metadata.pieces:
+                        continue   # resume semantics match back-source
+                    off = n * piece_size
+                    size = min(piece_size, rng.length - off)
+                    data = await asyncio.to_thread(
+                        parent.read_range, rng.start + off, size)
+                    rec = await asyncio.to_thread(store.write_piece, n, data)
+                    if on_piece is not None:
+                        await on_piece(store, rec)
+        except (StorageError, OSError) as e:
+            log.warning("local range import failed; falling back to origin",
+                        task=store.metadata.task_id[:16], error=str(e)[:200])
+            return False
+        return store.is_complete()
 
     async def _finalize_content_digest(self, req: "FileTaskRequest",
                                        store) -> None:

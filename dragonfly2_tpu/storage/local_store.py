@@ -565,10 +565,6 @@ class LocalTaskStore:
         self.touch()
         return out
 
-    def read_range(self, offset: int, size: int) -> bytes:
-        fd = self._ensure_fd()
-        return os.pread(fd, size, offset)
-
     def get_pieces(self, start_num: int = 0, limit: int = 0) -> list[PieceRecord]:
         """Contiguous-known pieces from start_num (upload-server listing —
         reference local_storage.go:434 GetPieces)."""
@@ -721,6 +717,22 @@ class LocalTaskStore:
         last = (start + length - 1) // m.piece_size
         with self._meta_lock:  # writers mutate from worker threads
             return all(n in m.pieces for n in range(first, last + 1))
+
+    def read_range(self, start: int, length: int) -> bytes:
+        """Bytes ``[start, start+length)`` — caller must have checked
+        ``covers_range`` first (pieces sit at ``num * piece_size``, so
+        covered bytes are literally contiguous in the data file)."""
+        fd = self._ensure_fd()
+        out = []
+        remaining, off = length, start
+        while remaining > 0:
+            chunk = os.pread(fd, min(remaining, 4 << 20), off)
+            if not chunk:
+                raise StorageError(f"short read at offset {off}")
+            out.append(chunk)
+            off += len(chunk)
+            remaining -= len(chunk)
+        return b"".join(out)
 
     def export_range(self, dest: str, start: int, length: int) -> None:
         """Write the byte range [start, start+length) to ``dest`` from the

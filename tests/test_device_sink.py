@@ -862,3 +862,93 @@ def test_download_global_2d_mesh(run_async, tmp_path):
             await runner.cleanup()
 
     run_async(body(), timeout=120)
+
+
+def test_warm_seed_serves_ranged_tasks_without_origin(run_async, tmp_path):
+    """THE production composition: a plain whole-file preheat on the seed,
+    then a peer's ranged device pull — the scheduler-triggered ranged
+    seed imports the slice from its LOCAL warm store, so origin traffic
+    does not grow at all after the preheat."""
+
+    async def body():
+        from tests.test_safetensors import make_safetensors
+
+        rng_np = np.random.RandomState(61)
+        tensors = {"stage0.w": rng_np.randn(512, 512).astype(np.float32),
+                   "stage1.w": rng_np.randn(512, 512).astype(np.float32)}
+        ckpt = make_safetensors(tensors, {k: "F32" for k in tensors})
+        runner, url, stats = await start_content_origin(ckpt)
+        sched = await start_scheduler()
+        daemons = []
+        try:
+            seed = await e2e.start_daemon(tmp_path, "wseed", sched.port(),
+                                          seed=True)
+            peer = await _start_sink_daemon(tmp_path, "wpeer", sched.port())
+            daemons += [seed, peer]
+
+            # Preheat: the seed holds the WHOLE checkpoint warm.
+            await dfget_lib.download(dfget_lib.DfgetConfig(
+                url=url, output=str(tmp_path / "warm.bin"),
+                daemon_sock=seed.config.unix_sock,
+                allow_source_fallback=False, timeout=60.0))
+            after_preheat = stats["bytes"]
+            assert after_preheat >= len(ckpt) - 8
+
+            # Sharded pull from the peer: every ranged task the scheduler
+            # seeds must import from the warm store, NOT origin.
+            got = await device_lib.download_sharded(
+                peer, url, names=["stage1.w"], prefix_guess=1024)
+            np.testing.assert_array_equal(
+                np.asarray(got["stage1.w"]), tensors["stage1.w"])
+            assert stats["bytes"] == after_preheat, (
+                "warm seed must serve ranged tasks without origin; "
+                f"origin grew by {stats['bytes'] - after_preheat} bytes")
+        finally:
+            for d in daemons:
+                await d.stop()
+            await sched.stop()
+            await origin_cleanup(runner)
+
+    async def origin_cleanup(runner):
+        await runner.cleanup()
+
+    run_async(body(), timeout=180)
+
+
+def test_ranged_import_from_local_parent_schedulerless(run_async, tmp_path):
+    """Schedulerless daemon with a warm whole-file task: a ranged request
+    imports from the local parent even with back-source disabled (a
+    local import is not a back-source)."""
+
+    async def body():
+        from dragonfly2_tpu.client import dfget as dfget_local
+        from dragonfly2_tpu.daemon.daemon import Daemon
+
+        content = bytes(random.Random(71).randbytes(3 * 1024 * 1024 + 77))
+        runner, url, stats = await start_content_origin(content)
+        cfg = daemon_config(tmp_path, "lonely", 0)
+        cfg.scheduler.addrs = []        # schedulerless
+        d = Daemon(cfg)
+        await d.start()
+        try:
+            await dfget_local.download(dfget_local.DfgetConfig(
+                url=url, output=str(tmp_path / "full.bin"),
+                daemon_sock=d.config.unix_sock,
+                allow_source_fallback=False, timeout=60.0))
+            warm = stats["bytes"]
+
+            r = await dfget_local.download(dfget_local.DfgetConfig(
+                url=url, output=str(tmp_path / "slice.bin"),
+                daemon_sock=d.config.unix_sock,
+                meta=UrlMeta(range="bytes=4096-1052671"),
+                disable_back_source=True,
+                allow_source_fallback=False, timeout=60.0))
+            assert r["state"] == "done"
+            assert ((tmp_path / "slice.bin").read_bytes()
+                    == content[4096:1052672])
+            assert stats["bytes"] == warm, "local import must not hit origin"
+        finally:
+            await d.stop()
+            await runner.cleanup()
+
+    run_async(body(), timeout=120)
