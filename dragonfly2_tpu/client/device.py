@@ -317,6 +317,8 @@ async def download_sharded(daemon, url: str, *,
     # expert weights — are max-of-spans, not sum-of-spans), bounded by
     # the daemon's shared sink admission inside _pull_ranges. Spans that
     # the header-guess landing already covers carve from it for free.
+    # (A span straddling plen re-pulls its prefix-covered head — bounded
+    # by prefix_guess per span; splitting would need two-source carves.)
     landed = await _pull_ranges(daemon, url,
                                 [(s, e) for s, e, _ in spans if e > plen],
                                 tag=tag, application=application,
@@ -402,6 +404,13 @@ async def download_global(daemon, url: str,
         itemsize = nbytes // max(1, count)
         row_bytes = (int(np.prod(shape[1:])) if len(shape) > 1 else 1) * itemsize
         idx_map = sharding.devices_indices_map(shape)
+        if not sharding.addressable_devices:
+            # A sub-mesh of other hosts' devices: assembly below would
+            # KeyError; fail with the tensor named like every other
+            # malformed-input path here.
+            raise st.SafetensorsError(
+                f"{name}: sharding has no addressable devices in this "
+                "process")
         for dev in sharding.addressable_devices:
             idx = idx_map[dev]
 

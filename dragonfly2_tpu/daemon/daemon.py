@@ -368,7 +368,7 @@ class Daemon:
         registry = getattr(self, "_source_registry", None)
         if registry is not None:
             self._source_registry = None
-            await registry.release()
+            await registry.release(close_when_idle=True)
         self.storage.close()
         self._stopped.set()
 

@@ -603,16 +603,24 @@ class TaskManager:
     async def start_seed_task(self, spec: dict) -> None:
         """Seed this daemon with a task (scheduler trigger). Runs inline;
         callers fire it as a background task."""
+        try:
+            # Canonical form before ANYTHING hashes it: a raw trigger span
+            # ('0-7') must land under the same task id as client pulls of
+            # 'bytes=0-7' or the warmed store never dedups. Defensive even
+            # though the RPC chokepoint validates: this runs in a spawned
+            # task where an escape would be an unretrieved exception.
+            norm_range = Range.normalize_header(spec.get("range", ""))
+        except ValueError as e:
+            log.warning("seed trigger with malformed range dropped",
+                        range=str(spec.get("range"))[:64], error=str(e)[:100])
+            return
         meta = UrlMeta(
             digest=spec.get("digest", ""),
             tag=spec.get("tag", ""),
             application=spec.get("application", ""),
             header=spec.get("header") or {},
             filter="&".join(spec.get("filters") or []),
-            # Canonical form before ANYTHING hashes it: a raw trigger span
-            # ('0-7') must land under the same task id as client pulls of
-            # 'bytes=0-7' or the warmed store never dedups.
-            range=Range.normalize_header(spec.get("range", "")),
+            range=norm_range,
         )
         # seed=False: run as a normal peer (persistent-cache replication —
         # the scheduler wants this host to PULL from peers, not re-seed from
@@ -980,15 +988,25 @@ class TaskManager:
                   or self.storage.find_partial_completed_task(parent_id))
         if parent is None or parent.metadata.piece_size <= 0:
             return None
+        total = parent.metadata.content_length
         try:
-            rng = Range.parse_http(req.meta.range,
-                                   parent.metadata.content_length)
+            rng = Range.parse_http(req.meta.range, total)
         except ValueError:
             return None
-        if (rng is None or rng.length <= 0
-                or not parent.covers_range(rng.start, rng.length)):
+        if rng is None:
             return None
-        return parent, rng
+        # Clamp EOF-overshooting spans exactly like download_source does
+        # before fetching: origin clamps 'bytes=0-262143' on a 100 KiB
+        # object, so the warm local parent must serve the same clamped
+        # slice — otherwise every overshooting range (the header guess on
+        # a small checkpoint, a generous user range) skips the warm store
+        # and re-touches origin.
+        length = rng.length
+        if total >= 0:
+            length = min(length, max(0, total - rng.start))
+        if length <= 0 or not parent.covers_range(rng.start, length):
+            return None
+        return parent, Range(rng.start, length)
 
     async def import_range_from_local_parent(self, store, req, on_piece) -> bool:
         """Ranged back-source shortcut: when THIS daemon already holds a

@@ -229,6 +229,16 @@ class DaemonRpcServer:
         spec = body or {}
         if not spec.get("url"):
             raise DfError(Code.BadRequest, "url required")
+        if spec.get("range"):
+            # Validate BEFORE the ACK: a malformed span would otherwise
+            # kill the spawned seed task with an unretrieved ValueError
+            # while the triggering job burns its full wait timeout
+            # against a task that never existed.
+            try:
+                spec["range"] = Range.normalize_header(spec["range"])
+            except ValueError as e:
+                raise DfError(Code.BadRequest,
+                              f"bad range {spec.get('range')!r}: {e}")
         task_id = spec.get("task_id", "")
         already = bool(task_id and
                        self.task_manager.storage.find_completed_task(task_id) is not None)

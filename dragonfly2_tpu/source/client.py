@@ -119,18 +119,25 @@ class Registry:
     def __init__(self):
         self._clients: dict[str, ResourceClient] = {}
         self._retains = 0
+        self._close_when_idle = False
 
     def retain(self) -> "Registry":
-        """A long-lived user (an in-process daemon) takes a reference;
-        ``release`` closes pooled sessions only when the LAST user goes
-        away — closing earlier would kill in-flight origin streams of
-        the other daemons sharing this process-global registry."""
+        """Any user with in-flight streams takes a reference; pooled
+        sessions close only when the LAST user releases AND a closing
+        user (a stopping daemon, ``close_when_idle=True``) asked for
+        hygiene. A pure-CLI process (direct dfget fetches, recursive
+        directory pulls) never arms closing, so its pooled session
+        persists across sequential fetches instead of churning
+        TCP+TLS setup per file."""
         self._retains += 1
         return self
 
-    async def release(self) -> None:
+    async def release(self, *, close_when_idle: bool = False) -> None:
+        if close_when_idle:
+            self._close_when_idle = True
         self._retains = max(0, self._retains - 1)
-        if self._retains == 0:
+        if self._retains == 0 and getattr(self, "_close_when_idle", False):
+            self._close_when_idle = False
             await self.close_all()
 
     def register(self, scheme: str, client: ResourceClient) -> None:

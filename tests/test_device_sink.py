@@ -952,3 +952,48 @@ def test_ranged_import_from_local_parent_schedulerless(run_async, tmp_path):
             await runner.cleanup()
 
     run_async(body(), timeout=120)
+
+
+def test_warm_seed_serves_overshooting_ranges(run_async, tmp_path):
+    """A checkpoint SMALLER than the header guess: the guess range
+    overshoots EOF, origin clamps it — and so must the warm local
+    parent, or the preheat buys nothing exactly for small files
+    (the import gate must clamp like download_source does)."""
+
+    async def body():
+        from tests.test_safetensors import make_safetensors
+
+        rng_np = np.random.RandomState(81)
+        # ~40 KiB checkpoint — far under the 256 KiB header guess.
+        tensors = {"small.w": rng_np.randn(100, 100).astype(np.float32)}
+        ckpt = make_safetensors(tensors, {"small.w": "F32"})
+        assert len(ckpt) < (256 << 10)
+        runner, url, stats = await start_content_origin(ckpt)
+        sched = await start_scheduler()
+        daemons = []
+        try:
+            seed = await e2e.start_daemon(tmp_path, "sseed", sched.port(),
+                                          seed=True)
+            peer = await _start_sink_daemon(tmp_path, "speer", sched.port())
+            daemons += [seed, peer]
+
+            await dfget_lib.download(dfget_lib.DfgetConfig(
+                url=url, output=str(tmp_path / "w.bin"),
+                daemon_sock=seed.config.unix_sock,
+                allow_source_fallback=False, timeout=60.0))
+            warm = stats["bytes"]
+
+            got = await device_lib.download_sharded(
+                peer, url, names=["small.w"])   # default 256K guess
+            np.testing.assert_array_equal(
+                np.asarray(got["small.w"]), tensors["small.w"])
+            assert stats["bytes"] == warm, (
+                f"overshooting guess re-touched origin by "
+                f"{stats['bytes'] - warm} bytes")
+        finally:
+            for d in daemons:
+                await d.stop()
+            await sched.stop()
+            await runner.cleanup()
+
+    run_async(body(), timeout=120)
