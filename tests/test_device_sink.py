@@ -997,3 +997,45 @@ def test_warm_seed_serves_overshooting_ranges(run_async, tmp_path):
             await runner.cleanup()
 
     run_async(body(), timeout=120)
+
+
+def test_download_global_composes_with_ici_all_gather(run_async, tmp_path):
+    """The full TPU chain: fabric-loaded tp-sharded weight → ICI
+    all_gather plan → every device holds the replicated tensor, bit
+    exact. This is the load-then-redistribute step a training job runs
+    right after download_global."""
+
+    async def body():
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from dragonfly2_tpu.parallel.ici import all_gather_shards
+        from tests.test_safetensors import make_safetensors
+
+        rng_np = np.random.RandomState(91)
+        tensors = {"w": rng_np.randn(64, 16).astype(np.float32)}
+        ckpt = make_safetensors(tensors, {"w": "F32"})
+        runner, url, stats = await start_content_origin(ckpt)
+        sched = await start_scheduler()
+        daemons = []
+        try:
+            peer = await _start_sink_daemon(tmp_path, "ici", sched.port())
+            daemons.append(peer)
+
+            mesh = Mesh(np.array(jax.devices()), ("d",))
+            got = await device_lib.download_global(
+                peer, url, {"w": NamedSharding(mesh, P("d", None))},
+                prefix_guess=1024)
+            gathered = all_gather_shards(mesh, got["w"])
+            assert gathered.shape == (64, 16)
+            # Replicated: every device holds the whole tensor.
+            assert len(gathered.sharding.device_set) == len(jax.devices())
+            np.testing.assert_array_equal(np.asarray(gathered),
+                                          tensors["w"])
+        finally:
+            for d in daemons:
+                await d.stop()
+            await sched.stop()
+            await runner.cleanup()
+
+    run_async(body(), timeout=120)
