@@ -84,6 +84,9 @@ def _add_dfstore(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--device", default="", choices=["", "tpu"],
                    help="prefetch: additionally land the object in the "
                         "daemon's TPU HBM sink (north-star --device=tpu)")
+    p.add_argument("--range", dest="range_", default="",
+                   help="prefetch: warm only this byte span a-b "
+                        "(a ranged task; sharded warm-up)")
     p.add_argument("--timeout", type=float, default=None,
                    help="client timeout seconds (default 60; prefetch "
                         "defaults to 3600 — it blocks until the daemon "
@@ -139,8 +142,9 @@ def _run_dfstore(args: argparse.Namespace) -> int:
                 print("deleted")
             elif args.op == "prefetch":
                 bucket, key = _parse_df_url(a[0])
-                result = await store.prefetch_object(bucket, key,
-                                                     device=args.device)
+                result = await store.prefetch_object(
+                    bucket, key, device=args.device,
+                    range_header=args.range_)
                 print(json.dumps(result))
             elif args.op == "stat":
                 bucket, key = _parse_df_url(a[0])

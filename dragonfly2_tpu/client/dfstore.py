@@ -135,14 +135,20 @@ class Dfstore:
         await self.put_object(bucket, dst_key, data)
 
     async def prefetch_object(self, bucket: str, key: str,
-                              device: str = "") -> dict:
+                              device: str = "",
+                              range_header: str = "") -> dict:
         """Warm the daemon's stores with an object without downloading it
         here: piece store always, and with device="tpu" the daemon also
         lands verified pieces in its HBM sink (dfstore --device=tpu).
+        ``range_header`` ("a-b") warms just that span as a ranged task.
         Returns {state, task_id, content_length, device_verified, ...}."""
         url = (f"{self.endpoint}/buckets/{quote(bucket, safe='')}"
                f"/prefetch/{quote(key, safe='/')}")
-        params = {"device": device} if device else {}
+        params = {}
+        if device:
+            params["device"] = device
+        if range_header:
+            params["range"] = range_header
         async with self._http().post(url, params=params) as r:
             if r.status != 200:
                 raise DfstoreError(await r.text(), r.status)

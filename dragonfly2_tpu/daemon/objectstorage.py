@@ -157,18 +157,36 @@ class ObjectStorageService:
         back: piece store always; `?device=tpu` additionally lands verified
         pieces in the HBM sink (the north star's dfstore --device=tpu —
         a pod-wide webdataset/checkpoint warm-up never touches the client).
-        Same task identity as gateway GETs (url + tag=bucket), so later
-        GETs are warm hits."""
+        Whole-object prefetches share task identity with gateway GETs
+        (url + tag=bucket), so later GETs are warm hits. A `?range=a-b`
+        prefetch warms the RANGED task id instead: it dedups with
+        dfget/preheat/device pulls of the same canonical span (gateway
+        GETs always resolve the whole-object task, so they are warmed by
+        whole-object prefetches, not ranged ones)."""
         bucket, key = request.match_info["bucket"], request.match_info["key"]
         device = request.query.get("device", "")
         if device not in ("", "tpu"):
             raise web.HTTPBadRequest(text=f"unknown device {device!r}")
         from dragonfly2_tpu.daemon.peer.task_manager import FileTaskRequest
+        from dragonfly2_tpu.pkg.piece import Range
         from dragonfly2_tpu.proto.common import UrlMeta
 
+        # Sharded warm-up: `?range=a-b` prefetches just that span as its
+        # own ranged task (dedups with dfget/preheat/device pulls of the
+        # same canonical span; warm whole-object stores serve it locally).
+        rng = ""
+        if request.query.get("range"):
+            try:
+                rng = Range.normalize_header(request.query["range"])
+            except ValueError as e:
+                raise web.HTTPBadRequest(
+                    text=f"bad range {request.query['range']!r}: {e}")
         url = self.backend.object_url(bucket, key)
         req = FileTaskRequest(url=url, output="",
-                              meta=UrlMeta(tag=bucket), device=device)
+                              meta=UrlMeta(tag=bucket, range=rng),
+                              device=device)
+        if rng:
+            req.range = Range.parse_http(rng)
 
         async def run_prefetch():
             final = None

@@ -466,10 +466,13 @@ class TaskManager:
         # 1b. Ranged request: serve the slice off the whole-content parent
         # task when its pieces cover the range — completed OR partial
         # (reference peertask_reuse.go:234 + FindPartialCompletedTask).
-        # Device requests skip this: the export path is file-only, and a
-        # fresh ranged task (below) lands into the sink; the local parent
-        # keeps serving its pieces to other peers either way.
-        if req.meta.range and req.device != "tpu":
+        # Device requests skip this (the export path is file-only; a
+        # fresh ranged task below lands into the sink), and so do
+        # output-less requests (gateway ranged prefetch: nothing to
+        # export — the fresh ranged task imports from the warm parent
+        # via _covering_local_parent instead). The local parent keeps
+        # serving its pieces to other peers either way.
+        if req.meta.range and req.device != "tpu" and req.output:
             covering = self._covering_local_parent(req)
             if covering is not None:
                 parent, rng = covering

@@ -546,3 +546,60 @@ def test_gateway_prefetch_and_device_sink(run_async, tmp_path):
             storage.close()
 
     run_async(run())
+
+
+def test_gateway_ranged_prefetch(run_async, tmp_path):
+    """dfstore prefetch --range warms ONE span as its own ranged task
+    (sharded warm-up through the object gateway), with device=tpu
+    landing the slice in the HBM sink; malformed spans are 400s."""
+    from dragonfly2_tpu.daemon.peer.device_sink import DeviceSinkManager
+    from dragonfly2_tpu.daemon.peer.task_manager import TaskManager
+
+    async def run():
+        backend = FSObjectStorage(root=str(tmp_path / "buckets"))
+        storage = StorageManager(StorageOption(data_dir=str(tmp_path / "p2p")))
+        sinks = DeviceSinkManager()
+        tm = TaskManager(storage, PieceManager(PieceManagerOption(concurrency=2)),
+                         device_sinks=sinks)
+        svc = ObjectStorageService(backend, P2PTransport(tm))
+        port = await svc.serve("127.0.0.1", 0)
+        store = Dfstore(f"http://127.0.0.1:{port}")
+        try:
+            await store.create_bucket("sharded")
+            payload = os.urandom((2 << 20) + 7)
+            await store.put_object("sharded", "ckpt.bin", payload,
+                                   mode="write_back")
+            result = await store.prefetch_object(
+                "sharded", "ckpt.bin", device="tpu",
+                range_header="4096-1052671")
+            assert result["state"] == "done", result
+            assert result["device_verified"] is True, result
+            assert result["content_length"] == 1052672 - 4096
+            # The ranged task's slice is resident in the sink.
+            sink = sinks.get(result["task_id"])
+            assert sink is not None and sink.verified
+            import numpy as np
+
+            assert (bytes(np.asarray(sink.as_bytes_array()))
+                    == payload[4096:1052672])
+            # Non-device ranged prefetch with a WARM whole-object
+            # parent: must serve from the local store (fresh ranged task
+            # + local import), never crash on the file-only export path.
+            whole = await store.prefetch_object("sharded", "ckpt.bin")
+            assert whole["state"] == "done"
+            ranged2 = await store.prefetch_object(
+                "sharded", "ckpt.bin", range_header="0-65535")
+            assert ranged2["state"] == "done", ranged2
+            assert ranged2["content_length"] == 65536
+
+            with pytest.raises(DfstoreError) as exc:
+                await store.prefetch_object("sharded", "ckpt.bin",
+                                            range_header="9-5")
+            assert exc.value.status == 400
+        finally:
+            await store.close()
+            await svc.close()
+            sinks.close()
+            storage.close()
+
+    run_async(run())
