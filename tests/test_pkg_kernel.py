@@ -400,3 +400,28 @@ class TestRangeNormalizeHeader:
         for bad in ("10-5", "-1024", "nonsense", "1,2-3"):
             with pytest.raises(ValueError):
                 Range.normalize_header(bad)
+
+
+class TestRangeNormalizeProperties:
+    def test_idempotent_and_parse_equivalent(self):
+        from hypothesis import given, settings, strategies as st_h
+
+        from dragonfly2_tpu.pkg.piece import Range
+
+        @settings(max_examples=200, deadline=None)
+        @given(a=st_h.integers(0, 1 << 40), span=st_h.integers(0, 1 << 30),
+               pad=st_h.sampled_from(["", " ", "0", "00"]),
+               prefix=st_h.sampled_from(["", "bytes="]))
+        def prop(a, span, pad, prefix):
+            raw = f"{prefix}{pad}{a}-{a + span}"
+            norm = Range.normalize_header(raw)
+            # Idempotent: canonical form is a fixed point.
+            assert Range.normalize_header(norm) == norm
+            # Parse-equivalent: the canonical header selects the same
+            # bytes as the raw input.
+            r1 = Range.parse_http(raw)
+            r2 = Range.parse_http(norm)
+            assert (r1.start, r1.length) == (r2.start, r2.length)
+            assert norm == f"bytes={a}-{a + span}"
+
+        prop()
