@@ -90,7 +90,14 @@ def _run_dfget(args: argparse.Namespace) -> int:
                 sys.stderr.write(f"\r{pct} {format_size(done)}")
                 sys.stderr.flush()
 
-        result = await dfget_lib.download(cfg, on_progress)
+        try:
+            result = await dfget_lib.download(cfg, on_progress)
+        finally:
+            # One-shot process: close any source-fallback session pool
+            # cleanly instead of leaking it to interpreter exit.
+            from dragonfly2_tpu.source.client import default_registry
+
+            await default_registry().close_all()
         elapsed = time.monotonic() - start
         size = result.get("completed_length", 0)
         rate = size / elapsed if elapsed > 0 else 0

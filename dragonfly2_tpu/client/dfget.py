@@ -96,7 +96,10 @@ async def _download_from_source(cfg: DfgetConfig) -> dict:
 
     # Hold the process-global registry for the stream's lifetime: an
     # embedded daemon stopping concurrently must not close the shared
-    # session under this in-flight direct fetch.
+    # session under this in-flight direct fetch. Never ARMS closing:
+    # library embedders keep the pooled session across sequential
+    # fetches (the Registry.retain invariant); the one-shot CLI closes
+    # explicitly at command end (cli/main.py).
     registry = default_registry().retain()
     try:
         return await _download_from_source_inner(cfg)
