@@ -17,6 +17,12 @@ from __future__ import annotations
 # libtpu) to initialize inside a subprocess that should never touch one.
 ACCELERATOR_ENV_PREFIXES = ("PALLAS_AXON", "AXON_", "TPU_", "LIBTPU")
 
+# Path substrings that mark accelerator-plugin site dirs (the dirs whose
+# sitecustomize dials the relay). Shared for the same reason as the env
+# prefixes: a marker added in one spawner's private copy and missed in
+# another silently regresses hermeticity.
+ACCELERATOR_PATH_MARKERS = ("axon_site",)
+
 
 def scrub_accelerator_env(env: dict) -> dict:
     """Delete accelerator-plugin trigger vars from ``env`` in place.
@@ -28,3 +34,9 @@ def scrub_accelerator_env(env: dict) -> dict:
         if key.startswith(ACCELERATOR_ENV_PREFIXES):
             del env[key]
     return env
+
+
+def scrub_plugin_paths(paths) -> list:
+    """Return ``paths`` minus accelerator-plugin site dirs (and empties)."""
+    return [p for p in paths
+            if p and not any(m in p for m in ACCELERATOR_PATH_MARKERS)]

@@ -228,6 +228,51 @@ def test_dryrun_survives_hanging_sitecustomize(tmp_path):
     assert "SURVIVED" in proc.stdout
 
 
+def test_dryrun_survives_driver_cpu_env_with_jax_trap(tmp_path):
+    """Round-4 regression (MULTICHIP_r04 rc=124): the DRIVER sets
+    JAX_PLATFORMS=cpu + the device-count flag in its own env, but the
+    sandbox sitecustomize (site ENABLED in the driver's parent process)
+    has already armed the axon plugin, so importing jax in that parent
+    dials the wedged tunnel and hangs. dryrun_multichip must therefore
+    never import jax in a process it does not control — only a live,
+    config-pinned CPU jax (the conftest case) may be reused in-process;
+    everything else goes to a ``python -S`` child that never imports
+    sitecustomize at all.
+
+    The fake sitecustomize arms an import trap that hangs the first
+    ``import jax`` — the honest analog of the wedged relay dial.
+    """
+    import subprocess
+    import sys
+
+    fake_site = tmp_path / "driver_site"
+    fake_site.mkdir()
+    (fake_site / "sitecustomize.py").write_text(
+        "import os, sys, time\n"
+        "if os.environ.get('PALLAS_AXON_POOL_IPS'):\n"
+        "    class _Trap:\n"
+        "        def find_spec(self, name, path=None, target=None):\n"
+        "            if name == 'jax':\n"
+        "                time.sleep(600)  # wedged tunnel dial at jax init\n"
+        "            return None\n"
+        "    sys.meta_path.insert(0, _Trap())\n")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join([str(fake_site), repo])
+    env["PALLAS_AXON_POOL_IPS"] = "10.255.255.1"
+    env["JAX_PLATFORMS"] = "cpu"      # the driver's own override (r04)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["GRAFT_DRYRUN_TIMEOUT"] = "70"
+    # Site ENABLED in the parent — exactly how the driver runs it.
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(8); "
+         "print('SURVIVED_R4')"],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=100)
+    assert proc.returncode == 0, (proc.stdout[-1000:], proc.stderr[-2000:])
+    assert "SURVIVED_R4" in proc.stdout
+
+
 def test_land_and_checksum_verify_on_land():
     """Fused sink step: scatter + checksums OF THE LANDED BATCH (verify-on-
     land); partial batches leave other slots untouched."""
