@@ -46,7 +46,23 @@ def _load_history() -> list:
     return history if isinstance(history, list) else []
 
 
+def _make_device_entry(jax, device_bps: float, cpu_bps: float,
+                       smoke: str) -> dict:
+    """The one history-entry shape, shared by bench.main and
+    benchmarks/device_evidence.py so the rolling record never forks."""
+    return {
+        "ts": time.time(),
+        "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "gbps": round(device_bps / 1e9, 3),
+        "vs_cpu_sha256": round(device_bps / cpu_bps, 3),
+        "backend": jax.default_backend(),
+        "sink_smoke": smoke,
+    }
+
+
 def _record_device_result(entry: dict) -> None:
+    if entry.get("backend") == "cpu":
+        return  # never let a CPU fallback masquerade as on-chip evidence
     history = _load_history()
     history.append(entry)
     try:
@@ -69,31 +85,63 @@ def bench_cpu_sha256(data: bytes, repeats: int = 3) -> float:
 def _probe_backend_subprocess(timeout_s: float) -> str | None:
     """Probe device availability in a THROWAWAY subprocess so a hung
     backend (tunnel stall) cannot wedge the bench process itself. Returns
-    an error string, or None when a device op round-tripped."""
+    an error string, or None when a device op round-tripped.
+
+    The probe arms faulthandler to dump its own stacks just before the
+    deadline, so a hang reports WHERE device init died (plugin load,
+    relay dial, first execute) instead of an opaque timeout."""
     import subprocess
     import sys as _sys
 
-    code = ("import jax, numpy as np, jax.numpy as jnp; "
+    dump_after = max(timeout_s - 5.0, 1.0)
+    code = ("import faulthandler, sys; "
+            f"faulthandler.dump_traceback_later({dump_after}, exit=True); "
+            "import jax, numpy as np, jax.numpy as jnp; "
             "x = jnp.ones((8,)) + 1; "
             "assert float(np.asarray(x[0])) == 2.0; "
+            "assert jax.default_backend() != 'cpu', 'cpu fallback'; "
+            "faulthandler.cancel_dump_traceback_later(); "
             "print('PROBE_OK', jax.default_backend())")
     try:
         proc = subprocess.run([_sys.executable, "-c", code],
                               capture_output=True, text=True,
                               timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        return f"device probe hung (> {timeout_s:.0f}s)"
+        return f"device probe hung (> {timeout_s:.0f}s), no stack dump"
     if proc.returncode != 0 or "PROBE_OK" not in proc.stdout:
-        return (proc.stderr.strip().splitlines() or ["probe failed"])[-1][:200]
+        err = proc.stderr.strip()
+        dump_fired = ("Timeout (0:" in err
+                      and ("Thread " in err or "Current thread" in err))
+        if dump_fired:
+            # faulthandler fired: keep each thread's DEEPEST frame (dumps
+            # are most-recent-call-first) — they name the exact call
+            # device init was stuck in; a bare "<string> line 1" deepest
+            # frame means the hang is inside native code (plugin dial).
+            deepest = []
+            take_next = False
+            for ln in err.splitlines():
+                if ln.startswith(("Thread ", "Current thread ")):
+                    take_next = True
+                elif take_next and ln.strip().startswith("File "):
+                    deepest.append(ln.strip())
+                    take_next = False
+            where = "; ".join(deepest) if deepest else "no frame captured"
+            return (f"device init stuck after {dump_after:.0f}s; deepest "
+                    f"frame per thread: {where}"[:600])
+        return (err.splitlines() or ["probe failed"])[-1][:400]
     return None
 
 
-def _init_backend_with_retry(max_attempts: int = 4,
-                             probe_timeout_s: float = 120.0):
+def _init_backend_with_retry(max_attempts: int = 6,
+                             probe_timeout_s: float = 45.0):
     """Backend init with bounded backoff (round-2 lesson: a single transient
     'Unable to initialize backend' burned the whole round's device number;
     round-3 lesson: the tunnel can HANG rather than fail, so each attempt
-    probes in a subprocess with a hard timeout). Returns (jax, attempts)."""
+    probes in a subprocess with a hard timeout; round-4 lesson: 4x120s
+    probes burned 8+ minutes saying nothing — shorter probes, more of
+    them, each naming the frame it died in). Returns (jax, attempts)."""
+    probe_timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT",
+                                           probe_timeout_s))
     delay = 5.0
     last = None
     for attempt in range(1, max_attempts + 1):
@@ -245,14 +293,7 @@ def main() -> int:
         smoke = f"failed: {e}"
     if smoke == "ok":
         # Only verified runs may ever be cited as "last known-good".
-        _record_device_result({
-            "ts": time.time(),
-            "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-            "gbps": round(device_bps / 1e9, 3),
-            "vs_cpu_sha256": round(device_bps / cpu_bps, 3),
-            "backend": jax.default_backend(),
-            "sink_smoke": smoke,
-        })
+        _record_device_result(_make_device_entry(jax, device_bps, cpu_bps, smoke))
     print(json.dumps({
         "metric": "verify_and_land_throughput",
         "value": round(device_bps / 1e9, 3),

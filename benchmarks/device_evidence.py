@@ -1,0 +1,65 @@
+"""Round-start (and opportunistic) on-chip evidence capture.
+
+VERDICT r04 item 10: the rounds that DID capture live TPU numbers did it
+by hand early, before the tunnel degraded; the rounds that didn't lost
+their official number to a wedged tunnel at driver time. This script is
+the habit, mechanized: probe the device with a short hard timeout, and if
+(and only if) a non-CPU backend answers, run the real sink benchmark +
+smoke and append the verified result to BENCH_DEVICE_HISTORY.json — the
+rolling record bench.py cites when the tunnel is down at driver time.
+
+Run it at round start and whenever convenient:
+
+    python benchmarks/device_evidence.py [--probe-timeout 45] [--attempts 2]
+
+Exit codes: 0 = evidence captured, 2 = device unreachable (no record
+written), 1 = device answered but the measurement failed (investigate).
+Prints one JSON line either way.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import bench  # noqa: E402  (repo-root bench.py: probe + sink bench + history)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--probe-timeout", type=float,
+                    default=float(os.environ.get("BENCH_PROBE_TIMEOUT", 45.0)))
+    ap.add_argument("--attempts", type=int, default=2)
+    args = ap.parse_args()
+
+    try:
+        jax, _attempts = bench._init_backend_with_retry(
+            max_attempts=args.attempts, probe_timeout_s=args.probe_timeout)
+    except RuntimeError as e:
+        print(json.dumps({"captured": False, "reason": str(e)[:600]}))
+        return 2
+
+    try:
+        cpu_bps = bench.bench_cpu_sha256(np.random.RandomState(1).bytes(64 << 20))
+        device_bps = bench.bench_device_sink(jax)
+        smoke = bench.sink_smoke(jax)
+    except Exception as e:
+        print(json.dumps({"captured": False,
+                          "reason": f"measurement failed: {e}"[:400]}))
+        return 1
+    entry = bench._make_device_entry(jax, device_bps, cpu_bps, smoke)
+    captured = smoke == "ok" and entry["backend"] != "cpu"
+    if captured:
+        bench._record_device_result(entry)
+    print(json.dumps({"captured": captured, **entry}))
+    return 0 if captured else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
