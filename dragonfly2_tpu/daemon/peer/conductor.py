@@ -19,6 +19,7 @@ Flow:
 from __future__ import annotations
 
 import asyncio
+import os
 
 from dragonfly2_tpu.daemon.peer.piece_dispatcher import PieceAssignment, PieceDispatcher
 from dragonfly2_tpu.daemon.peer.piece_downloader import PieceDownloader
@@ -488,6 +489,13 @@ class PeerTaskConductor:
         except (asyncio.CancelledError, DfError):
             pass
 
+    # Coalescing bound: one ranged GET covers up to this many contiguous
+    # pieces (32 MiB at the default 4 MiB piece size). Availability gates
+    # real run lengths — a warming parent advertises pieces incrementally,
+    # so cold-chain runs stay short while warm pulls ride full spans.
+    # Env-overridable for A/B measurement on noisy shared hosts.
+    SPAN_MAX_PIECES = int(os.environ.get("DF_SPAN_MAX_PIECES", "8"))
+
     async def _piece_worker(self, index: int) -> None:
         """Hot loop (reference downloadPieceWorker :1043)."""
         while True:
@@ -500,7 +508,57 @@ class PeerTaskConductor:
                 if not await self._handle_starvation():
                     return
                 continue
+            run = self.dispatcher.extend_run(assignment, self.SPAN_MAX_PIECES)
+            if len(run) > 1 and await self._download_run(run):
+                continue
+            for extra in run[1:]:
+                # Span path ineligible: hand the reservations back and pull
+                # the head piece the per-piece way.
+                self.dispatcher.release_assignment(extra)
             await self._download_one(assignment)
+
+    async def _download_run(self, run: list[PieceAssignment]) -> bool:
+        """One coalesced ranged fetch; returns False when the downloader
+        deemed the span ineligible (caller falls back per-piece). Piece
+        results arrive through the streaming callback as each lands, so
+        progress frames and broker piece discovery stay piece-granular."""
+        from dragonfly2_tpu.daemon.peer.piece_downloader import is_parent_gone
+
+        p = run[0].parent
+        penalized: set[int] = set()
+
+        async def on_result(a: PieceAssignment, rec, err) -> None:
+            if rec is not None:
+                self.dispatcher.report_success(a, rec.cost_ms)
+                PIECE_DOWNLOAD_COUNT.labels("ok").inc()
+                await self._report_piece(rec, parent_id=p.peer_id)
+                if self.on_piece is not None:
+                    await self.on_piece(self.store, rec)
+            else:
+                PIECE_DOWNLOAD_COUNT.labels("fail").inc()
+                gone = is_parent_gone(err)
+                # One span-level event (429, 416, dead stream) arrives as
+                # the SAME error object for every affected piece: penalize
+                # the parent once — per-piece penalties would double the
+                # cost EWMA 8x and block a parent over a single temporary
+                # throttle. Distinct errors (per-piece crc mismatches)
+                # still count individually, matching the per-piece path.
+                if id(err) in penalized:
+                    self.dispatcher.release_assignment(a)
+                else:
+                    penalized.add(id(err))
+                    self.dispatcher.report_failure(a, parent_gone=gone)
+                await self._safe_send({
+                    "type": "piece_failed",
+                    "piece_num": a.piece_num,
+                    "parent_id": p.peer_id,
+                    "temporary": not gone,
+                })
+
+        return await self.downloader.download_span_to_store(
+            p.ip, p.upload_port, self.task_id, run, self.store,
+            src_peer_id=self.peer_id, limiter=self.limiter,
+            on_result=on_result)
 
     async def _download_one(self, assignment: PieceAssignment) -> None:
         from dragonfly2_tpu.daemon.peer.piece_downloader import (

@@ -255,6 +255,52 @@ class PieceDispatcher:
             heapq.heappush(self._heap, n)
         return found
 
+    def extend_run(self, a: PieceAssignment,
+                   max_len: int) -> list[PieceAssignment]:
+        """Greedily extend ``a`` into a CONTIGUOUS run of needed pieces the
+        same parent already advertises, for one coalesced ranged fetch
+        (reference moves pieces one GET each — peertask_conductor.go:1043;
+        the TPU-first win is one native socket→crc→pwrite loop per run).
+        Only pieces whose digest the native path can verify on the fly
+        (crc32c or none) extend the run, so a mixed-digest task does not
+        bounce between span attempts and per-piece fallbacks. Extended
+        pieces are reserved (inflight) exactly like try_get's."""
+        run = [a]
+        p = a.parent
+        if (self.piece_size <= 0 or self.content_length < 0
+                or p.blocked or max_len <= 1):
+            return run
+        if a.digest and not a.digest.startswith("crc32c:"):
+            # The head piece itself would make the span ineligible: don't
+            # reserve extras just to release them (a 25k-piece sha256 task
+            # would churn reserve/release on every piece).
+            return run
+        from dragonfly2_tpu.storage.local_store import _native
+
+        if _native() is None:
+            return run  # span fetch is native-only; avoid churn without it
+        from dragonfly2_tpu.pkg.piece import piece_length
+
+        n = a.piece_num + 1
+        while len(run) < max_len and n in self._needed and n in p.pieces:
+            digest = self.piece_digests.get(n, "")
+            if digest and not digest.startswith("crc32c:"):
+                break
+            self._needed.discard(n)
+            self._inflight.add(n)
+            run.append(PieceAssignment(
+                n, p, piece_length(n, self.piece_size, self.content_length),
+                digest=digest))
+            n += 1
+        return run
+
+    def release_assignment(self, a: PieceAssignment) -> None:
+        """Hand an unfetched reservation back (span fallback): no failure
+        accounting — the piece simply becomes assignable again."""
+        self._inflight.discard(a.piece_num)
+        self._add_needed([a.piece_num])
+        self._wakeup.set()
+
     async def get(self, timeout: float = 30.0) -> PieceAssignment | None:
         """Next assignment; None when the task is complete or no parents can
         serve anything new within ``timeout`` (caller decides to reschedule)."""

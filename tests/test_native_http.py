@@ -293,6 +293,220 @@ class TestNativeSpan:
         assert b"Host: origin:8080\r\n" in head and b"user:pw" not in head
 
 
+class TestP2PSpan:
+    """PieceDownloader.download_span_to_store: one ranged GET coalescing a
+    contiguous run of pieces, per-piece results streaming through the
+    callback as they land (round-5 receive-path coalescing)."""
+
+    @staticmethod
+    def _assignments(recs, parent_port):
+        from dragonfly2_tpu.daemon.peer.piece_dispatcher import (
+            ParentInfo, PieceAssignment)
+
+        parent = ParentInfo("p_src", "127.0.0.1", parent_port)
+        return [PieceAssignment(r.num, parent, r.size, digest=r.digest)
+                for r in recs]
+
+    def test_span_streams_piece_results(self, run_async, tmp_path):
+        from dragonfly2_tpu.daemon.peer.piece_downloader import PieceDownloader
+
+        async def body():
+            ps = 1 << 20
+            content = os.urandom(3 * ps + 123)
+            src = _store(tmp_path, "src", len(content), ps)
+            recs = [src.write_piece(n, content[n * ps:(n + 1) * ps])
+                    for n in range(4)]
+
+            async def ranged(req: web.Request) -> web.Response:
+                r = Range.parse_http(req.headers["Range"], len(content))
+                return web.Response(status=206,
+                                    body=content[r.start:r.start + r.length],
+                                    headers={"Content-Range":
+                                             f"bytes {r.start}-"
+                                             f"{r.start + r.length - 1}"
+                                             f"/{len(content)}"})
+
+            runner, port = await _serve({"/download/{p}/{t}": ranged})
+            dst = _store(tmp_path, "dst", len(content), ps)
+            dl = PieceDownloader()
+            seen: list[int] = []
+            try:
+                async def on_result(a, rec, err):
+                    assert err is None and rec is not None
+                    assert dst.has_piece(a.piece_num)  # already committed
+                    seen.append(a.piece_num)
+
+                handled = await dl.download_span_to_store(
+                    "127.0.0.1", port, "t" * 16,
+                    self._assignments(recs, port), dst, on_result=on_result)
+                assert handled and seen == [0, 1, 2, 3]
+                got = b"".join(dst.read_piece(n) for n in range(4))
+                assert got == content
+            finally:
+                await dl.close()
+                await runner.cleanup()
+
+        run_async(body())
+
+    def test_mid_span_corruption_fails_only_that_piece(self, run_async, tmp_path):
+        from dragonfly2_tpu.daemon.peer.piece_downloader import PieceDownloader
+        from dragonfly2_tpu.pkg.errors import Code
+
+        async def body():
+            ps = 1 << 20
+            content = os.urandom(4 * ps)
+            src = _store(tmp_path, "src", len(content), ps)
+            recs = [src.write_piece(n, content[n * ps:(n + 1) * ps])
+                    for n in range(4)]
+
+            async def corrupting(req: web.Request) -> web.Response:
+                r = Range.parse_http(req.headers["Range"], len(content))
+                body_bytes = bytearray(content[r.start:r.start + r.length])
+                # Flip a byte inside piece 2's window.
+                body_bytes[2 * ps - r.start + 7] ^= 0xFF
+                return web.Response(status=206, body=bytes(body_bytes),
+                                    headers={"Content-Range":
+                                             f"bytes {r.start}-"
+                                             f"{r.start + r.length - 1}"
+                                             f"/{len(content)}"})
+
+            runner, port = await _serve({"/download/{p}/{t}": corrupting})
+            dst = _store(tmp_path, "dst", len(content), ps)
+            dl = PieceDownloader()
+            outcomes: dict[int, object] = {}
+            try:
+                async def on_result(a, rec, err):
+                    outcomes[a.piece_num] = err.code if err else "ok"
+
+                handled = await dl.download_span_to_store(
+                    "127.0.0.1", port, "t" * 16,
+                    self._assignments(recs, port), dst, on_result=on_result)
+                assert handled
+                assert outcomes == {0: "ok", 1: "ok",
+                                    2: Code.ClientPieceDownloadFail, 3: "ok"}
+                assert not dst.has_piece(2)   # bad bytes stay invisible
+                assert dst.has_piece(3)       # stream continued past the bad one
+            finally:
+                await dl.close()
+                await runner.cleanup()
+
+        run_async(body())
+
+    def test_uncovered_span_fails_all_as_not_found(self, run_async, tmp_path):
+        from dragonfly2_tpu.daemon.peer.piece_downloader import PieceDownloader
+        from dragonfly2_tpu.pkg.errors import Code
+
+        async def body():
+            ps = 1 << 20
+            src = _store(tmp_path, "src", 4 * ps, ps)
+            recs = [src.write_piece(n, os.urandom(ps)) for n in range(4)]
+
+            async def gone(req: web.Request) -> web.Response:
+                return web.Response(status=416, text="range not covered")
+
+            runner, port = await _serve({"/download/{p}/{t}": gone})
+            dst = _store(tmp_path, "dst", 4 * ps, ps)
+            dl = PieceDownloader()
+            codes: list[object] = []
+            try:
+                async def on_result(a, rec, err):
+                    codes.append(err.code)
+
+                handled = await dl.download_span_to_store(
+                    "127.0.0.1", port, "t" * 16,
+                    self._assignments(recs, port), dst, on_result=on_result)
+                assert handled
+                assert codes == [Code.ClientPieceNotFound] * 4
+            finally:
+                await dl.close()
+                await runner.cleanup()
+
+        run_async(body())
+
+    def test_span_ineligibility_falls_back(self, run_async, tmp_path):
+        from dragonfly2_tpu.daemon.peer.piece_downloader import PieceDownloader
+        from dragonfly2_tpu.daemon.peer.piece_dispatcher import (
+            ParentInfo, PieceAssignment)
+
+        async def body():
+            ps = 1 << 20
+            dst = _store(tmp_path, "dst", 4 * ps, ps)
+            parent = ParentInfo("p_src", "127.0.0.1", 1)
+            dl = PieceDownloader()
+
+            async def never(a, rec, err):
+                raise AssertionError("ineligible span must not call back")
+
+            # Non-crc32c digest.
+            run = [PieceAssignment(n, parent, ps,
+                                   digest="sha256:" + "0" * 64)
+                   for n in range(2)]
+            assert not await dl.download_span_to_store(
+                "127.0.0.1", 1, "t" * 16, run, dst, on_result=never)
+            # Non-contiguous pieces.
+            run = [PieceAssignment(0, parent, ps), PieceAssignment(2, parent, ps)]
+            assert not await dl.download_span_to_store(
+                "127.0.0.1", 1, "t" * 16, run, dst, on_result=never)
+            # Unknown expected size.
+            run = [PieceAssignment(0, parent, -1), PieceAssignment(1, parent, ps)]
+            assert not await dl.download_span_to_store(
+                "127.0.0.1", 1, "t" * 16, run, dst, on_result=never)
+            await dl.close()
+
+        run_async(body())
+
+
+class TestSpanDispatch:
+    """PieceDispatcher.extend_run / release_assignment."""
+
+    def _dispatcher_with_parent(self, n_pieces=10, advertised=None):
+        from dragonfly2_tpu.daemon.peer.piece_dispatcher import PieceDispatcher
+
+        d = PieceDispatcher()
+        d.piece_size = 1 << 20
+        d.content_length = n_pieces << 20
+        d.total_piece_count = n_pieces
+        p = d.upsert_parent("par", "127.0.0.1", 9)
+        d.on_parent_pieces("par", list(advertised
+                                       if advertised is not None
+                                       else range(n_pieces)))
+        return d, p
+
+    def test_extend_run_reserves_contiguous_pieces(self):
+        d, p = self._dispatcher_with_parent()
+        a = d.try_get()
+        assert a is not None and a.piece_num == 0
+        run = d.extend_run(a, 4)
+        assert [x.piece_num for x in run] == [0, 1, 2, 3]
+        # Extended pieces are reserved: the next worker starts at 4.
+        b = d.try_get()
+        assert b.piece_num == 4
+
+    def test_extend_run_stops_at_unadvertised(self):
+        d, p = self._dispatcher_with_parent(advertised=[0, 1, 5, 6])
+        a = d.try_get()
+        run = d.extend_run(a, 8)
+        assert [x.piece_num for x in run] == [0, 1]
+
+    def test_extend_run_stops_at_non_crc_digest(self):
+        d, p = self._dispatcher_with_parent()
+        d.piece_digests[2] = "sha256:" + "0" * 64
+        a = d.try_get()
+        run = d.extend_run(a, 8)
+        assert [x.piece_num for x in run] == [0, 1]
+
+    def test_release_assignment_requeues_without_penalty(self):
+        d, p = self._dispatcher_with_parent()
+        a = d.try_get()
+        run = d.extend_run(a, 4)
+        before = p.cost_ewma_ms
+        for extra in run[1:]:
+            d.release_assignment(extra)
+        assert p.cost_ewma_ms == before and p.failures == 0
+        # Released pieces are assignable again, in order.
+        assert d.try_get().piece_num == 1
+
+
 class TestMalformedResponses:
     def test_garbage_heads_fail_cleanly(self, run_async, tmp_path):
         """Random/adversarial response bytes must produce a coded error —
