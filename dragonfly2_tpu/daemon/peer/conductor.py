@@ -525,7 +525,8 @@ class PeerTaskConductor:
         from dragonfly2_tpu.daemon.peer.piece_downloader import is_parent_gone
 
         p = run[0].parent
-        penalized: set[int] = set()
+        penalized: list = []   # error OBJECTS — an id() set would alias a
+        # freed error's reused address to a fresh distinct failure
 
         async def on_result(a: PieceAssignment, rec, err) -> None:
             if rec is not None:
@@ -543,10 +544,10 @@ class PeerTaskConductor:
                 # cost EWMA 8x and block a parent over a single temporary
                 # throttle. Distinct errors (per-piece crc mismatches)
                 # still count individually, matching the per-piece path.
-                if id(err) in penalized:
+                if any(e is err for e in penalized):
                     self.dispatcher.release_assignment(a)
                 else:
-                    penalized.add(id(err))
+                    penalized.append(err)
                     self.dispatcher.report_failure(a, parent_gone=gone)
                 await self._safe_send({
                     "type": "piece_failed",

@@ -246,17 +246,19 @@ class PieceDownloader:
             return None
         want_crc = -1
         if expected_digest:
-            d = pkgdigest.parse(expected_digest)
-            if d.algorithm != pkgdigest.ALGORITHM_CRC32C:
-                return None
             try:
-                want_crc = int(d.encoded, 16)
-            except ValueError:
+                d = pkgdigest.parse(expected_digest)
+            except pkgdigest.InvalidDigestError:
                 # Malformed parent-advertised digest can never match any
                 # body: the same per-piece failure the in-memory path's
                 # hex-string comparison produces, without fetching first.
+                # (parse itself validates the hex — int() below cannot
+                # fail on a parsed digest.)
                 raise DfError(Code.ClientPieceDownloadFail,
                               f"piece {piece_num}: malformed digest {expected_digest!r}")
+            if d.algorithm != pkgdigest.ALGORITHM_CRC32C:
+                return None
+            want_crc = int(d.encoded, 16)
 
         if _unsafe_request_ids(task_id, src_peer_id):
             return None  # the aiohttp path quotes them safely
@@ -352,13 +354,13 @@ class PieceDownloader:
                     or store.has_piece(a.piece_num)):
                 return False
             if a.digest:
-                d = pkgdigest.parse(a.digest)
+                try:
+                    d = pkgdigest.parse(a.digest)
+                except pkgdigest.InvalidDigestError:
+                    return False  # malformed: per-piece path raises its coded error
                 if d.algorithm != pkgdigest.ALGORITHM_CRC32C:
                     return False
-                try:
-                    want_crcs.append(int(d.encoded, 16))
-                except ValueError:
-                    return False  # malformed: per-piece path raises its error
+                want_crcs.append(int(d.encoded, 16))
             else:
                 want_crcs.append(-1)
         for prev, nxt in zip(run, run[1:]):

@@ -423,6 +423,38 @@ class TestP2PSpan:
 
         run_async(body())
 
+    def test_malformed_crc_digest_is_coded_not_leaked(self, run_async, tmp_path):
+        """A parent-advertised digest like 'crc32c:dead' (right prefix,
+        bad encoding) must yield the per-piece coded error / span
+        fallback — never leak InvalidDigestError through the worker,
+        which would strand the run's reservations."""
+        from dragonfly2_tpu.daemon.peer.piece_downloader import PieceDownloader
+        from dragonfly2_tpu.daemon.peer.piece_dispatcher import (
+            ParentInfo, PieceAssignment)
+        from dragonfly2_tpu.pkg.errors import Code, DfError
+
+        async def body():
+            ps = 1 << 20
+            dst = _store(tmp_path, "dst", 4 * ps, ps)
+            parent = ParentInfo("p_src", "127.0.0.1", 1)
+            dl = PieceDownloader()
+
+            async def never(a, rec, err):
+                raise AssertionError("malformed span must not call back")
+
+            run = [PieceAssignment(n, parent, ps, digest="crc32c:dead")
+                   for n in range(2)]
+            assert not await dl.download_span_to_store(
+                "127.0.0.1", 1, "t" * 16, run, dst, on_result=never)
+            with pytest.raises(DfError) as ei:
+                await dl.download_piece_to_store(
+                    "127.0.0.1", 1, "t" * 16, 0, dst,
+                    expected_size=ps, expected_digest="crc32c:dead")
+            assert ei.value.code == Code.ClientPieceDownloadFail
+            await dl.close()
+
+        run_async(body())
+
     def test_span_ineligibility_falls_back(self, run_async, tmp_path):
         from dragonfly2_tpu.daemon.peer.piece_downloader import PieceDownloader
         from dragonfly2_tpu.daemon.peer.piece_dispatcher import (
