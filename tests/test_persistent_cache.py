@@ -180,6 +180,97 @@ def test_replicas_restored_when_host_leaves(run_async, tmp_path):
     run_async(run())
 
 
+def test_task_retrievable_after_replica_host_killed(run_async, tmp_path):
+    """The VERDICT r04 item-6 'done' bar: import with --replica-count 2,
+    HARD-KILL one replica's daemon (no goodbye — its announcer is torn
+    off before stop so no LeaveHost is ever sent, the failure-detection
+    analog of a SIGKILL), and a THIRD host must still export the exact
+    bytes over P2P from the surviving replica — replication repair is
+    stubbed out until after the export so the survivor cannot be
+    pre-warmed by the repair racing the pull. Then the repair path is
+    restored and the GC top-up re-establishes the count without the dead
+    host. Reference capability: service_v2.go:1726-1895 +
+    persistentcache host GC."""
+
+    async def run():
+        cfg = _sched_config(tmp_path)
+        sched = SchedulerServer(cfg)
+        await sched.start()
+        d_a = await start_daemon(tmp_path, "kill-a", sched.port())
+        d_b = await start_daemon(tmp_path, "kill-b", sched.port())
+        d_c = await start_daemon(tmp_path, "kill-c", sched.port())
+        alive = [d_a, d_b, d_c]
+        try:
+            payload = os.urandom(768 * 1024)
+            src = tmp_path / "k.bin"
+            src.write_bytes(payload)
+            assert await _wait(lambda: len(sched.service.hosts.all()) >= 3)
+
+            cfg_a = dfcache.DfcacheConfig(
+                daemon_sock=d_a.config.unix_sock, cache_id="kill-entry")
+            result = await dfcache.import_file(
+                cfg_a, str(src), persistent=True, replica_count=2)
+            task_id = result["task_id"]
+            assert await _wait(
+                lambda: sched.service.persistent.replica_count(task_id) >= 2)
+
+            # The uploader is d_a by construction; the victim is the
+            # OTHER holder (replication placed it on b or c).
+            uploader_host = d_a._host_wire()["id"]
+            holders = {p["host_id"] for p in
+                       sched.service.persistent.peers_of(task_id)}
+            assert uploader_host in holders
+            victim_host = next(h for h in holders if h != uploader_host)
+            by_host = {d._host_wire()["id"]: d for d in (d_a, d_b, d_c)}
+            victim = by_host[victim_host]
+            alive.remove(victim)
+            # Hard kill: no announcer → no LeaveHost goodbye; the
+            # scheduler still lists the host until failure detection
+            # (modeled by the explicit leave below) reaps it.
+            victim.announcer = None
+            await victim.stop()
+            assert any(h.id == victim_host
+                       for h in sched.service.hosts.all())
+
+            # Stub replication repair so the upcoming leave cannot
+            # pre-warm the survivor before the export exercises P2P.
+            real_trigger = sched.service.seed_clients.trigger_download_task
+
+            async def no_repair(host, spec):
+                return False
+
+            sched.service.seed_clients.trigger_download_task = no_repair
+            resp = await sched.service.leave_host({"id": victim_host}, None)
+            assert resp.get("ok"), resp
+
+            # The survivor that never held the entry exports it: bytes
+            # must arrive exactly, pulled over P2P from the live replica.
+            survivor = next(d for d in alive
+                            if d._host_wire()["id"] not in holders)
+            assert survivor.task_manager.storage.try_get(task_id) is None
+            out = tmp_path / "exported.bin"
+            cfg_s = dfcache.DfcacheConfig(
+                daemon_sock=survivor.config.unix_sock, cache_id="kill-entry")
+            await dfcache.export_file(cfg_s, str(out))
+            assert out.read_bytes() == payload
+
+            # Restore repair; the GC top-up re-establishes the count
+            # without ever handing out the dead host.
+            sched.service.seed_clients.trigger_download_task = real_trigger
+            sched.service.gc()
+            assert await _wait(
+                lambda: sched.service.persistent.replica_count(task_id) >= 2)
+            assert victim_host not in {
+                p["host_id"]
+                for p in sched.service.persistent.peers_of(task_id)}
+        finally:
+            for d in alive:
+                await d.stop()
+            await sched.stop()
+
+    run_async(run(), timeout=120)
+
+
 def test_gc_repairs_under_replication(run_async):
     """A replication trigger whose download failed leaves the task under-
     replicated with no retry scheduled; the GC pass must re-check succeeded
