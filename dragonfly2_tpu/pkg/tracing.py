@@ -1,11 +1,15 @@
-"""Distributed tracing: spans, context propagation, JSONL export.
+"""Distributed tracing: spans, context propagation, JSONL + OTLP export.
 
 Reference: OpenTelemetry with a Jaeger exporter wired per binary
 (cmd/dependency/dependency.go:263-271, --jaeger flag :73) and gRPC/gin
 auto-instrumentation (otelgrpc stats handlers, scheduler/scheduler.go:95).
 This is the dependency-free analog: W3C-traceparent-style context that
 rides drpc frame metadata (daemon → scheduler → seed peer), contextvar
-scoping, and a JSONL exporter (DF_TRACE_FILE) any trace UI can ingest.
+scoping, a JSONL exporter (DF_TRACE_FILE) any trace UI can ingest, and an
+OTLP/HTTP JSON exporter (DF_TRACE_OTLP_ENDPOINT, e.g.
+``http://collector:4318``) so spans land in any standard collector —
+Jaeger, Tempo, the otel-collector — closing the observability interop the
+reference gets from its otel SDK, without taking the dependency.
 """
 
 from __future__ import annotations
@@ -13,8 +17,11 @@ from __future__ import annotations
 import contextvars
 import json
 import os
+import queue as _queue
 import secrets
+import threading
 import time
+import urllib.request
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
@@ -67,16 +74,155 @@ class Span:
                 "attrs": self.attrs, "status": self.status}
 
 
+def _otlp_attr_value(value) -> dict:
+    """Map a python attr to an OTLP AnyValue (proto3 JSON form)."""
+    if isinstance(value, bool):
+        return {"boolValue": value}
+    if isinstance(value, int):
+        return {"intValue": str(value)}   # int64 rides as a JSON string
+    if isinstance(value, float):
+        return {"doubleValue": value}
+    return {"stringValue": str(value)}
+
+
+def otlp_payload(spans: "list[Span]", service_name: str) -> dict:
+    """OTLP/JSON ExportTraceServiceRequest for ``spans``. The OTLP JSON
+    mapping special-cases trace/span ids as HEX strings (not the generic
+    proto3 base64-bytes rule), status code 1=OK 2=ERROR, and int64s as
+    decimal strings."""
+    return {"resourceSpans": [{
+        "resource": {"attributes": [
+            {"key": "service.name",
+             "value": {"stringValue": service_name}}]},
+        "scopeSpans": [{
+            "scope": {"name": "dragonfly2_tpu.pkg.tracing"},
+            "spans": [{
+                "traceId": s.context.trace_id,
+                "spanId": s.context.span_id,
+                **({"parentSpanId": s.parent_id} if s.parent_id else {}),
+                "name": s.name,
+                "kind": 1,  # SPAN_KIND_INTERNAL
+                "startTimeUnixNano": str(int(s.start * 1e9)),
+                "endTimeUnixNano": str(int(s.end * 1e9)),
+                "attributes": [{"key": k, "value": _otlp_attr_value(v)}
+                               for k, v in s.attrs.items()],
+                "status": {"code": 1 if s.status == "ok" else 2,
+                           **({} if s.status == "ok"
+                              else {"message": s.status})},
+            } for s in spans],
+        }],
+    }]}
+
+
+class OTLPExporter:
+    """Background OTLP/HTTP JSON push to ``{endpoint}/v1/traces``.
+
+    Dependency-free (urllib on a daemon thread), never blocks the traced
+    code path: finished spans enqueue; the worker batches up to
+    ``batch_max`` per POST and drops on the floor when the collector is
+    unreachable (tracing must never become a data-plane liability).
+    """
+
+    def __init__(self, endpoint: str, *, service_name: str = "",
+                 flush_interval: float = 1.0, batch_max: int = 256,
+                 timeout: float = 5.0):
+        self.endpoint = endpoint.rstrip("/")
+        self.service_name = (service_name
+                             or os.environ.get("DF_SERVICE_NAME", "")
+                             or "dragonfly2-tpu")
+        self.flush_interval = flush_interval
+        self.batch_max = batch_max
+        self.timeout = timeout
+        self.sent_spans = 0
+        self.dropped_spans = 0
+        self._q: _queue.Queue = _queue.Queue(maxsize=8192)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="df-otlp-export")
+        self._thread.start()
+
+    def enqueue(self, span: "Span") -> None:
+        try:
+            self._q.put_nowait(span)
+        except _queue.Full:
+            self.dropped_spans += 1
+
+    def _drain_batch(self) -> "list[Span]":
+        batch: list[Span] = []
+        try:
+            batch.append(self._q.get(timeout=self.flush_interval))
+            while len(batch) < self.batch_max:
+                batch.append(self._q.get_nowait())
+        except _queue.Empty:
+            pass
+        return batch
+
+    def _post(self, batch: "list[Span]") -> None:
+        body = json.dumps(
+            otlp_payload(batch, self.service_name)).encode()
+        req = urllib.request.Request(
+            f"{self.endpoint}/v1/traces", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout):
+                self.sent_spans += len(batch)
+        except OSError:
+            self.dropped_spans += len(batch)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            batch = self._drain_batch()
+            if batch:
+                try:
+                    self._post(batch)
+                except Exception:
+                    # The contract is "drop on the floor", never die: a
+                    # malformed endpoint (ValueError from urllib) must not
+                    # kill the worker and silently wedge export forever.
+                    self.dropped_spans += len(batch)
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Best-effort: wait until the queue has drained (tests, shutdown)."""
+        deadline = time.monotonic() + timeout
+        while not self._q.empty() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        time.sleep(0.05)  # let the in-flight POST finish
+
+    def close(self) -> None:
+        self.flush(timeout=2.0)
+        self._stop.set()
+
+
 class Exporter:
-    """Ring buffer + optional JSONL file (DF_TRACE_FILE or set_file())."""
+    """Ring buffer + optional JSONL file (DF_TRACE_FILE or set_file()) +
+    optional OTLP/HTTP push (DF_TRACE_OTLP_ENDPOINT or set_otlp())."""
+
+    _OTLP_UNSET = object()   # distinct from None: None = explicitly disabled
 
     def __init__(self, capacity: int = 2048):
         self.capacity = capacity
         self.spans: list[Span] = []
         self._path = os.environ.get("DF_TRACE_FILE", "")
+        self._otlp = Exporter._OTLP_UNSET
 
     def set_file(self, path: str) -> None:
         self._path = path
+
+    def set_otlp(self, endpoint: str, **kwargs) -> "OTLPExporter | None":
+        """Enable (or re-point) the OTLP push; empty endpoint disables —
+        and STAYS disabled even when DF_TRACE_OTLP_ENDPOINT is set (the
+        explicit call outranks the env default)."""
+        if isinstance(self._otlp, OTLPExporter):
+            self._otlp.close()
+        self._otlp = OTLPExporter(endpoint, **kwargs) if endpoint else None
+        return self._otlp
+
+    @property
+    def otlp(self) -> "OTLPExporter | None":
+        if self._otlp is Exporter._OTLP_UNSET:
+            endpoint = os.environ.get("DF_TRACE_OTLP_ENDPOINT", "")
+            self._otlp = OTLPExporter(endpoint) if endpoint else None
+        return self._otlp
 
     def export(self, span: Span) -> None:
         self.spans.append(span)
@@ -89,6 +235,9 @@ class Exporter:
                     f.write(json.dumps(span.to_json()) + "\n")
             except OSError:
                 pass
+        otlp = self.otlp
+        if otlp is not None:
+            otlp.enqueue(span)
 
     def find(self, name: str = "", trace_id: str = "") -> list[Span]:
         return [s for s in self.spans

@@ -87,3 +87,76 @@ def test_jsonl_export(tmp_path):
         assert rows[-1]["duration_ms"] >= 0
     finally:
         tracing.exporter().set_file("")
+
+
+def test_otlp_export_lands_in_collector(run_async):
+    """Spans reach a live OTLP/HTTP collector endpoint in the standard
+    ExportTraceServiceRequest JSON shape: hex ids, nano timestamps as
+    strings, mapped attribute types, status ERROR on failed spans
+    (reference wires the same interop through the otel SDK,
+    cmd/dependency/dependency.go:263-271)."""
+    import asyncio
+
+    from aiohttp import web
+
+    async def run():
+        received: list[dict] = []
+
+        async def v1_traces(request: web.Request) -> web.Response:
+            assert request.content_type == "application/json"
+            received.append(await request.json())
+            return web.json_response({"partialSuccess": {}})
+
+        app = web.Application()
+        app.router.add_post("/v1/traces", v1_traces)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+
+        exp = tracing.exporter()
+        otlp = exp.set_otlp(f"http://127.0.0.1:{port}",
+                            service_name="df-test", flush_interval=0.05)
+        try:
+            with tracing.span("parent", peers=3, rate=0.5, seed=True) as sp:
+                with tracing.span("child"):
+                    pass
+            try:
+                with tracing.span("broken"):
+                    raise RuntimeError("boom")
+            except RuntimeError:
+                pass
+            # Drain off-loop: the worker thread posts to THIS loop's server.
+            for _ in range(100):
+                if otlp.sent_spans >= 3:
+                    break
+                await asyncio.sleep(0.05)
+            assert otlp.sent_spans >= 3, (otlp.sent_spans, otlp.dropped_spans)
+
+            spans = [s
+                     for payload in received
+                     for rs in payload["resourceSpans"]
+                     for ss in rs["scopeSpans"]
+                     for s in ss["spans"]]
+            by_name = {s["name"]: s for s in spans}
+            assert set(by_name) >= {"parent", "child", "broken"}
+            svc = received[0]["resourceSpans"][0]["resource"]["attributes"]
+            assert {"key": "service.name",
+                    "value": {"stringValue": "df-test"}} in svc
+            parent, child = by_name["parent"], by_name["child"]
+            assert len(parent["traceId"]) == 32 and len(parent["spanId"]) == 16
+            assert child["traceId"] == parent["traceId"]
+            assert child["parentSpanId"] == parent["spanId"]
+            assert int(parent["endTimeUnixNano"]) >= int(parent["startTimeUnixNano"])
+            attrs = {a["key"]: a["value"] for a in parent["attributes"]}
+            assert attrs["peers"] == {"intValue": "3"}
+            assert attrs["rate"] == {"doubleValue": 0.5}
+            assert attrs["seed"] == {"boolValue": True}
+            assert by_name["broken"]["status"]["code"] == 2
+            assert parent["status"]["code"] == 1
+        finally:
+            exp.set_otlp("")
+            await runner.cleanup()
+
+    run_async(run())
