@@ -41,6 +41,13 @@ def _add_dfget(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--timeout", type=float, default=0.0)
     p.add_argument("--work-home", default="")
     p.add_argument("--no-daemon", action="store_true", help="never spawn a daemon")
+    p.add_argument("--scheduler", action="append", default=[],
+                   help="scheduler host:port handed to an auto-spawned "
+                        "daemon (repeatable) — a cold host joins the P2P "
+                        "fabric on first dfget (reference "
+                        "cmd/dfget/cmd/root.go:251-340)")
+    p.add_argument("--manager", default="",
+                   help="manager drpc host:port for the auto-spawned daemon")
     p.set_defaults(func=_run_dfget)
 
 
@@ -74,7 +81,8 @@ def _run_dfget(args: argparse.Namespace) -> int:
 
     async def run() -> int:
         if not args.no_daemon and not await dfget_lib.is_daemon_alive(path.daemon_sock):
-            _spawn_daemon(path, device_sink=(args.device == "tpu"))
+            _spawn_daemon(path, device_sink=(args.device == "tpu"),
+                          schedulers=args.scheduler, manager=args.manager)
             await _wait_daemon(path.daemon_sock)
         start = time.monotonic()
         state = {"last": 0}
@@ -117,11 +125,18 @@ def _run_dfget(args: argparse.Namespace) -> int:
         return 1
 
 
-def _spawn_daemon(path: Dfpath, *, device_sink: bool = False) -> None:
-    """Fork a daemon like dfget does (reference cmd/dfget/cmd/root.go:313)."""
+def _spawn_daemon(path: Dfpath, *, device_sink: bool = False,
+                  schedulers: list | None = None, manager: str = "") -> None:
+    """Fork a daemon like dfget does (reference cmd/dfget/cmd/root.go:313).
+    Scheduler/manager addresses thread through so a COLD host's first
+    dfget joins the P2P fabric, not just a local-cache daemon."""
     path.ensure()
     cmd = [sys.executable, "-m", "dragonfly2_tpu.cli.main", "daemon",
            "--work-home", path.root]
+    for addr in schedulers or []:
+        cmd += ["--scheduler", addr]
+    if manager:
+        cmd += ["--manager", manager]
     if device_sink:
         cmd.append("--device-sink")
     with open(os.path.join(path.log_dir, "daemon-spawn.log"), "ab") as logf:

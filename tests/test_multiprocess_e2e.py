@@ -318,6 +318,51 @@ def test_multiprocess_fanout(run_async, tmp_path):
     run_async(run())
 
 
+def test_dfget_cold_host_auto_spawn_joins_p2p(run_async, tmp_path):
+    """A COLD host (no daemon running, empty work-home) runs plain dfget
+    with --scheduler: the CLI health-checks the socket, forks a daemon
+    wired to the scheduler, waits for the handshake, and the download
+    rides P2P (p2p=True) off the seed — mirroring
+    cmd/dfget/cmd/root.go:251-340 where dfget spawns dfdaemon on demand.
+    Direct-source remains the final fallback but must NOT be what happens
+    here."""
+
+    async def run():
+        runner, origin_port, stats = await _start_origin()
+        fab = _Fabric(tmp_path, peers=())
+        spawned_home = str(tmp_path / "coldhost")
+        try:
+            await fab.start()   # scheduler + seed only
+            url = f"http://127.0.0.1:{origin_port}/model.bin"
+            # Warm the seed so the cold host's pull is served P2P.
+            warm = str(tmp_path / "warm.bin")
+            await fab.await_dfget(fab.dfget("seed", url, warm,
+                                            with_digest=False), warm)
+            bytes_warm = stats["bytes"]
+
+            out = str(tmp_path / "cold.bin")
+            p = _spawn(
+                ["dfget", url, "-O", out, "--work-home", spawned_home,
+                 "--scheduler", f"127.0.0.1:{fab.sched_port}"],
+                out + ".log")
+            rc = await asyncio.to_thread(p.wait, 120)
+            log_text = open(out + ".log").read()
+            assert rc == 0, log_text[-2000:]
+            with open(out, "rb") as f:
+                assert hashlib.sha256(f.read()).hexdigest() == SHA
+            assert "spawned daemon" in log_text, log_text[-1000:]
+            assert "p2p=True" in log_text, log_text[-1000:]
+            # Pure P2P: the cold host's pull added no origin traffic.
+            assert stats["bytes"] == bytes_warm, stats
+        finally:
+            subprocess.run(["pkill", "-f", spawned_home],
+                           capture_output=True)
+            await fab.teardown()
+            await runner.cleanup()
+
+    run_async(run(), timeout=240)
+
+
 def test_multiprocess_seed_death(run_async, tmp_path):
     """SIGKILL the seed PROCESS mid-transfer: both peers still land
     sha-exact (reschedule onto each other + bounded back-source), and the
