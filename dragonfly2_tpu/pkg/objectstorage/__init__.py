@@ -36,13 +36,17 @@ def new_client(name: str, **kwargs) -> ObjectStorage:
         from dragonfly2_tpu.pkg.objectstorage.gcs import GCSObjectStorage
 
         return GCSObjectStorage(**kwargs)
-    if name in ("oss", "obs"):
-        # OSS/OBS speak S3-compatible APIs at vendor endpoints; the SigV4
-        # client covers them (reference ships separate SDK wrappers —
-        # oss.go/obs.go — because the Go SDKs differ, not the wire).
-        from dragonfly2_tpu.pkg.objectstorage.s3 import S3ObjectStorage
+    if name == "oss":
+        # Native vendor auth (HMAC-SHA1 headers). An OSS bucket reached
+        # through its S3-COMPATIBLE endpoint should use backend "s3"
+        # (SigV4) instead — the two schemes are not interchangeable.
+        from dragonfly2_tpu.pkg.objectstorage.oss import OSSObjectStorage
 
-        return S3ObjectStorage(**kwargs)
+        return OSSObjectStorage(**kwargs)
+    if name == "obs":
+        from dragonfly2_tpu.pkg.objectstorage.obs import OBSObjectStorage
+
+        return OBSObjectStorage(**kwargs)
     raise ObjectStorageError(f"unknown object storage backend {name!r}")
 
 

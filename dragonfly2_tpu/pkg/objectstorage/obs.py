@@ -1,0 +1,19 @@
+"""Huawei OBS object-storage backend (native header auth).
+
+Reference: pkg/objectstorage/obs.go (278 LoC over esdk-obs-go). OBS's
+native scheme is the same HMAC-SHA1 construction as Aliyun OSS with the
+vendor constants swapped — ``Authorization: OBS ak:sig`` and ``x-obs-*``
+canonicalized headers — so the client is the OSS one re-tagged (the
+reference carries a second 278-line wrapper only because the vendor Go
+SDKs differ; the wire shape does not).
+"""
+
+from __future__ import annotations
+
+from dragonfly2_tpu.pkg.objectstorage.oss import OSSObjectStorage
+
+
+class OBSObjectStorage(OSSObjectStorage):
+    name = "obs"
+    AUTH_SCHEME = "OBS"
+    HEADER_PREFIX = "x-obs-"
