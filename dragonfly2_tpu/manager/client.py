@@ -69,6 +69,15 @@ class ManagerClient:
                                        timeout=timeout + 10.0)
         return resp.get("item")
 
+    async def take_job_tokens(self, cluster_ids: list, tokens: int = 1) -> dict:
+        """Draw from the manager-coordinated per-cluster job buckets — the
+        shared budget every scheduler instance and the REST face debit
+        (reference internal/ratelimiter's Redis bucket). Returns
+        {granted, retry_after_s}."""
+        return await self._client.call(
+            "Manager.TakeJobTokens",
+            {"cluster_ids": cluster_ids, "tokens": tokens}, timeout=10.0)
+
     async def complete_job(self, group_id: str, task_uuid: str, state: str,
                            result: dict[str, Any]) -> None:
         await self._client.call("Manager.CompleteJob", {

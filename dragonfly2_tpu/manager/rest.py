@@ -336,10 +336,25 @@ class RestServer:
             return web.json_response({"message": f"unknown job type {job_type}"},
                                      status=400)
         args = body.get("args", {})
-        if job_type == jobqueue.PREHEAT_JOB:
-            args = await expand_preheat_args(args)
         cluster_ids = body.get("scheduler_cluster_ids") or [
             c["id"] for c in self.service.db.list("scheduler_clusters")]
+        # Per-cluster job rate limit (reference
+        # manager/middlewares/ratelimiter.go CreateJobRateLimiter → 429).
+        # BEFORE the preheat expansion: image preheats fetch registry
+        # manifests, and a limited client must not amplify into outbound
+        # fetches. Retry-After is integer delta-seconds (RFC 9110);
+        # the precise wait rides the body.
+        granted, retry_after = self.service.take_job_tokens(cluster_ids)
+        if not granted:
+            import math
+
+            return web.json_response(
+                {"message": "rate limit exceeded",
+                 "retry_after_s": round(retry_after, 3)},
+                status=429,
+                headers={"Retry-After": str(max(1, math.ceil(retry_after)))})
+        if job_type == jobqueue.PREHEAT_JOB:
+            args = await expand_preheat_args(args)
         job = self.service.jobs.enqueue_job(
             job_type, args, cluster_ids,
             user_id=request["identity"]["uid"], bio=body.get("bio", ""))

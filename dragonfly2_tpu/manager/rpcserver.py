@@ -35,6 +35,7 @@ class ManagerRpcServer:
         server.register_unary("Manager.UpsertPeer", self._upsert_peer)
         server.register_unary("Manager.PollJob", self._poll_job)
         server.register_unary("Manager.CompleteJob", self._complete_job)
+        server.register_unary("Manager.TakeJobTokens", self._take_job_tokens)
         server.register_stream("Manager.KeepAlive", self._keep_alive)
 
     async def _get_scheduler(self, body: dict, ctx: RpcContext) -> dict:
@@ -89,6 +90,15 @@ class ManagerRpcServer:
             body["group_id"], body["task_uuid"],
             body.get("state", jobqueue.SUCCESS), body.get("result", {}))
         return {}
+
+    async def _take_job_tokens(self, body: dict, ctx: RpcContext) -> dict:
+        """Distributed job rate limiting: every scheduler instance draws
+        from the SAME per-cluster bucket the REST face debits (reference
+        internal/ratelimiter — Redis-coordinated there, manager-coordinated
+        here; the manager is this deployment's shared point)."""
+        granted, retry_after = self.service.take_job_tokens(
+            body.get("cluster_ids") or [], int(body.get("tokens", 1)))
+        return {"granted": granted, "retry_after_s": retry_after}
 
     async def _keep_alive(self, stream: ServerStream, ctx: RpcContext) -> None:
         """Open body: {source_type, hostname, ip, cluster_id}. Each further

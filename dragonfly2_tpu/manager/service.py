@@ -50,6 +50,14 @@ class ManagerService:
         # Keepalive stream generations: the newest stream per instance owns
         # liveness; stale stream teardowns must not flip an instance inactive.
         self._ka_gen: dict[tuple, int] = {}
+        # Per-scheduler-cluster job token buckets (reference
+        # internal/ratelimiter/job_ratelimiter.go + the Redis-backed
+        # distributed limiter). The manager IS this deployment's shared
+        # coordination point — every job enters through its REST API or
+        # drpc queue, so a bucket here bounds the whole fleet's job rate
+        # the way the reference's Redis bucket bounds its manager
+        # replicas'. Keyed (rate, Limiter) so a config change rebuilds.
+        self._job_limiters: dict[int, tuple[float, "Limiter"]] = {}
         self._ensure_defaults()
 
     def _ensure_defaults(self) -> None:
@@ -72,6 +80,56 @@ class ManagerService:
                 "config": {"load_limit": 2000},
             })
             self.db.link_seed_peer_cluster(sc["id"], spc["id"])
+
+    # -- distributed job rate limiting -------------------------------------
+
+    # Reference manager/config/constants.go:112: default 10 job requests
+    # per second per scheduler cluster.
+    DEFAULT_JOB_RATE_LIMIT = 10.0
+
+    def take_job_tokens(self, cluster_ids, tokens: int = 1) -> tuple[bool, float]:
+        """Draw ``tokens`` from EVERY listed cluster's job bucket
+        (reference job_ratelimiter.go TakeByClusterIDs), all-or-nothing:
+        a deny debits NO bucket, so 429'd retries against a mixed cluster
+        list cannot starve the healthy clusters' budgets. Returns
+        (granted, retry_after_s). The per-cluster rate comes live from the
+        cluster config key ``job_rate_limit`` so an operator PATCH takes
+        effect on the next take (retuned in place — lowering the limit
+        must not hand the runaway client a fresh burst); the reference
+        refreshes from its DB on a 10-minute tick. Callers on the REST
+        face map a denial to HTTP 429; drpc callers (scheduler job
+        workers of the same cluster) share the identical buckets, which
+        is what makes the limit hold ACROSS scheduler instances.
+        Synchronous on the event loop: check-all then debit-all is
+        atomic."""
+        from dragonfly2_tpu.pkg.ratelimit import Limiter
+
+        tokens = max(1, int(tokens))  # negative/zero must never credit
+        limiters: list[Limiter] = []
+        retry_after = 0.0
+        for cid in cluster_ids:
+            cluster = self.db.get("scheduler_clusters", int(cid))
+            if cluster is None:
+                continue
+            rate = float((cluster.get("config") or {}).get(
+                "job_rate_limit", self.DEFAULT_JOB_RATE_LIMIT))
+            cached = self._job_limiters.get(int(cid))
+            if cached is None:
+                cached = (rate, Limiter(rate, burst=max(1, int(rate))))
+                self._job_limiters[int(cid)] = cached
+            elif cached[0] != rate:
+                cached[1].set_limit(rate, burst=max(1, int(rate)))
+                cached = (rate, cached[1])
+                self._job_limiters[int(cid)] = cached
+            if not cached[1].can_allow(tokens):
+                retry_after = max(retry_after,
+                                  tokens / max(rate, 1e-9), 0.05)
+            limiters.append(cached[1])
+        if retry_after > 0:
+            return False, retry_after
+        for lim in limiters:
+            lim.allow(tokens)
+        return True, 0.0
 
     # -- users / auth ------------------------------------------------------
 

@@ -17,3 +17,4 @@ class OBSObjectStorage(OSSObjectStorage):
     name = "obs"
     AUTH_SCHEME = "OBS"
     HEADER_PREFIX = "x-obs-"
+    PRESIGN_TOKEN_PARAM = "x-obs-security-token"

@@ -41,6 +41,9 @@ class OSSObjectStorage(ObjectStorage):
     name = "oss"
     AUTH_SCHEME = "OSS"            # Authorization header scheme tag
     HEADER_PREFIX = "x-oss-"       # canonicalized vendor-header prefix
+    # Query param carrying the STS token on URL-auth presigns: Aliyun
+    # expects the bare name; Huawei expects the prefixed one (obs.py).
+    PRESIGN_TOKEN_PARAM = "security-token"
 
     def __init__(self, *, endpoint: str, access_key: str = "",
                  secret_key: str = "", security_token: str = "",
@@ -209,8 +212,9 @@ class OSSObjectStorage(ObjectStorage):
         signed_resource = resource
         token_param = ""
         if self.security_token:
-            signed_resource += f"?security-token={self.security_token}"
-            token_param = ("&security-token="
+            signed_resource += (f"?{self.PRESIGN_TOKEN_PARAM}="
+                                f"{self.security_token}")
+            token_param = (f"&{self.PRESIGN_TOKEN_PARAM}="
                            + quote(self.security_token, safe=""))
         to_sign = "\n".join(["GET", "", "", deadline]) + "\n" + signed_resource
         sig = quote(self._signature(to_sign), safe="")

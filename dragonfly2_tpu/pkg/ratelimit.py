@@ -63,6 +63,13 @@ class Limiter:
             return True
         return False
 
+    def can_allow(self, n: int = 1) -> bool:
+        """Non-mutating: would allow(n) succeed right now? Lets callers
+        check SEVERAL buckets before debiting any (all-or-nothing takes
+        across clusters must not drain earlier buckets on a later deny)."""
+        self._advance()
+        return self._tokens >= n
+
     async def wait(self, n: int = 1) -> float:
         """Block until ``n`` tokens are available; returns seconds waited."""
         if self._limit == INF:

@@ -227,6 +227,8 @@ UNARY: dict[str, Msg] = {
         "CompleteJob",
         group_id=F(str, required=True), task_uuid=F(str, required=True),
         state=F(str), result=F(dict)),
+    "Manager.TakeJobTokens": Msg(
+        "TakeJobTokens", cluster_ids=F(list, required=True), tokens=F(int)),
 }
 
 # --------------------------------------------------------------------- #
