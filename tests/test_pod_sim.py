@@ -9,6 +9,8 @@ latency, and event-loop stall bounds.
 
 from __future__ import annotations
 
+import asyncio
+
 import sys
 
 from benchmarks.pod_sim_bench import check, check_churn, run_sim
@@ -43,7 +45,9 @@ def test_pod_sim_1024_hosts_sustained_churn(run_async):
     lag 7.8 ms / RSS +5 MiB on the 1-core CI host)."""
 
     async def body():
-        for attempt in range(2):   # see test_pod_sim_96_hosts
+        for attempt in range(3):   # see test_pod_sim_96_hosts; the 1024-host
+            # storm is the most load-sensitive test in the suite, so give
+            # an external CPU spike time to pass between attempts.
             try:
                 result = await run_sim(1024, piece_latency_s=0.001,
                                        arrival_window_s=0.5, churn=True,
@@ -52,8 +56,9 @@ def test_pod_sim_1024_hosts_sustained_churn(run_async):
                 assert result["schedule_p99_ms"] < 2000, result
                 return
             except AssertionError:
-                if attempt:
+                if attempt == 2:
                     raise
+                await asyncio.sleep(3)
 
     run_async(body(), timeout=360)
 
