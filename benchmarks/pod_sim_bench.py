@@ -127,6 +127,7 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
     finished: set[int] = set()
     max_lag = 0.0
     dead_peer_ids: set[str] = set()
+    dead_by_slice: dict[int, int] = {k: 0 for k in killed_slice_ids}
     straggler_dead_picks = 0
     straggler_pick_count = 0
     rss_start = _rss_mb()
@@ -223,6 +224,8 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
                     # finish, no goodbye — the scheduler's stream-gone
                     # path must reap this peer from the DAG.
                     dead_peer_ids.add(body["peer_id"])
+                    dead_by_slice[i // HOSTS_PER_SLICE] = \
+                        dead_by_slice.get(i // HOSTS_PER_SLICE, 0) + 1
                     return
                 await asyncio.sleep(piece_latency_s * rng.uniform(0.5, 1.5))
                 await stream.to_sched.put({
@@ -242,6 +245,15 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
             await stream.to_sched.put(None)
             await asyncio.wait_for(server, timeout=300)
 
+    # Freeze whatever heap the hosting process already carries (a full
+    # pytest run drags ~700 MB of prior-test objects): cyclic-GC passes
+    # over that inherited heap otherwise dominate measured loop lag, and
+    # this benchmark is about the SCHEDULER's lag, not the host process's
+    # garbage. Unfrozen on exit.
+    import gc
+
+    gc.collect()
+    gc.freeze()
     hb = asyncio.ensure_future(heartbeat())
     t0 = time.perf_counter()
     try:
@@ -258,10 +270,17 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
         waves = [delayed(i) for i in range(n_hosts)]
         for w, k in enumerate(killed_slice_ids):
             async def straggle(i, k=k, w=w):
-                # Join AFTER this wave's kill window, into the killed
-                # slice; waves stagger so churn stays sustained.
-                await asyncio.sleep(0.25 + arrival_window_s
-                                    + 0.4 * w + rng.uniform(0.2, 0.6))
+                # Join AFTER this wave's kills have actually LANDED —
+                # gating on the observed dead count, not wall time, keeps
+                # the no-dead-parent invariant sharp under any host load
+                # (a fixed sleep races the kills when the loop lags);
+                # waves still stagger via their own kill completion.
+                deadline = asyncio.get_running_loop().time() + 300
+                while dead_by_slice.get(k, 0) < HOSTS_PER_SLICE:
+                    if asyncio.get_running_loop().time() > deadline:
+                        raise AssertionError(f"slice {k} kills never landed")
+                    await asyncio.sleep(0.05)
+                await asyncio.sleep(rng.uniform(0.05, 0.3))
                 await peer(i, straggler_into=k)
 
             base = n_hosts + w * HOSTS_PER_SLICE
@@ -269,6 +288,7 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
         await asyncio.wait_for(asyncio.gather(*waves), timeout=900)
     finally:
         hb.cancel()
+        gc.unfreeze()
     wall = time.perf_counter() - t0
     rss_peak = _resource.getrusage(_resource.RUSAGE_SELF).ru_maxrss / 1024
 
