@@ -278,9 +278,11 @@ async def run_bench(total_mb: int, n_peers: int, workdir: str,
             # n_peers × sha256 on the shared core would bill verification
             # to the delivery plane.
             for i in range(n_peers):
+                h = hashlib.sha256()   # file_digest needs 3.11; run on 3.10
                 with open(os.path.join(workdir, f"out{i}.bin"), "rb") as f:
-                    actual = hashlib.file_digest(f, "sha256").hexdigest()
-                if actual != sha:
+                    for chunk in iter(lambda: f.read(4 << 20), b""):
+                        h.update(chunk)
+                if h.hexdigest() != sha:
                     raise RuntimeError(f"client {i} sha mismatch")
 
         profiles: dict[str, str] = {}

@@ -203,12 +203,16 @@ async def _pull_ranges(daemon, url: str, ranges, *, tag: str = "",
             range_header=f"{s0}-{s1 - 1}")
         landed[(s0, s1)] = result.as_bytes_array()
 
+    # First failure cancels the sibling pulls and re-raises plain (the
+    # TaskGroup/ExceptionGroup shape needs 3.11; this runs on 3.10 too).
+    tasks = [asyncio.ensure_future(pull(s0, s1)) for s0, s1 in ranges]
     try:
-        async with asyncio.TaskGroup() as tg:
-            for s0, s1 in ranges:
-                tg.create_task(pull(s0, s1))
-    except BaseExceptionGroup as eg:
-        raise eg.exceptions[0] from eg
+        await asyncio.gather(*tasks)
+    except BaseException:
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        raise
     return landed
 
 

@@ -222,15 +222,17 @@ class PeerTaskConductor:
         self._apply_task_meta(task_wire)
         try:
             if piece.piece_num not in self.store.metadata.pieces:
-                data, cost_ms = await self.downloader.download_piece(
-                    host.get("ip", ""), host.get("upload_port", 0),
-                    self.task_id, piece.piece_num,
-                    src_peer_id=parent.get("id", ""),
-                    expected_size=piece.range_size)
-                await self.limiter.wait(len(data))
-                rec = self.store.write_piece(piece.piece_num, data,
-                                             expected_digest=piece.digest,
-                                             cost_ms=cost_ms)
+                chunks, size, cost_ms, received_digest = \
+                    await self.downloader.download_piece(
+                        host.get("ip", ""), host.get("upload_port", 0),
+                        self.task_id, piece.piece_num,
+                        src_peer_id=parent.get("id", ""),
+                        expected_size=piece.range_size,
+                        expected_digest=piece.digest)
+                await self.limiter.wait(size)
+                rec = self.store.write_piece_chunks(
+                    piece.piece_num, chunks, received_digest,
+                    expected_digest=piece.digest, cost_ms=cost_ms)
                 await self._report_piece(rec, parent_id=parent.get("id", ""))
                 if self.on_piece is not None:
                     await self.on_piece(self.store, rec)

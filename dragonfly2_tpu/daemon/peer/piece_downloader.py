@@ -28,6 +28,53 @@ from dragonfly2_tpu.storage.local_store import _native
 
 log = dflog.get("peer.piece_downloader")
 
+_RECV_CHUNK = 256 << 10
+
+
+async def assemble_piece(chunks, expected_size: int,
+                         expected_digest: str = "",
+                         ) -> "tuple[list, int, str]":
+    """Drain an async chunk iterator into the list of chunks exactly as
+    the wire delivered them — no assembly buffer, no concatenation copy;
+    the store lands them with one pwritev (write_piece_chunks). Returns
+    ``(chunks, size, digest_str)``.
+
+    ``digest_str`` is the piece digest computed WHILE the bytes arrived
+    (reference Dragonfly2 streams through a digest reader —
+    pkg/digest/digest_reader.go — instead of re-hashing a landed copy),
+    for algorithms the store cannot fuse into the write (md5/sha*, or no
+    native lib). For native crc32c — the fleet default — it is "" and the
+    store checksums each chunk WHILE pwriting it (seeded fused walk), so
+    hash+write cost one memory pass total. Either way verification
+    happens at the store's single commit point and never re-reads landed
+    bytes; size mismatches raise here."""
+    algorithm = ""
+    if expected_digest:
+        try:
+            algorithm = pkgdigest.parse(expected_digest).algorithm
+        except pkgdigest.InvalidDigestError:
+            raise DfError(Code.ClientPieceDownloadFail,
+                          f"malformed digest {expected_digest!r}")
+    hasher = None
+    if algorithm and not (algorithm == pkgdigest.ALGORITHM_CRC32C
+                          and _native() is not None):
+        hasher = pkgdigest.new_hasher(algorithm)
+    out: list = []
+    got = 0
+    async for chunk in chunks:
+        if expected_size >= 0 and got + len(chunk) > expected_size:
+            raise DfError(Code.ClientPieceDownloadFail,
+                          f"body exceeds expected size {expected_size}")
+        out.append(chunk)
+        got += len(chunk)
+        if hasher is not None:
+            hasher.update(chunk)
+    if expected_size >= 0 and got != expected_size:
+        raise DfError(Code.ClientPieceDownloadFail,
+                      f"body size {got} != expected {expected_size}")
+    digest_str = f"{algorithm}:{hasher.hexdigest()}" if hasher else ""
+    return out, got, digest_str
+
 _NATIVE_EXECUTOR: concurrent.futures.ThreadPoolExecutor | None = None
 
 
@@ -197,8 +244,12 @@ class PieceDownloader:
 
     async def download_piece(self, parent_ip: str, parent_upload_port: int,
                              task_id: str, piece_num: int, *, src_peer_id: str = "",
-                             expected_size: int = -1) -> tuple[bytes, int]:
-        """Fetch one piece; returns (data, cost_ms)."""
+                             expected_size: int = -1,
+                             expected_digest: str = "") -> tuple[list, int, int, str]:
+        """Fetch one piece; returns (chunks, size, cost_ms, digest_str) —
+        the body as wire chunks plus the streaming digest (see
+        assemble_piece). Land with store.write_piece_chunks, which
+        verifies at the commit point with no second pass and no re-read."""
         url = (f"http://{parent_ip}:{parent_upload_port}"
                f"/download/{task_id[:3]}/{task_id}")
         start = time.monotonic()
@@ -217,15 +268,14 @@ class PieceDownloader:
                 if resp.status not in (200, 206):
                     raise DfError(Code.ClientPieceRequestFail,
                                   f"parent returned {resp.status} for piece {piece_num}")
-                data = await resp.read()
+                chunks, size, digest_str = await assemble_piece(
+                    resp.content.iter_chunked(_RECV_CHUNK), expected_size,
+                    expected_digest)
         except aiohttp.ClientError as e:
             raise DfError(Code.ClientPieceRequestFail,
                           f"piece {piece_num} from {parent_ip}:{parent_upload_port}: {e}")
-        if expected_size >= 0 and len(data) != expected_size:
-            raise DfError(Code.ClientPieceDownloadFail,
-                          f"piece {piece_num} size {len(data)} != expected {expected_size}")
         cost_ms = int((time.monotonic() - start) * 1000)
-        return data, cost_ms
+        return chunks, size, cost_ms, digest_str
 
     async def download_piece_to_store(self, parent_ip: str,
                                       parent_upload_port: int, task_id: str,
@@ -522,13 +572,16 @@ async def pull_one_piece(downloader: PieceDownloader, store, dispatcher,
         expected_digest=assignment.digest)
     if rec is not None:
         return rec
-    data, cost_ms = await downloader.download_piece(
+    chunks, _size, cost_ms, received_digest = await downloader.download_piece(
         assignment.parent.ip, assignment.parent.upload_port,
         task_id, assignment.piece_num,
-        src_peer_id=peer_id, expected_size=assignment.expected_size)
-    # Thread offload: the fused crc+pwrite is a GIL-releasing native call;
-    # inline it would block the event loop (and this daemon's own upload
-    # serving) for the disk write of every 4 MiB piece.
+        src_peer_id=peer_id, expected_size=assignment.expected_size,
+        expected_digest=assignment.digest)
+    # Thread offload: the write blocks on disk; inline it would stall the
+    # event loop (and this daemon's own upload serving) per 4 MiB piece.
+    # The chunks land via one pwritev (crc fused into the write, or
+    # verified against the digest streamed during receive) — single pass,
+    # no assembly copy, no store re-read.
     return await asyncio.to_thread(
-        store.write_piece, assignment.piece_num, data,
-        expected_digest=assignment.digest, cost_ms=cost_ms)
+        store.write_piece_chunks, assignment.piece_num, chunks,
+        received_digest, expected_digest=assignment.digest, cost_ms=cost_ms)

@@ -254,19 +254,52 @@ class PieceManager:
         resp = await client.download(req)
         piece_size = store.metadata.piece_size
         num = 0
-        buf = bytearray()
         total = 0
+        # Zero-copy carve: piece boundaries are memoryview windows over the
+        # wire chunks exactly as they arrived — no assembly bytearray, no
+        # bytes() copy, no O(piece) del-memmove. The store lands each
+        # window list with the per-piece digest FUSED into the write
+        # (write_piece_chunks: seeded crc while pwriting — one memory walk
+        # for hash+write; digest_reader.go single-pass parity).
+        views: list[memoryview] = []
+        filled = 0
         start = time.monotonic()
+        # Depth-1 landing pipeline: piece N's write+digest runs in a worker
+        # thread (GIL released in the native crc+pwrite and the sha feed)
+        # WHILE the loop receives piece N+1's chunks — wall becomes
+        # max(receive, hash+write) instead of their sum on a busy core.
+        # Exactly one landing is in flight, awaited before the next
+        # launches, so commits (and the prefix-hasher's in-memory frontier
+        # feed) stay in piece order.
+        pending: "asyncio.Future | None" = None
         try:
-            async for chunk in resp.body:
-                buf += chunk
-                total += len(chunk)
-                while len(buf) >= piece_size:
-                    data = bytes(buf[:piece_size])
-                    del buf[:piece_size]
-                    await self._write_piece(store, num, data, on_piece, limiter, start)
-                    num += 1
-                    start = time.monotonic()
+            try:
+                async for chunk in resp.body:
+                    total += len(chunk)
+                    cv = memoryview(chunk)
+                    while len(cv):
+                        take = min(piece_size - filled, len(cv))
+                        views.append(cv[:take])
+                        cv = cv[take:]
+                        filled += take
+                        if filled == piece_size:
+                            if pending is not None:
+                                await pending
+                            pending = asyncio.ensure_future(
+                                self._land_piece_chunks(
+                                    store, num, views, piece_size,
+                                    on_piece, limiter, start))
+                            num += 1
+                            views, filled = [], 0
+                            start = time.monotonic()
+                if pending is not None:
+                    await pending
+                    pending = None
+            except BaseException:
+                if pending is not None:
+                    pending.cancel()
+                    await asyncio.gather(pending, return_exceptions=True)
+                raise
         finally:
             await resp.close()
         # Length check BEFORE the trailing partial piece lands: a dropped
@@ -274,8 +307,9 @@ class PieceManager:
         if known_length >= 0 and total != known_length:
             raise SourceError(f"origin returned {total} bytes, expected {known_length}",
                               Code.BackToSourceAborted, temporary=True)
-        if buf:
-            await self._write_piece(store, num, bytes(buf), on_piece, limiter, start)
+        if views:
+            await self._land_piece_chunks(
+                store, num, views, filled, on_piece, limiter, start)
             num += 1
         if known_length < 0:
             # Learned the length at EOF (reference downloadUnknownLengthSource
@@ -332,19 +366,47 @@ class PieceManager:
                 raise SourceError("origin ignored range request",
                                   Code.SourceRangeUnsupported, temporary=True)
             num = first
-            buf = bytearray()
             got = 0
+            # Same zero-copy carve as the sequential path; the group's
+            # LAST piece accumulates to EOF (its size is the range
+            # remainder) and lands only after the length check below.
+            views: list[memoryview] = []
+            filled = 0
             t0 = time.monotonic()
+            # Depth-1 landing pipeline per group (see _download_streaming).
+            pending: "asyncio.Future | None" = None
             try:
-                async for chunk in resp.body:
-                    buf += chunk
-                    got += len(chunk)
-                    while len(buf) >= m.piece_size and num < last - 1:
-                        data = bytes(buf[: m.piece_size])
-                        del buf[: m.piece_size]
-                        await self._write_piece(store, num, data, on_piece, limiter, t0)
-                        num += 1
-                        t0 = time.monotonic()
+                try:
+                    async for chunk in resp.body:
+                        got += len(chunk)
+                        cv = memoryview(chunk)
+                        while len(cv):
+                            if num >= last - 1:
+                                views.append(cv)
+                                filled += len(cv)
+                                break
+                            take = min(m.piece_size - filled, len(cv))
+                            views.append(cv[:take])
+                            cv = cv[take:]
+                            filled += take
+                            if filled == m.piece_size:
+                                if pending is not None:
+                                    await pending
+                                pending = asyncio.ensure_future(
+                                    self._land_piece_chunks(
+                                        store, num, views, m.piece_size,
+                                        on_piece, limiter, t0))
+                                num += 1
+                                views, filled = [], 0
+                                t0 = time.monotonic()
+                    if pending is not None:
+                        await pending
+                        pending = None
+                except BaseException:
+                    if pending is not None:
+                        pending.cancel()
+                        await asyncio.gather(pending, return_exceptions=True)
+                    raise
             finally:
                 await resp.close()
             # Length check first — a short stream must not persist its
@@ -352,8 +414,9 @@ class PieceManager:
             if got != byte_len:
                 raise SourceError(f"group [{first},{last}) got {got} bytes, want {byte_len}",
                                   Code.BackToSourceAborted, temporary=True)
-            if buf:
-                await self._write_piece(store, num, bytes(buf), on_piece, limiter, t0)
+            if views:
+                await self._land_piece_chunks(
+                    store, num, views, filled, on_piece, limiter, t0)
                 num += 1
 
         results = await asyncio.gather(
@@ -364,6 +427,27 @@ class PieceManager:
             raise errors[0]
 
     # -- shared piece writer -----------------------------------------------
+
+    async def _land_piece_chunks(
+        self,
+        store: LocalTaskStore,
+        num: int,
+        views: list,
+        size: int,
+        on_piece: PieceCallback | None,
+        limiter: Limiter,
+        started_at: float,
+    ) -> None:
+        """Land a carved piece: one write_piece_chunks call (digest fused
+        into the write) — off-loop, because it still blocks on disk."""
+        await limiter.wait(size)
+        cost_ms = int((time.monotonic() - started_at) * 1000)
+        if store.has_piece(num):
+            return   # resume overlap: bytes already verified on disk
+        rec = await asyncio.to_thread(
+            store.write_piece_chunks, num, views, cost_ms=cost_ms)
+        if on_piece is not None:
+            await on_piece(store, rec)
 
     async def _write_piece(
         self,

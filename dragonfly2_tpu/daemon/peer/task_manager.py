@@ -890,23 +890,28 @@ class TaskManager:
         return data[lo - piece_offset:hi - piece_offset]
 
     async def _stream_from_store(self, store, rng: Range | None) -> AsyncIterator[bytes]:
-        """Completed task: emit ordered pieces straight off disk, touching
-        only pieces that intersect the range (a tail range on a multi-GiB
-        blob must not read the whole file)."""
+        """Completed task: emit the requested window straight off disk in
+        bounded spans (read_range — contiguous on a complete store),
+        touching only the bytes that intersect the range. The old per-piece
+        read + slice re-copied every partially-overlapping piece; span
+        reads walk the window's memory once."""
         store.pin()
         try:
             m = store.metadata
-            start_num = 0
-            if rng is not None and m.piece_size > 0:
-                start_num = rng.start // m.piece_size
-            for num in range(start_num, max(m.total_piece_count, 0)):
-                data = store.read_piece(num)
-                chunk = self._slice_piece(data, num * m.piece_size, rng)
-                if chunk:
-                    yield chunk
-                if (rng is not None and rng.length >= 0 and m.piece_size > 0
-                        and (num + 1) * m.piece_size >= rng.start + rng.length):
-                    return
+            end = m.content_length if m.content_length >= 0 else \
+                store.disk_usage()
+            start = 0
+            if rng is not None:
+                start = min(rng.start, end)
+                if rng.length >= 0:
+                    end = min(end, rng.start + rng.length)
+            span = max(m.piece_size, 1 << 20)
+            off = start
+            while off < end:
+                take = min(span, end - off)
+                chunk = await asyncio.to_thread(store.read_range, off, take)
+                yield chunk
+                off += take
         finally:
             store.unpin()
 
@@ -1040,6 +1045,10 @@ class TaskManager:
                  start=rng.start, length=rng.length)
         try:
             with parent:  # pin: GC must not reclaim the parent mid-import
+                from dragonfly2_tpu.storage.local_store import (
+                    release_read_buffer,
+                )
+
                 for n in range(store.metadata.total_piece_count):
                     if n in store.metadata.pieces:
                         continue   # resume semantics match back-source
@@ -1047,7 +1056,13 @@ class TaskManager:
                     size = min(piece_size, rng.length - off)
                     data = await asyncio.to_thread(
                         parent.read_range, rng.start + off, size)
-                    rec = await asyncio.to_thread(store.write_piece, n, data)
+                    # Pooled view: written (and digested) in one pass,
+                    # then recycled for the next piece's read.
+                    try:
+                        rec = await asyncio.to_thread(
+                            store.write_piece, n, data)
+                    finally:
+                        release_read_buffer(data)
                     if on_piece is not None:
                         await on_piece(store, rec)
         except (StorageError, OSError) as e:

@@ -6,6 +6,12 @@ limiting (WithLimiter :79). Piece payloads ride HTTP (not drpc) exactly like
 the reference, so transfers stream zero-copy from the page cache via
 sendfile-ish paths and any HTTP client can fetch.
 
+Serving is the READ half of the zero-copy data plane (docs/ZERO_COPY.md):
+both servers move piece bytes kernel→socket without them ever entering
+Python — _PieceFileResponse rides aiohttp's sendfile, the native server
+(native/src/dfupload.cc) does its own sendfile loop — so the daemon's
+single hot core spends its cycles on the receive/verify side only.
+
 Routes:
   GET /download/{task_prefix}/{task_id}?peerId=...          Range: bytes=a-b
   GET /download/{task_prefix}/{task_id}?peerId=...&pieceNum=N   (whole piece)

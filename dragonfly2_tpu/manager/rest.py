@@ -338,6 +338,15 @@ class RestServer:
         args = body.get("args", {})
         cluster_ids = body.get("scheduler_cluster_ids") or [
             c["id"] for c in self.service.db.list("scheduler_clusters")]
+        try:
+            # Coerce up front ("3" and 3 both fine): a malformed entry is a
+            # client error, not a 500 from deep inside the limiter.
+            cluster_ids = [int(cid) for cid in cluster_ids]
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"message": f"malformed scheduler_cluster_ids: "
+                            f"{body.get('scheduler_cluster_ids')!r}"},
+                status=400)
         # Per-cluster job rate limit (reference
         # manager/middlewares/ratelimiter.go CreateJobRateLimiter → 429).
         # BEFORE the preheat expansion: image preheats fetch registry

@@ -101,10 +101,25 @@ class ManagerService:
         workers of the same cluster) share the identical buckets, which
         is what makes the limit hold ACROSS scheduler instances.
         Synchronous on the event loop: check-all then debit-all is
-        atomic."""
+        atomic.
+
+        Raises DfError(NotFound) when NONE of the listed cluster ids
+        resolves: an empty limiter list would otherwise grant with zero
+        debit, letting a client bypass the job limit entirely by naming
+        only nonexistent clusters (the pre-expansion limit exists exactly
+        to stop that amplification). Unknown ids mixed with known ones
+        are still skipped — the known clusters' buckets govern."""
         from dragonfly2_tpu.pkg.ratelimit import Limiter
 
         tokens = max(1, int(tokens))  # negative/zero must never credit
+        # Dedupe before the check/debit loop: cluster_ids=[1,1] must not
+        # double-debit one job, nor slip past can_allow when only one
+        # token remains (each occurrence checked independently would).
+        try:
+            cluster_ids = list(dict.fromkeys(int(cid) for cid in cluster_ids))
+        except (TypeError, ValueError):
+            raise DfError(Code.InvalidArgument,
+                          f"malformed scheduler cluster ids {cluster_ids!r}")
         limiters: list[Limiter] = []
         retry_after = 0.0
         for cid in cluster_ids:
@@ -125,10 +140,16 @@ class ManagerService:
                 retry_after = max(retry_after,
                                   tokens / max(rate, 1e-9), 0.05)
             limiters.append(cached[1])
+        if cluster_ids and not limiters:
+            raise DfError(Code.NotFound,
+                          "no listed scheduler cluster exists")
         if retry_after > 0:
             return False, retry_after
         for lim in limiters:
-            lim.allow(tokens)
+            # can_allow passed for every bucket above and nothing else
+            # runs between check and debit (single event loop); a False
+            # here means that atomicity broke — fail loudly, not quietly.
+            assert lim.allow(tokens), "job bucket drained between check and debit"
         return True, 0.0
 
     # -- users / auth ------------------------------------------------------

@@ -38,6 +38,12 @@ _lib.df_write_piece_crc.argtypes = [
 ]
 _lib.df_write_piece_crc.restype = ctypes.c_int
 
+_lib.df_write_chunk_crc.argtypes = [
+    ctypes.c_int, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_size_t,
+    ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint32),
+]
+_lib.df_write_chunk_crc.restype = ctypes.c_int
+
 _lib.df_read_piece_crc.argtypes = [
     ctypes.c_int, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_size_t,
     ctypes.POINTER(ctypes.c_uint32),
@@ -115,18 +121,48 @@ _lib.df_upload_stop.argtypes = [ctypes.c_int64]
 _lib.df_upload_stop.restype = None
 
 
-def crc32c(data: bytes, crc: int = 0) -> int:
-    return _lib.df_crc32c(data, len(data), crc)
+def _as_char_buf(data):
+    """(arg, nbytes) for a bytes-like without copying: bytes pass through;
+    writable buffers (bytearray, memoryview from the receive pool) wrap in
+    a ctypes char array sharing their memory — ctypes accepts either where
+    a char pointer is declared. Read-only non-bytes views (rare) fall back
+    to one copy."""
+    if isinstance(data, bytes):
+        return data, len(data)
+    mv = data if isinstance(data, memoryview) else memoryview(data)
+    if mv.readonly:
+        b = bytes(mv)
+        return b, len(b)
+    return (ctypes.c_char * mv.nbytes).from_buffer(mv), mv.nbytes
+
+
+def crc32c(data, crc: int = 0) -> int:
+    buf, n = _as_char_buf(data)
+    return _lib.df_crc32c(buf, n, crc)
 
 
 def has_hw_crc() -> bool:
     return bool(_lib.df_has_hw_crc())
 
 
-def write_piece_crc(fd: int, offset: int, data: bytes) -> int:
-    """Fused checksum+pwrite; returns the crc32c of ``data``."""
+def write_piece_crc(fd: int, offset: int, data) -> int:
+    """Fused checksum+pwrite; returns the crc32c of ``data`` (any
+    bytes-like; pooled receive buffers land without a bytes() copy)."""
     out = ctypes.c_uint32(0)
-    rc = _lib.df_write_piece_crc(fd, offset, data, len(data), ctypes.byref(out))
+    buf, n = _as_char_buf(data)
+    rc = _lib.df_write_piece_crc(fd, offset, buf, n, ctypes.byref(out))
+    if rc < 0:
+        raise OSError(-rc, os.strerror(-rc))
+    return out.value
+
+
+def write_chunk_crc(fd: int, offset: int, data, crc: int = 0) -> int:
+    """Seeded fused checksum+pwrite for chunk streams: continues ``crc``
+    across calls, so a piece digest assembles while its wire chunks land —
+    one memory walk per byte, no separate hash pass."""
+    out = ctypes.c_uint32(0)
+    buf, n = _as_char_buf(data)
+    rc = _lib.df_write_chunk_crc(fd, offset, buf, n, crc, ctypes.byref(out))
     if rc < 0:
         raise OSError(-rc, os.strerror(-rc))
     return out.value

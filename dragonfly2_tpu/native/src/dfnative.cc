@@ -139,6 +139,33 @@ int df_write_piece_crc(int fd, uint64_t offset, const uint8_t* data, size_t len,
   return 0;
 }
 
+// Seeded variant for chunk streams: the crc continues from `init`, so a
+// receive loop can land each wire chunk as it arrives — fused checksum+
+// pwrite per chunk, one memory walk per byte across the whole piece —
+// and still produce the piece's digest at the last chunk.
+int df_write_chunk_crc(int fd, uint64_t offset, const uint8_t* data,
+                       size_t len, uint32_t init, uint32_t* crc_out) {
+  const size_t BLOCK = 1 << 20;
+  uint32_t crc = init;
+  size_t done = 0;
+  while (done < len) {
+    size_t n = len - done < BLOCK ? len - done : BLOCK;
+    crc = df_crc32c(data + done, n, crc);
+    size_t w = 0;
+    while (w < n) {
+      ssize_t r = pwrite(fd, data + done + w, n - w, (off_t)(offset + done + w));
+      if (r < 0) {
+        if (errno == EINTR) continue;
+        return -errno;
+      }
+      w += (size_t)r;
+    }
+    done += n;
+  }
+  if (crc_out) *crc_out = crc;
+  return 0;
+}
+
 // Read a piece and checksum it in one pass. Returns bytes read or -errno.
 int64_t df_read_piece_crc(int fd, uint64_t offset, uint8_t* out, size_t len,
                           uint32_t* crc_out) {

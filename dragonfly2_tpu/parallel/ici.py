@@ -17,7 +17,18 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:     # pre-0.6 jax: same callable, experimental home
+    from jax.experimental.shard_map import shard_map
+
+# The "skip the replication/varying-manifest check" kwarg was renamed
+# check_rep → check_vma across jax versions; pass whichever this one has.
+import inspect as _inspect
+
+_NO_CHECK = ({"check_vma": False}
+             if "check_vma" in _inspect.signature(shard_map).parameters
+             else {"check_rep": False})
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -47,7 +58,7 @@ def _all_gather_jit(x, *, mesh: Mesh, axis_name: str):
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=P(axis_name), out_specs=P(),
-        check_vma=False,
+        **_NO_CHECK,
     )
     def gather(shard):
         return jax.lax.all_gather(shard, axis_name, axis=0, tiled=True)
@@ -72,7 +83,7 @@ def _ring_all_gather_jit(x, *, mesh: Mesh, axis_name: str):
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=P(axis_name), out_specs=P(axis_name),
-        check_vma=False,
+        **_NO_CHECK,
     )
     def ring(shard):
         # shard: [chunk, ...] local block. Accumulate n blocks stacked on a

@@ -1,0 +1,70 @@
+"""Reusable piece-buffer pool for the zero-copy receive path.
+
+Piece bodies used to materialize as throwaway ``bytes`` at every hop
+(``resp.read()``, ``bytes(buf[:piece_size])``, ``b"".join``) — at 4-32 MiB
+a piece, that is allocator churn plus a full memory copy per hop on the
+daemon's one hot core. The pool hands out ``memoryview`` windows over
+recycled bytearrays instead; receive loops fill them in place, the store
+writes straight from them, and release() parks the backing buffer for the
+next piece.
+
+Ownership rules (documented in docs/ZERO_COPY.md):
+  - acquire() transfers ownership to the caller; exactly one release()
+    returns it. Double-release is refused (the buffer is already free).
+  - A released view must not be read again — the next acquire() will
+    overwrite its bytes.
+  - Consumers that must RETAIN piece bytes past the call that handed them
+    over (device sinks, caches) must copy (``bytes(view)``); everything on
+    the receive→verify→store path only borrows.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_MB = 1 << 20
+
+
+class BufferPool:
+    """Free-list of bytearrays, bounded by total retained bytes. Thread-safe
+    (release happens on worker threads after off-loop store writes)."""
+
+    def __init__(self, max_retained_bytes: int = 64 * _MB):
+        self._free: list[bytearray] = []
+        self._retained = 0
+        self._max_retained = max_retained_bytes
+        self._mu = threading.Lock()
+
+    def acquire(self, size: int) -> memoryview:
+        """A writable ``memoryview`` of exactly ``size`` bytes over a pooled
+        (or fresh) bytearray."""
+        size = max(size, 1)
+        with self._mu:
+            # First fit that's large enough; the fleet of piece buffers in
+            # one daemon is near-uniform in size, so this is ~always hit #0.
+            for i, ba in enumerate(self._free):
+                if len(ba) >= size:
+                    self._free.pop(i)
+                    self._retained -= len(ba)
+                    return memoryview(ba)[:size]
+        return memoryview(bytearray(size))
+
+    def release(self, view: "memoryview | bytearray | bytes | None") -> None:
+        """Return a buffer obtained from acquire(). Tolerant of plain bytes
+        (non-pooled fallback paths): those are simply dropped."""
+        if isinstance(view, memoryview):
+            obj = view.obj
+            view.release()
+        else:
+            obj = view
+        if not isinstance(obj, bytearray):
+            return
+        with self._mu:
+            if self._retained + len(obj) <= self._max_retained:
+                self._free.append(obj)
+                self._retained += len(obj)
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"free_buffers": len(self._free),
+                    "retained_bytes": self._retained}

@@ -137,15 +137,36 @@ class OTLPExporter:
         self.dropped_spans = 0
         self._q: _queue.Queue = _queue.Queue(maxsize=8192)
         self._stop = threading.Event()
+        # In-flight accounting: a span is "unfinished" from enqueue until
+        # its POST attempt completes (task_done in _run). flush() waits on
+        # this, not on queue-emptiness — the queue empties the moment the
+        # worker POPS a batch, while the POST for it can run another 5 s.
+        self._done_cv = threading.Condition()
+        self._unfinished = 0
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="df-otlp-export")
         self._thread.start()
 
     def enqueue(self, span: "Span") -> None:
+        if self._stop.is_set():
+            self.dropped_spans += 1   # closed: no worker will ever post it
+            return
+        # Count BEFORE the put: the worker may pop and task_done between a
+        # put and a later increment, driving the counter negative and
+        # letting a concurrent flush() return while a span it should wait
+        # for is still in flight.
+        with self._done_cv:
+            self._unfinished += 1
         try:
             self._q.put_nowait(span)
         except _queue.Full:
             self.dropped_spans += 1
+            self._task_done(1)
+
+    def _task_done(self, n: int) -> None:
+        with self._done_cv:
+            self._unfinished -= n
+            self._done_cv.notify_all()
 
     def _drain_batch(self) -> "list[Span]":
         batch: list[Span] = []
@@ -180,17 +201,43 @@ class OTLPExporter:
                     # malformed endpoint (ValueError from urllib) must not
                     # kill the worker and silently wedge export forever.
                     self.dropped_spans += len(batch)
+                finally:
+                    self._task_done(len(batch))
+        # Stop raced a final enqueue: whatever is still queued will never
+        # post — account it as dropped so no flush() waits forever.
+        tail = 0
+        while True:
+            try:
+                self._q.get_nowait()
+                tail += 1
+            except _queue.Empty:
+                break
+        if tail:
+            self.dropped_spans += tail
+            self._task_done(tail)
 
     def flush(self, timeout: float = 5.0) -> None:
-        """Best-effort: wait until the queue has drained (tests, shutdown)."""
+        """Wait until every span enqueued so far has finished its POST
+        attempt (sent or dropped), up to ``timeout`` — queue-empty alone is
+        not done: the worker pops a batch before POSTing it, and that POST
+        can hold the final batch in flight for seconds (tests, shutdown,
+        set_otlp re-point)."""
         deadline = time.monotonic() + timeout
-        while not self._q.empty() and time.monotonic() < deadline:
-            time.sleep(0.02)
-        time.sleep(0.05)  # let the in-flight POST finish
+        with self._done_cv:
+            while self._unfinished > 0:
+                left = deadline - time.monotonic()
+                if left <= 0 or not self._done_cv.wait(timeout=min(left, 0.1)):
+                    if time.monotonic() >= deadline:
+                        return
 
     def close(self) -> None:
         self.flush(timeout=2.0)
         self._stop.set()
+        # Join the worker: it wakes within flush_interval (the blocking
+        # get's timeout) and exits; a close() that returns while the
+        # thread still runs could post after the process tears down the
+        # endpoint (or interleave with a re-pointed exporter).
+        self._thread.join(timeout=self.flush_interval + self.timeout + 1.0)
 
 
 class Exporter:

@@ -46,7 +46,9 @@ class Frame:
     # Call metadata (trace context, auth) — otel's gRPC metadata analog.
     md: dict | None = None
 
-    def pack(self) -> bytes:
+    def pack_parts(self) -> tuple[bytes, bytes]:
+        """(header, payload) — writers push both without concatenating, so
+        a frame costs one serialization and zero assembly copies."""
         m: dict[str, Any] = {"t": self.type, "id": self.call_id}
         if self.method:
             m["m"] = self.method
@@ -57,7 +59,11 @@ class Frame:
         if self.md:
             m["md"] = self.md
         payload = msgpack.packb(m, use_bin_type=True)
-        return struct.pack(">I", len(payload)) + payload
+        return struct.pack(">I", len(payload)), payload
+
+    def pack(self) -> bytes:
+        header, payload = self.pack_parts()
+        return header + payload
 
     @classmethod
     def unpack(cls, payload: bytes) -> "Frame":
@@ -127,8 +133,12 @@ class FrameWriter:
         self._lock = asyncio.Lock()
 
     async def write(self, frame: Frame) -> None:
+        header, payload = frame.pack_parts()
         async with self._lock:
-            self._w.write(frame.pack())
+            # Two writes, no concat: StreamWriter buffers both before the
+            # drain, so the wire sees one contiguous frame either way.
+            self._w.write(header)
+            self._w.write(payload)
             await self._w.drain()
 
     async def close(self) -> None:

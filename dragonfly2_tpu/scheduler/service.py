@@ -161,10 +161,7 @@ class SchedulerService:
         elif kind == "piece_finished":
             self._handle_piece_finished(msg, task, peer)
         elif kind == "pieces_finished":
-            # Coalesced batch (clients flush reports on a short window);
-            # semantics identical to N piece_finished in order.
-            for p in msg.get("pieces") or []:
-                self._apply_piece_finished(p, task, peer)
+            self._handle_pieces_finished(msg, task, peer)
         elif kind == "piece_failed":
             self._handle_piece_failed(msg, task, peer)
         elif kind == "reschedule":
@@ -391,17 +388,22 @@ class SchedulerService:
         self._apply_piece_finished(msg.get("piece") or {}, task, peer)
 
     def _apply_piece_finished(self, p: dict, task: Task, peer: Peer) -> None:
-        info = PieceInfo.from_wire(p)
-        if info.piece_num in peer.finished_pieces:
+        num = p["piece_num"]
+        if num in peer.finished_pieces:
             # Duplicate report: the client's flush restores a popped batch
             # on cancellation even when the send hit the wire (at-least-once
             # delivery), so application must be idempotent — a re-send must
             # not re-count the parent's upload or duplicate cost samples.
+            # Checked on the raw dict BEFORE any PieceInfo construction:
+            # this runs once per piece per peer across the whole pod.
             peer.touch()
             return
         first_piece = not peer.finished_pieces
-        peer.add_finished_piece(info.piece_num, info.download_cost_ms)
-        task.store_piece(info)
+        peer.add_finished_piece(num, p.get("download_cost_ms", 0))
+        if num not in task.pieces:
+            # Construct piece metadata only for the first reporter; every
+            # later peer re-reporting the same piece skips the allocation.
+            task.store_piece(PieceInfo.from_wire(p))
         task.touch()
         if first_piece:
             # The peer just became a usable parent: wake schedule loops
@@ -412,6 +414,37 @@ class SchedulerService:
             parent = self.peers.load(parent_id)
             if parent is not None:
                 parent.host.upload_count += 1
+                parent.touch()
+
+    def _handle_pieces_finished(self, msg: dict, task: Task, peer: Peer) -> None:
+        """Coalesced batch (clients flush reports on a short window);
+        semantics identical to N piece_finished in order, but the per-batch
+        bookkeeping — task touch, parent-availability wakeup, parent
+        upload accounting and registry lookups — runs once per batch (or
+        once per distinct parent) instead of once per piece. This is the
+        scheduler's hottest ingest path: a 1024-host fan-out delivers
+        ~hosts x pieces of these."""
+        pieces = msg.get("pieces") or []
+        was_empty = not peer.finished_pieces
+        parent_uploads: dict[str, int] = {}
+        for p in pieces:
+            num = p["piece_num"]
+            if num in peer.finished_pieces:
+                continue   # idempotent re-delivery (see _apply_piece_finished)
+            peer.add_finished_piece(num, p.get("download_cost_ms", 0))
+            if num not in task.pieces:
+                task.store_piece(PieceInfo.from_wire(p))
+            parent_id = p.get("dst_peer_id", "")
+            if parent_id:
+                parent_uploads[parent_id] = parent_uploads.get(parent_id, 0) + 1
+        peer.touch()
+        task.touch()
+        if was_empty and peer.finished_pieces:
+            task.notify_parents_changed()
+        for parent_id, n in parent_uploads.items():
+            parent = self.peers.load(parent_id)
+            if parent is not None:
+                parent.host.upload_count += n
                 parent.touch()
 
     def _handle_piece_failed(self, msg: dict, task: Task, peer: Peer) -> None:

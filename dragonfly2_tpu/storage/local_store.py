@@ -18,11 +18,23 @@ import time
 from dataclasses import asdict, dataclass, field
 
 from dragonfly2_tpu.pkg import digest as pkgdigest
+from dragonfly2_tpu.pkg.bufpool import BufferPool
 from dragonfly2_tpu.pkg.errors import Code, StorageError
 from dragonfly2_tpu.pkg.piece import compute_piece_count
 
 DATA_FILE = "data"
 METADATA_FILE = "metadata.json"
+
+# Pooled read buffers for range reads (ownership: docs/ZERO_COPY.md).
+# read_range hands out views over these; callers that recycle (the ranged
+# local-parent import) release via release_read_buffer, everyone else just
+# lets theirs be garbage-collected — the pool only ever retains returned
+# buffers, so forgetting to release costs reuse, never correctness.
+_READ_BUFFERS = BufferPool()
+
+
+def release_read_buffer(view) -> None:
+    _READ_BUFFERS.release(view)
 
 _NATIVE = None
 _NATIVE_PROBED = False
@@ -122,7 +134,15 @@ class _PrefixHasher:
     finality point. Any anomaly (re-recorded piece below the frontier,
     short read, fd error) poisons the hasher; ``finish`` then returns None
     and the caller falls back to the normal full re-hash, so this is an
-    optimization that can only be bypassed, never wrong."""
+    optimization that can only be bypassed, never wrong.
+
+    Zero-copy feed: when the committing writer still holds the piece's
+    bytes in memory (the Python receive paths), it hands them to ``feed``
+    right after the commit and the frontier advances WITHOUT re-reading
+    landed bytes from disk — the hash runs in the writer's worker thread,
+    over memory it owns for the duration of the call. The background
+    thread only ever preads pieces that never came through memory
+    (native-engine landings, out-of-order arrivals)."""
 
     def __init__(self, store: "LocalTaskStore", algorithm: str):
         self.store = store
@@ -132,6 +152,16 @@ class _PrefixHasher:
         self._err: str | None = None
         self._cv = threading.Condition()
         self._stop = False
+        # Frontier claim: exactly one hasher (a feed() caller or the
+        # background thread) may advance _next at a time.
+        self._busy = False
+        # Commit→feed handshake: a commit that WILL be followed by a feed
+        # of the frontier piece reserves it so the background thread does
+        # not race in and pread it first (stamped so a feed that never
+        # arrives — observer raised mid-commit — only stalls us briefly).
+        self._reserved: int | None = None
+        self._reserved_at = 0.0
+        self.disk_reads = 0   # pieces the background thread pread (telemetry)
         self._thread = threading.Thread(
             target=self._run, daemon=True,
             name=f"prefix-hash-{store.metadata.task_id[:12]}")
@@ -139,7 +169,8 @@ class _PrefixHasher:
 
     # Called from _commit_piece_record (under the store's _meta_lock; lock
     # order store._meta_lock → self._cv, and _run never takes _meta_lock).
-    def piece_recorded(self, num: int, replaced: bool) -> None:
+    def piece_recorded(self, num: int, replaced: bool,
+                       will_feed: bool = False) -> None:
         with self._cv:
             # <=, not <: _next is also the piece currently being hashed
             # OUTSIDE the lock — a re-record there would hash a torn mix
@@ -147,6 +178,41 @@ class _PrefixHasher:
             if replaced and num <= self._next:
                 self._err = f"piece {num} re-recorded at/behind the frontier"
                 self._stop = True
+            if (will_feed and not self._stop and not self._busy
+                    and num == self._next):
+                self._reserved = num
+                self._reserved_at = time.monotonic()
+                return   # no notify: the imminent feed() advances instead
+            self._cv.notify()
+
+    def feed(self, num: int, chunks) -> None:
+        """Advance the frontier with in-memory bytes (one buffer or a list
+        of buffers, in order). Called by the committing writer AFTER
+        ``piece_recorded``, outside the store's _meta_lock, while it still
+        owns the buffers. No-op unless ``num`` is exactly the unclaimed
+        frontier — anything else stays the background thread's job."""
+        with self._cv:
+            if self._reserved == num:
+                self._reserved = None
+            if (self._err is not None or self._stop or self._busy
+                    or num != self._next):
+                self._cv.notify()
+                return
+            self._busy = True
+        try:
+            if isinstance(chunks, (bytes, bytearray, memoryview)):
+                chunks = (chunks,)
+            for c in chunks:
+                self._h.update(c)   # GIL released for >2 KiB
+        except Exception as e:  # noqa: BLE001 - poisons; caller re-hashes
+            with self._cv:
+                self._err = str(e)
+                self._busy = False
+                self._cv.notify()
+            return
+        with self._cv:
+            self._busy = False
+            self._next += 1
             self._cv.notify()
 
     def stop(self) -> None:
@@ -170,23 +236,39 @@ class _PrefixHasher:
                             return
                         m = self.store.metadata
                         rec = m.pieces.get(self._next)
-                        if rec is not None:
-                            break
-                        if (m.total_piece_count >= 0
+                        if rec is not None and not self._busy:
+                            if self._reserved != self._next:
+                                break
+                            # A feed() is imminent for this piece; only
+                            # reclaim a reservation whose feed never came
+                            # (commit-path exception between record and
+                            # feed — rare, and the cost is one pread).
+                            if time.monotonic() - self._reserved_at > 1.0:
+                                self._reserved = None
+                                break
+                        if (rec is None and m.total_piece_count >= 0
                                 and self._next >= m.total_piece_count):
                             return  # drained
                         # Timed wait: total_piece_count can be set by
                         # update_task without a piece commit notifying.
-                        self._cv.wait(timeout=2.0)
-                remaining, off = rec.size, rec.offset
-                while remaining > 0:
-                    chunk = os.pread(fd, min(remaining, 4 << 20), off)
-                    if not chunk:
-                        raise OSError(f"short read at piece {rec.num}")
-                    self._h.update(chunk)  # GIL released for >2 KiB
-                    off += len(chunk)
-                    remaining -= len(chunk)
+                        self._cv.wait(timeout=1.0)
+                    self._busy = True
+                try:
+                    remaining, off = rec.size, rec.offset
+                    self.disk_reads += 1
+                    while remaining > 0:
+                        chunk = os.pread(fd, min(remaining, 4 << 20), off)
+                        if not chunk:
+                            raise OSError(f"short read at piece {rec.num}")
+                        self._h.update(chunk)  # GIL released for >2 KiB
+                        off += len(chunk)
+                        remaining -= len(chunk)
+                except BaseException:
+                    with self._cv:
+                        self._busy = False
+                    raise
                 with self._cv:
+                    self._busy = False
                     self._next += 1
                     self._cv.notify()
         except Exception as e:  # noqa: BLE001 - poisons; caller re-hashes
@@ -329,10 +411,13 @@ class LocalTaskStore:
     # piece is O(pieces²) json work (profiled at ~80 ms/piece on big tasks,
     # dominating the download loop). A crash loses at most one batch — those
     # pieces simply re-fetch on resume; completion (mark_done) always saves.
-    # The 2 s timer trades ≤2 s of re-fetchable piece records for ~4× fewer
-    # json+fsync cycles during a transfer (each is 30-50 ms of the shared
-    # core on the fan-out bench host).
-    _SAVE_EVERY_PIECES = 16
+    # The 2 s timer is the PRIMARY trigger: a standard ~32-piece task that
+    # transfers inside the window does O(1) metadata serializations total
+    # (one mid-flight at most, plus completion), where the old 16-piece
+    # count trigger made it O(pieces/16) each a full-map json dump. The
+    # count is only a backstop bounding replay for many-hundred-piece
+    # tasks on slow links.
+    _SAVE_EVERY_PIECES = 64
     _SAVE_EVERY_SECONDS = 2.0
 
     def _piece_recorded_save(self) -> None:
@@ -368,13 +453,16 @@ class LocalTaskStore:
 
     # -- piece IO ----------------------------------------------------------
 
-    def write_piece(self, num: int, data: bytes, expected_digest: str = "",
+    def write_piece(self, num: int, data, expected_digest: str = "",
                     cost_ms: int = 0, algorithm: str = "") -> PieceRecord:
-        """Write piece ``num``. Verifies the per-piece digest before the
-        write lands (reference local_storage.go:102-196 hashes in-flight).
-        With no ``expected_digest``, a fresh digest is computed with
-        ``algorithm`` (default: preferred_piece_algorithm — hardware crc32c
-        fused into the write when the native library is present)."""
+        """Write piece ``num`` (``data`` is any bytes-like — pooled read
+        buffers land without a bytes() copy). Verifies the per-piece digest
+        before the write lands (reference local_storage.go:102-196 hashes
+        in-flight). With no ``expected_digest``, a fresh digest is computed
+        with ``algorithm`` (default: preferred_piece_algorithm — hardware
+        crc32c fused into the write when the native library is present).
+        Receive paths that hold the body as wire chunks use
+        ``write_piece_chunks`` instead (digest fused into the write)."""
         m = self.metadata
         if m.piece_size <= 0:
             raise StorageError("piece size not set")
@@ -421,11 +509,104 @@ class LocalTaskStore:
             else:
                 digest_str = str(pkgdigest.hash_bytes(algorithm, data))
         if not fused:
+            mv = data if isinstance(data, memoryview) else memoryview(data)
             written = 0
-            while written < len(data):
-                written += os.pwrite(fd, data[written:], offset + written)
-        rec = PieceRecord(num=num, offset=offset, size=len(data), digest=digest_str, cost_ms=cost_ms)
-        return self._commit_piece_record(rec)
+            while written < len(mv):
+                written += os.pwrite(fd, mv[written:], offset + written)
+        rec = PieceRecord(num=num, offset=offset, size=len(data),
+                          digest=digest_str, cost_ms=cost_ms)
+        return self._commit_piece_record(rec, feed_chunks=(data,))
+
+    def _pwritev_chunks(self, fd: int, chunks: list, offset: int,
+                        num: int) -> None:
+        views = [c if isinstance(c, memoryview) else memoryview(c)
+                 for c in chunks if len(c)]
+        written = 0
+        while views:
+            n = os.pwritev(fd, views, offset + written)
+            if n <= 0:
+                raise StorageError(f"pwritev returned {n} at piece {num}")
+            written += n
+            # Partial vector write (rare on regular files): drop the fully
+            # written views, trim the boundary one, continue.
+            while views and n >= len(views[0]):
+                n -= len(views[0])
+                views.pop(0)
+            if views and n:
+                views[0] = views[0][n:]
+
+    def write_piece_chunks(self, num: int, chunks: list, digest_str: str = "",
+                           expected_digest: str = "",
+                           cost_ms: int = 0) -> PieceRecord:
+        """Land piece ``num`` from an ordered list of bytes-like chunks —
+        the streaming receive paths hand over their chunk views exactly as
+        the wire delivered them, with no assembly buffer and no
+        concatenation copy. Single-pass, never re-reading landed bytes,
+        in one of three shapes:
+
+          - ``digest_str`` given: the caller hashed these exact chunks
+            while they arrived (non-crc32c algorithms overlap the socket
+            wait that way); verification is a string compare, the write
+            one pwritev.
+          - crc32c target + native + unrecorded piece: FUSED — each chunk
+            is checksummed while being pwritten (seeded crc continues
+            across chunks), one memory walk per byte for hash+write
+            combined. Safe to write before verifying for the same reason
+            as write_piece's fused path: no valid bytes exist at the
+            offset yet, and a mismatch leaves the bytes unrecorded.
+          - otherwise: hash the in-memory chunks, verify, then pwritev
+            (no native lib, or re-writing a recorded piece where
+            write-before-verify would be unsafe)."""
+        m = self.metadata
+        if m.piece_size <= 0:
+            raise StorageError("piece size not set")
+        offset = num * m.piece_size
+        fd = self._ensure_fd()
+        native = _native()
+        size = sum(len(c) for c in chunks)
+        want = pkgdigest.parse(expected_digest) if expected_digest else None
+        target_alg = (want.algorithm if want is not None
+                      else pkgdigest.preferred_piece_algorithm())
+        if digest_str:
+            if want is not None and \
+                    digest_str != f"{want.algorithm}:{want.encoded}":
+                raise StorageError(
+                    f"piece {num} digest mismatch: want {want}, got {digest_str}",
+                    Code.ClientPieceDownloadFail,
+                )
+            self._pwritev_chunks(fd, chunks, offset, num)
+        elif (native is not None and num not in m.pieces
+                and target_alg == pkgdigest.ALGORITHM_CRC32C):
+            crc, off = 0, offset
+            for c in chunks:
+                if len(c):
+                    crc = native.write_chunk_crc(fd, off, c, crc)
+                    off += len(c)
+            if want is not None and f"{crc:08x}" != want.encoded:
+                raise StorageError(
+                    f"piece {num} digest mismatch: want {want.encoded}, "
+                    f"got {crc:08x}",
+                    Code.ClientPieceDownloadFail,
+                )
+            digest_str = f"{pkgdigest.ALGORITHM_CRC32C}:{crc:08x}"
+        else:
+            h = pkgdigest.new_hasher(target_alg)
+            for c in chunks:
+                h.update(c)
+            digest_str = f"{target_alg}:{h.hexdigest()}"
+            if want is not None and \
+                    digest_str != f"{want.algorithm}:{want.encoded}":
+                raise StorageError(
+                    f"piece {num} digest mismatch: want {want}, got {digest_str}",
+                    Code.ClientPieceDownloadFail,
+                )
+            self._pwritev_chunks(fd, chunks, offset, num)
+        if expected_digest:
+            self._verified_pieces[num] = expected_digest
+            digest_str = expected_digest
+        rec = PieceRecord(num=num, offset=offset, size=size,
+                          digest=digest_str, cost_ms=cost_ms)
+        return self._commit_piece_record(rec, feed_chunks=chunks)
 
     def data_fd(self) -> int:
         """The data file's fd, for transports that land bytes directly
@@ -532,12 +713,17 @@ class LocalTaskStore:
         on completion. See ``certifies`` for the provenance argument."""
         return self.certifies(self.certified_digests)
 
-    def _commit_piece_record(self, rec: PieceRecord) -> PieceRecord:
-        """The single metadata-commit point for both write paths (in-memory
-        write_piece and native-transport record_piece): record under the
-        lock, then persist the piece map in batches so a daemon restart
-        resumes from the bitmap (reference: checkpoint/resume of
-        downloads)."""
+    def _commit_piece_record(self, rec: PieceRecord,
+                             feed_chunks=None) -> PieceRecord:
+        """The single metadata-commit point for all write paths (in-memory
+        write_piece/write_piece_chunks and native-transport record_piece):
+        record under the lock, then persist the piece map in batches so a
+        daemon restart resumes from the bitmap (reference: checkpoint/
+        resume of downloads). ``feed_chunks`` are the piece's in-memory
+        bytes when the writer still holds them — the prefix hasher
+        advances from memory instead of re-reading landed bytes (fed
+        after the lock, in this worker thread, while the buffers are
+        still owned by the caller)."""
         with self._meta_lock:
             existing = self.metadata.pieces.get(rec.num)
             self.metadata.pieces[rec.num] = rec
@@ -546,7 +732,10 @@ class LocalTaskStore:
                 self._unsaved_pieces += 1
             ph = self._prefix_hasher
             if ph is not None:
-                ph.piece_recorded(rec.num, existing is not None)
+                ph.piece_recorded(rec.num, existing is not None,
+                                  will_feed=feed_chunks is not None)
+        if ph is not None and feed_chunks is not None:
+            ph.feed(rec.num, feed_chunks)
         if existing is None:
             self._piece_recorded_save()
         obs = self.observer
@@ -718,35 +907,47 @@ class LocalTaskStore:
         with self._meta_lock:  # writers mutate from worker threads
             return all(n in m.pieces for n in range(first, last + 1))
 
-    def read_range(self, start: int, length: int) -> bytes:
+    def read_range(self, start: int, length: int) -> memoryview:
         """Bytes ``[start, start+length)`` — caller must have checked
         ``covers_range`` first (pieces sit at ``num * piece_size``, so
-        covered bytes are literally contiguous in the data file)."""
+        covered bytes are literally contiguous in the data file). Returns
+        a memoryview over one freshly-filled buffer: the old chunked
+        pread + ``b"".join`` walked the range's memory twice; preadv into
+        a single allocation walks it once."""
         fd = self._ensure_fd()
-        out = []
-        remaining, off = length, start
-        while remaining > 0:
-            chunk = os.pread(fd, min(remaining, 4 << 20), off)
-            if not chunk:
-                raise StorageError(f"short read at offset {off}")
-            out.append(chunk)
-            off += len(chunk)
-            remaining -= len(chunk)
-        return b"".join(out)
+        mv = _READ_BUFFERS.acquire(length)
+        got = 0
+        while got < length:
+            n = os.preadv(fd, [mv[got:]], start + got)
+            if n <= 0:
+                raise StorageError(f"short read at offset {start + got}")
+            got += n
+        return mv
 
     def export_range(self, dest: str, start: int, length: int) -> None:
-        """Write the byte range [start, start+length) to ``dest`` from the
-        covering pieces (caller checks covers_range first)."""
+        """Write the byte range [start, start+length) to ``dest`` straight
+        off the data file in bounded spans (caller checks covers_range
+        first — covered bytes are contiguous, so no per-piece slicing)."""
         os.makedirs(os.path.dirname(os.path.abspath(dest)), exist_ok=True)
-        m = self.metadata
-        first = start // m.piece_size
-        last = (start + length - 1) // m.piece_size
-        end = start + length
-        with open(dest, "wb") as out:
-            for n in range(first, last + 1):
-                data = self.read_piece(n)
-                p0 = n * m.piece_size
-                out.write(data[max(0, start - p0):max(0, min(len(data), end - p0))])
+        fd = self._ensure_fd()
+        mv = _READ_BUFFERS.acquire(min(4 << 20, length))
+        try:
+            remaining, off = length, start
+            with open(dest, "wb") as out:
+                while remaining > 0:
+                    take = min(len(mv), remaining)
+                    got = 0
+                    while got < take:
+                        n = os.preadv(fd, [mv[got:take]], off + got)
+                        if n <= 0:
+                            raise StorageError(
+                                f"short read at offset {off + got}")
+                        got += n
+                    out.write(mv[:take])
+                    off += take
+                    remaining -= take
+        finally:
+            _READ_BUFFERS.release(mv)
 
     def store_to(self, dest: str, *, hardlink: bool = True) -> None:
         """Land the completed content at ``dest``: hardlink when possible,

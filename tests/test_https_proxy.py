@@ -16,7 +16,12 @@ import random
 import ssl
 
 import aiohttp
+import pytest
 from aiohttp import web
+
+pytest.importorskip(
+    "cryptography",
+    reason="MITM CA needs the cryptography package (absent in slim images)")
 
 from dragonfly2_tpu.daemon.proxy import Proxy, parse_sni
 from dragonfly2_tpu.daemon.transport import P2PTransport, ProxyRule

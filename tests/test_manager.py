@@ -349,3 +349,74 @@ def test_job_rate_limit_shared_across_faces(run_async):
             await server.stop()
 
     run_async(run())
+
+
+def test_job_rate_limit_unknown_and_duplicate_clusters():
+    """Unknown/duplicate cluster-id hardening (advisor round 5):
+    a request whose cluster ids ALL resolve to nonexistent clusters must
+    be rejected, not granted with zero debit (rate-limit bypass); and
+    duplicate ids must neither double-debit nor slip past the
+    all-or-nothing check when only one token remains."""
+    from dragonfly2_tpu.pkg.errors import Code, DfError
+
+    svc = ManagerService()
+    cluster_id = svc.db.find("scheduler_clusters", name="default")["id"]
+    cfg = svc.db.get("scheduler_clusters", cluster_id)["config"]
+    svc.db.update("scheduler_clusters", cluster_id,
+                  {"config": {**cfg, "job_rate_limit": 2}})
+
+    # All listed ids nonexistent: rejected (the empty limiter list used to
+    # grant with no debit — a full bypass of the job limit).
+    with pytest.raises(DfError) as ei:
+        svc.take_job_tokens([987654, 987655])
+    assert ei.value.code == Code.NotFound
+
+    # Duplicates collapse to one debit: burst is 2, so [id, id] granted
+    # once leaves exactly one token, and the next single take still works.
+    granted, _ = svc.take_job_tokens([cluster_id, cluster_id])
+    assert granted
+    granted, _ = svc.take_job_tokens([cluster_id])
+    assert granted, "duplicate ids double-debited one job"
+    # Bucket now empty: [id, id] with zero tokens must be denied (per-
+    # occurrence can_allow with one token would still pass each check).
+    granted, retry_after = svc.take_job_tokens([cluster_id, cluster_id])
+    assert not granted and retry_after > 0
+
+    # Malformed ids are a coded client error, not a ValueError escape.
+    with pytest.raises(DfError) as ei:
+        svc.take_job_tokens(["abc"])
+    assert ei.value.code == Code.InvalidArgument
+
+
+def test_job_create_rejects_bad_cluster_ids(run_async):
+    """REST face of the same hardening: non-numeric scheduler_cluster_ids
+    → 400 (was a 500 path), all-nonexistent → 404, and neither enqueues a
+    job or expands preheat args."""
+
+    async def run():
+        server = ManagerServer(ManagerConfig())
+        await server.start()
+        base = f"http://127.0.0.1:{server.rest_port}"
+        try:
+            async with aiohttp.ClientSession() as http:
+                resp = await http.post(
+                    f"{base}/api/v1/users/signin",
+                    json={"name": "root", "password": "dragonfly"})
+                hdr = {"Authorization":
+                       f"Bearer {(await resp.json())['token']}"}
+                body = {"type": "preheat",
+                        "args": {"type": "file", "url": "http://o/x"},
+                        "scheduler_cluster_ids": ["abc"]}
+                resp = await http.post(f"{base}/api/v1/jobs", headers=hdr,
+                                       json=body)
+                assert resp.status == 400, await resp.text()
+                body["scheduler_cluster_ids"] = [987654]
+                resp = await http.post(f"{base}/api/v1/jobs", headers=hdr,
+                                       json=body)
+                assert resp.status == 404, await resp.text()
+                resp = await http.get(f"{base}/api/v1/jobs", headers=hdr)
+                assert await resp.json() == [], "rejected job was enqueued"
+        finally:
+            await server.stop()
+
+    run_async(run())

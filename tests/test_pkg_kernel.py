@@ -404,6 +404,9 @@ class TestRangeNormalizeHeader:
 
 class TestRangeNormalizeProperties:
     def test_idempotent_and_parse_equivalent(self):
+        pytest.importorskip(
+            "hypothesis",
+            reason="property tests need hypothesis (absent in slim images)")
         from hypothesis import given, settings, strategies as st_h
 
         from dragonfly2_tpu.pkg.piece import Range

@@ -371,26 +371,32 @@ def test_stream_range_skips_leading_pieces(tmp_path, run_async):
                 StreamTaskRequest(url=url, meta=UrlMeta()))
             async for _ in body:
                 pass
-            # Tail range from the completed store: pieces before the range
-            # must not be read off disk.
+            # Tail range from the completed store: bytes before the range
+            # must not be read off disk (the serving path reads spans via
+            # read_range; instrument both it and read_piece).
             store = tm.storage.find_completed_task(attrs["task_id"])
-            orig = store.read_piece
+            orig_rr = store.read_range
+            orig_rp = store.read_piece
 
-            def counting_read(num):
-                reads.append(num)
-                return orig(num)
+            def counting_range(off, length):
+                reads.append(off)
+                return orig_rr(off, length)
 
-            store.read_piece = counting_read
+            def counting_piece(num):
+                reads.append(num * store.metadata.piece_size)
+                return orig_rp(num)
+
+            store.read_range = counting_range
+            store.read_piece = counting_piece
             start = len(BLOB) - 100
             attrs2, body2 = await tm.start_stream_task(
                 StreamTaskRequest(url=url, meta=UrlMeta(),
                                   range=Range(start, -1)))
             got = b""
             async for chunk in body2:
-                got += chunk
+                got += bytes(chunk)
             assert got == BLOB[start:]
-            piece_size = store.metadata.piece_size
-            assert reads and min(reads) >= start // piece_size
+            assert reads and min(reads) >= start
         finally:
             await runner.cleanup()
 

@@ -160,3 +160,75 @@ def test_otlp_export_lands_in_collector(run_async):
             await runner.cleanup()
 
     run_async(run())
+
+
+def test_otlp_flush_waits_for_inflight_post_and_close_joins(run_async):
+    """Shutdown race (advisor round 5): flush() must wait for the POST the
+    worker already popped from the queue — queue-empty plus a fixed 50 ms
+    is not "drained" when a collector takes hundreds of ms — and close()
+    must join the worker thread so nothing posts after teardown."""
+    import asyncio
+    import threading
+
+    from aiohttp import web
+
+    async def run():
+        received: list[int] = []
+        release = asyncio.Event()
+
+        async def v1_traces(request: web.Request) -> web.Response:
+            payload = await request.json()
+            # Hold the POST well past the old flush's 50 ms grace.
+            await release.wait()
+            received.append(sum(
+                len(ss["spans"])
+                for rs in payload["resourceSpans"]
+                for ss in rs["scopeSpans"]))
+            return web.json_response({"partialSuccess": {}})
+
+        app = web.Application()
+        app.router.add_post("/v1/traces", v1_traces)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+
+        exp = tracing.exporter()
+        otlp = exp.set_otlp(f"http://127.0.0.1:{port}",
+                            service_name="df-flush-test",
+                            flush_interval=0.02)
+        try:
+            with tracing.span("held"):
+                pass
+            # Let the worker pop the batch and enter the slow POST.
+            for _ in range(100):
+                if otlp._q.empty():
+                    break
+                await asyncio.sleep(0.01)
+            flushed = asyncio.ensure_future(asyncio.to_thread(otlp.flush, 5.0))
+            await asyncio.sleep(0.3)
+            # Queue is empty but the POST is mid-flight: the old flush
+            # (queue-empty + 50 ms) has already returned by now.
+            assert not flushed.done(), \
+                "flush returned while the final batch's POST was in flight"
+            assert otlp.sent_spans == 0
+            release.set()
+            await flushed
+            assert otlp.sent_spans == 1, (otlp.sent_spans,
+                                          otlp.dropped_spans)
+            assert received == [1]
+            await asyncio.to_thread(otlp.close)
+            assert not otlp._thread.is_alive(), \
+                "close() returned with the worker thread still running"
+            # A post-close enqueue is dropped, never stranded in flight.
+            before = otlp.dropped_spans
+            otlp.enqueue(tracing.Span(
+                "late", tracing.SpanContext("c" * 32, "d" * 16), end=1.0))
+            assert otlp.dropped_spans == before + 1
+            otlp.flush(timeout=0.5)   # returns promptly, nothing pending
+        finally:
+            exp.set_otlp("")
+            await runner.cleanup()
+
+    run_async(run())
