@@ -1,15 +1,26 @@
-"""BASELINE config #4 bench: webdataset tar-shard streaming through dfstore.
+"""BASELINE config #4 bench: webdataset tar shards through dfstore.
 
 A real dfdaemon process runs the S3-like object gateway (fs backend holding
-webdataset-style tar shards); the client streams the shards through
-``Dfstore.stream_object`` — ordered bytes delivered as pieces land, the way
-a training input pipeline consumes them. Reports:
+webdataset-style tar shards). Two modes:
+
+Default (streaming): the client streams whole shards through
+``Dfstore.stream_object`` — ordered bytes delivered as pieces land. Reports:
 
   - ttfb_s           time to the FIRST streamed chunk of a cold shard
   - cold_mbps        sustained streaming rate, cold (origin → pieces → client)
   - warm_mbps        repeat read (served from the local piece store)
 
+``--loader``: the full dataset plane (dragonfly2_tpu/dataset) end-to-end —
+shard indexes built and P2P-cached, samples fetched as ranged tasks
+through the pod-sharded loader with readahead, batched by the device
+feed. Reports:
+
+  - ttfb_s           time to the FIRST batch (includes index resolution)
+  - cold_sps         samples/s, cold epoch (origin → ranged tasks)
+  - warm_sps         samples/s, warm epoch (local piece store)
+
 Usage: python benchmarks/webdataset_bench.py [--shards 4] [--shard-mb 64]
+                                             [--loader]
 Writes a JSON line to stdout and (with --publish) updates
 BASELINE.json["published"]["config4_webdataset"].
 
@@ -174,10 +185,110 @@ async def run_bench(n_shards: int, shard_mb: int, workdir: str) -> dict:
             daemon.kill()
 
 
+_SAMPLE_KB = 256          # _make_shard geometry
+_JPG_BYTES = _SAMPLE_KB * 1024 - 128
+
+
+async def run_loader_bench(n_shards: int, shard_mb: int, workdir: str,
+                           batch_size: int = 16,
+                           readahead: int = 16) -> dict:
+    """Dataset plane end-to-end: cold epoch (index build + ranged pulls),
+    then a warm epoch against the now-local piece store."""
+    rng = random.Random(17)
+    bucket_root = os.path.join(workdir, "buckets")
+    shard_dir = os.path.join(bucket_root, "webdataset")
+    os.makedirs(shard_dir, exist_ok=True)
+    keys = []
+    total_bytes = 0
+    for i in range(n_shards):
+        shard = _make_shard(rng, shard_mb, i)
+        key = f"train-{i:05d}.tar"
+        with open(os.path.join(shard_dir, key), "wb") as f:
+            f.write(shard)
+        keys.append(key)
+        total_bytes += len(shard)
+
+    gw_port = _free_port()
+    daemon = _spawn(
+        ["daemon", "--work-home", os.path.join(workdir, "daemon"),
+         "--object-storage-port", str(gw_port),
+         "--object-storage-backend", "fs",
+         "--object-storage-option", f"root={bucket_root}"],
+        os.path.join(workdir, "daemon.log"))
+    try:
+        from dragonfly2_tpu.daemon.config import _local_ip
+
+        host_ip = _local_ip()
+        if not _wait_port(host_ip, gw_port):
+            raise RuntimeError(
+                "gateway did not come up; tail: " + open(
+                    os.path.join(workdir, "daemon.log")).read()[-1500:])
+
+        from dragonfly2_tpu.client.dfstore import Dfstore
+        from dragonfly2_tpu.dataset import LoaderOptions, PodShardedLoader
+        from dragonfly2_tpu.dataset.device_feed import DeviceFeed
+
+        store = Dfstore(f"http://{host_ip}:{gw_port}")
+        try:
+            async def run_epoch(seed: int) -> tuple[float, float, int, int]:
+                """(ttfb_s, total_s, samples, batches) for one epoch."""
+                t0 = time.perf_counter()
+                loader = PodShardedLoader(
+                    store, "webdataset", keys,
+                    options=LoaderOptions(seed=seed, readahead=readahead,
+                                          interleave=min(4, n_shards)))
+                await loader.prepare()
+                feed = DeviceFeed("jpg", record_bytes=_JPG_BYTES,
+                                  batch_size=batch_size)
+                ttfb = None
+                samples = batches = 0
+                async for batch in feed.batches(loader.epoch(0)):
+                    if ttfb is None:
+                        ttfb = time.perf_counter() - t0
+                    samples += len(batch.keys)
+                    batches += 1
+                return ttfb, time.perf_counter() - t0, samples, batches
+
+            cold_ttfb, cold_s, n_samples, n_batches = await run_epoch(1)
+            warm_ttfb, warm_s, warm_samples, _ = await run_epoch(1)
+            assert warm_samples == n_samples
+        finally:
+            await store.close()
+        sample_bytes = n_samples * _SAMPLE_KB * 1024
+        return {
+            "config": "webdataset-loader",
+            "shards": n_shards,
+            "shard_mb": shard_mb,
+            "samples": n_samples,
+            "batch_size": batch_size,
+            "readahead": readahead,
+            "ttfb_s": round(cold_ttfb, 3),
+            "warm_ttfb_s": round(warm_ttfb, 3),
+            "cold_sps": round(n_samples / cold_s, 1),
+            "warm_sps": round(n_samples / warm_s, 1),
+            "cold_mbps": round(sample_bytes / cold_s / 1e6, 1),
+            "warm_mbps": round(sample_bytes / warm_s / 1e6, 1),
+            "cold_s": round(cold_s, 2),
+            "warm_s": round(warm_s, 2),
+            "host_cores": os.cpu_count(),
+        }
+    finally:
+        daemon.send_signal(signal.SIGTERM)
+        try:
+            daemon.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            daemon.kill()
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--shards", type=int, default=4)
     ap.add_argument("--shard-mb", type=int, default=64)
+    ap.add_argument("--loader", action="store_true",
+                    help="bench the dataset-plane loader instead of "
+                         "whole-shard streaming")
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--readahead", type=int, default=16)
     ap.add_argument("--publish", action="store_true")
     ap.add_argument("--workdir", default="")
     args = ap.parse_args()
@@ -186,13 +297,23 @@ def main() -> int:
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="df-webdataset-")
     os.makedirs(workdir, exist_ok=True)
-    result = asyncio.run(run_bench(args.shards, args.shard_mb, workdir))
+    if args.loader:
+        result = asyncio.run(run_loader_bench(
+            args.shards, args.shard_mb, workdir,
+            batch_size=args.batch_size, readahead=args.readahead))
+    else:
+        result = asyncio.run(run_bench(args.shards, args.shard_mb, workdir))
     print(json.dumps(result))
 
     if args.publish:
         path = os.path.join(REPO, "BASELINE.json")
         doc = json.load(open(path))
-        doc.setdefault("published", {})["config4_webdataset"] = result
+        published = doc.setdefault("published", {})
+        entry = published.get("config4_webdataset", {})
+        if "config" in entry:   # pre-loader flat shape: one streaming dict
+            entry = {"streaming": entry}
+        entry["loader" if args.loader else "streaming"] = result
+        published["config4_webdataset"] = entry
         with open(path, "w") as f:
             json.dump(doc, f, indent=2)
             f.write("\n")

@@ -33,10 +33,16 @@ class Dfstore:
     """Async client; endpoint is the daemon gateway, e.g.
     ``http://127.0.0.1:65004``."""
 
-    def __init__(self, endpoint: str, *, timeout: float = 60.0):
+    def __init__(self, endpoint: str, *, timeout: float = 60.0,
+                 read_timeout: float = 60.0):
         self.endpoint = endpoint.rstrip("/")
         # timeout 0 = unbounded (long prefetch warm-ups).
         self.timeout = aiohttp.ClientTimeout(total=timeout or None)
+        # Long-lived streams (multi-GB tar shards) must not die at the
+        # session's TOTAL timeout mid-body: they get a per-read idle
+        # timeout instead — progress keeps them alive, stalls kill them.
+        self.stream_timeout = aiohttp.ClientTimeout(
+            total=None, sock_connect=30.0, sock_read=read_timeout or None)
         self._session: aiohttp.ClientSession | None = None
 
     def _http(self) -> aiohttp.ClientSession:
@@ -89,9 +95,19 @@ class Dfstore:
                 raise DfstoreError(await r.text(), r.status)
             return await r.read()
 
-    async def stream_object(self, bucket: str, key: str) -> AsyncIterator[bytes]:
-        """Streaming GET (webdataset tar shards — BASELINE config #4)."""
-        r = await self._http().get(self._object_url(bucket, key))
+    async def stream_object(self, bucket: str, key: str,
+                            range_header: str = "") -> AsyncIterator[bytes]:
+        """Streaming GET (webdataset tar shards — BASELINE config #4).
+        ``range_header`` ("a-b" or "bytes=a-b") streams just that span.
+        Rides the per-read stream timeout, not the session total — a cold
+        multi-GB shard must not be killed mid-stream by a 60 s budget."""
+        headers = {}
+        if range_header:
+            v = range_header.strip()
+            headers["Range"] = v if v.startswith("bytes=") else f"bytes={v}"
+        r = await self._http().get(self._object_url(bucket, key),
+                                   headers=headers,
+                                   timeout=self.stream_timeout)
         if r.status not in (200, 206):
             text = await r.text()
             r.release()
@@ -105,6 +121,58 @@ class Dfstore:
                 r.release()
 
         return chunks()
+
+    async def read_object_range(self, bucket: str, key: str, start: int,
+                                end: int, *, ranged_task: bool = True,
+                                buf: "memoryview | bytearray | None" = None):
+        """Read the half-open byte span ``[start, end)``.
+
+        With ``ranged_task`` (default) the daemon serves it as a dedicated
+        RANGED P2P task (`?ranged_task=1`): on a cold cache only the
+        span's bytes are fetched from origin, and every host reading the
+        same span shares one task identity (the dataset plane's
+        sample-read primitive). Without it, the span rides a plain ranged
+        GET over the whole-object stream task (which, when cold, pulls
+        the entire object).
+
+        Returns ``(attrs, data)``; with ``buf`` given the bytes are
+        written in place and data is None. attrs: {"from_reuse", "task_id"}.
+        """
+        n = end - start
+        if n <= 0:
+            raise ValueError(f"empty range [{start}, {end})")
+        if buf is not None and len(buf) < n:
+            raise ValueError(f"buffer {len(buf)}B < span {n}B")
+        url = self._object_url(bucket, key)
+        if ranged_task:
+            url += "?ranged_task=1"
+        headers = {"Range": f"bytes={start}-{end - 1}"}
+        async with self._http().get(url, headers=headers,
+                                    timeout=self.stream_timeout) as r:
+            if r.status not in (200, 206):
+                raise DfstoreError(await r.text(), r.status)
+            attrs = {
+                "from_reuse": r.headers.get("X-Dragonfly-From-Reuse") == "1",
+                "task_id": r.headers.get("X-Dragonfly-Task-Id", ""),
+            }
+            if buf is None:
+                data = await r.read()
+                if len(data) != n:
+                    raise DfstoreError(
+                        f"range [{start}, {end}) returned {len(data)}B")
+                return attrs, data
+            filled = 0
+            async for chunk in r.content.iter_chunked(1 << 20):
+                if filled + len(chunk) > n:
+                    raise DfstoreError(
+                        f"range [{start}, {end}) over-delivered "
+                        f"({filled + len(chunk)}B)")
+                buf[filled:filled + len(chunk)] = chunk
+                filled += len(chunk)
+            if filled != n:
+                raise DfstoreError(
+                    f"range [{start}, {end}) returned {filled}B")
+            return attrs, None
 
     async def stat_object(self, bucket: str, key: str) -> ObjectInfo:
         async with self._http().head(self._object_url(bucket, key)) as r:
@@ -129,10 +197,19 @@ class Dfstore:
             if r.status != 200:
                 raise DfstoreError(await r.text(), r.status)
 
-    async def copy_object(self, bucket: str, src_key: str, dst_key: str) -> None:
-        """GET+PUT copy (reference dfstore CopyObject)."""
-        data = await self.get_object(bucket, src_key)
-        await self.put_object(bucket, dst_key, data)
+    async def copy_object(self, bucket: str, src_key: str, dst_key: str,
+                          *, mode: str = "async_write_back") -> str:
+        """Streaming copy (reference dfstore CopyObject is GET+PUT): the
+        source streams chunk-by-chunk into a chunked PUT, so a multi-GB
+        shard copy holds one chunk in memory, not the object. Returns the
+        stored digest."""
+        chunks = await self.stream_object(bucket, src_key)
+        url = self._object_url(bucket, dst_key) + f"?mode={mode}"
+        async with self._http().put(url, data=chunks,
+                                    timeout=self.stream_timeout) as r:
+            if r.status != 200:
+                raise DfstoreError(await r.text(), r.status)
+            return (await r.json()).get("digest", "")
 
     async def prefetch_object(self, bucket: str, key: str,
                               device: str = "",

@@ -254,12 +254,70 @@ class ObjectStorageService:
         return (_PieceFileResponse(store.data_path, range_header, release),
                 count)
 
+    async def _get_object_ranged_task(self, request: web.Request,
+                                      bucket: str, key: str,
+                                      rng_header: str) -> web.StreamResponse:
+        """`?ranged_task=1` + Range: serve the span as its own RANGED file
+        task instead of a window over the whole-object stream task. Task
+        identity includes the canonical range, so (a) a cold read fetches
+        ONLY the span's bytes from origin/peers, (b) every host reading
+        the same span dedupes on one task, and (c) a warm whole-object
+        store satisfies it locally (import_range_from_local_parent). This
+        is the dataset plane's sample-read path (dataset/shard_reader.py);
+        whole-shard consumers keep the plain GET."""
+        from dragonfly2_tpu.daemon.peer.task_manager import FileTaskRequest
+        from dragonfly2_tpu.pkg.piece import Range
+        from dragonfly2_tpu.proto.common import UrlMeta
+
+        try:
+            rng = Range.normalize_header(rng_header)
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=f"bad range {rng_header!r}: {e}")
+        url = self.backend.object_url(bucket, key)
+        req = FileTaskRequest(url=url, output="",
+                              meta=UrlMeta(tag=bucket, range=rng))
+        req.range = Range.parse_http(rng)
+        final = None
+        try:
+            async for p in self.transport.task_manager.start_file_task(req):
+                if p.state == "failed":
+                    raise DfError.from_wire(p.error or {})
+                final = p
+        except DfError as e:
+            OBJ_REQUESTS.labels("GET", "error").inc()
+            raise web.HTTPBadGateway(text=f"ranged task failed: {e}")
+        if final is None or final.state != "done":
+            OBJ_REQUESTS.labels("GET", "error").inc()
+            raise web.HTTPBadGateway(text="ranged task ended without result")
+        store = self.transport.task_manager.storage.find_completed_task(
+            final.task_id)
+        if store is None:
+            OBJ_REQUESTS.labels("GET", "error").inc()
+            raise web.HTTPBadGateway(text="ranged task store missing")
+        # The ranged store's data file IS the span: sendfile it whole.
+        count = store.metadata.content_length
+        store.pin()
+
+        def release() -> None:
+            store.unpin()
+            OBJ_BYTES.labels("out").inc(count)
+            OBJ_REQUESTS.labels("GET", "ok").inc()
+
+        resp = _PieceFileResponse(store.data_path, None, release)
+        resp.headers["X-Dragonfly-Task-Id"] = final.task_id
+        resp.headers["X-Dragonfly-From-Reuse"] = \
+            "1" if final.from_reuse else "0"
+        return resp
+
     async def _get_object(self, request: web.Request) -> web.StreamResponse:
         """GET via the P2P fabric (reference :253 getObject → stream task)."""
         bucket, key = request.match_info["bucket"], request.match_info["key"]
         url = self.backend.object_url(bucket, key)
         headers = {"X-Dragonfly-Tag": bucket}
         rng_header = request.headers.get("Range", "")
+        if rng_header and request.query.get("ranged_task"):
+            return await self._get_object_ranged_task(request, bucket, key,
+                                                      rng_header)
         if rng_header:
             headers["Range"] = rng_header
         try:

@@ -1,0 +1,140 @@
+"""Batch landing: loader samples → fixed-size record batches on device.
+
+The last hop of the dataset plane: instead of a host-side copy loop
+(bytes → np.stack → device_put), fixed-size records land through
+``ops.hbm_sink.HBMSink`` piece-per-record — each record stages into a
+device batch exactly like a P2P piece, the batch is verified ON DEVICE
+against host checksums (the same verify-on-land contract as the
+``--device=tpu`` sink, daemon/peer/device_sink.py), and the batch
+materializes as a ``(batch, record_bytes)`` uint8 device array in one
+fused assembly dispatch.
+
+On a CPU-only JAX backend (``JAX_PLATFORMS=cpu``) — or with no usable
+jax at all — the feed degrades to plain NumPy batches (``force_hbm=True``
+keeps the sink path for tests and CPU-backend verification).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from dragonfly2_tpu.pkg import dflog, metrics
+
+log = dflog.get("dataset.device_feed")
+
+DEVICE_BATCHES = metrics.counter(
+    "dataset_device_batches_total",
+    "Record batches produced by the device feed", ("path",))
+
+
+class DeviceFeedError(Exception):
+    pass
+
+
+@dataclass
+class DeviceBatch:
+    """One landed batch: ``array`` is (n, record_bytes) uint8 — a device
+    array on the HBM path, np.ndarray on the fallback."""
+
+    keys: list[str]
+    array: object
+    on_device: bool
+
+
+def _hbm_available() -> bool:
+    try:
+        import jax
+
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False
+
+
+class DeviceFeed:
+    """Consumes a sample iterator (``PodShardedLoader.epoch()``) and
+    yields fixed-size record batches of one member extension.
+
+    ``record_bytes``: every record must be exactly this long, unless
+    ``pad=True`` (shorter records are zero-padded; longer ones always
+    raise — silent truncation would corrupt training data). The final
+    short batch is yielded unless ``drop_last``.
+    """
+
+    def __init__(self, ext: str, record_bytes: int, batch_size: int, *,
+                 pad: bool = False, drop_last: bool = False,
+                 device=None, force_hbm: bool = False):
+        if record_bytes <= 0 or batch_size <= 0:
+            raise DeviceFeedError("record_bytes and batch_size must be > 0")
+        self.ext = ext
+        self.record_bytes = record_bytes
+        self.batch_size = batch_size
+        self.pad = pad
+        self.drop_last = drop_last
+        self.device = device
+        self.use_hbm = force_hbm or _hbm_available()
+
+    def _record(self, sample: dict) -> bytes:
+        data = sample.get(self.ext)
+        if data is None:
+            raise DeviceFeedError(
+                f"sample {sample.get('__key__')!r} has no {self.ext!r} member")
+        if len(data) > self.record_bytes:
+            raise DeviceFeedError(
+                f"sample {sample.get('__key__')!r}: {self.ext} is "
+                f"{len(data)}B > record_bytes={self.record_bytes}")
+        if len(data) < self.record_bytes:
+            if not self.pad:
+                raise DeviceFeedError(
+                    f"sample {sample.get('__key__')!r}: {self.ext} is "
+                    f"{len(data)}B != record_bytes={self.record_bytes} "
+                    "(pass pad=True to zero-pad)")
+            data = data + b"\0" * (self.record_bytes - len(data))
+        return data
+
+    def _land_hbm(self, keys: list[str], records: list[bytes]) -> DeviceBatch:
+        from dragonfly2_tpu.ops.hbm_sink import HBMSink
+
+        padded = self.record_bytes + ((-self.record_bytes) % 4)
+        sink = HBMSink(padded * len(records), padded, device=self.device,
+                       batch_pieces=min(len(records), 64))
+        for i, rec in enumerate(records):
+            sink.land_piece(i, rec)
+        sink.verify()   # on-device checksums vs host values
+        arr = sink.as_record_batch(len(records), self.record_bytes)
+        DEVICE_BATCHES.labels("hbm").inc()
+        return DeviceBatch(keys=keys, array=arr, on_device=True)
+
+    def _land_numpy(self, keys: list[str], records: list[bytes]) -> DeviceBatch:
+        import numpy as np
+
+        arr = np.frombuffer(b"".join(records), dtype=np.uint8).reshape(
+            len(records), self.record_bytes)
+        DEVICE_BATCHES.labels("numpy").inc()
+        return DeviceBatch(keys=keys, array=arr, on_device=False)
+
+    def _land(self, keys: list[str], records: list[bytes]) -> DeviceBatch:
+        if self.use_hbm:
+            try:
+                return self._land_hbm(keys, records)
+            except DeviceFeedError:
+                raise
+            except Exception as e:
+                # Device trouble (OOM, runtime) degrades to host batches —
+                # the input pipeline must outlive a sink hiccup.
+                log.warning("HBM batch landing failed; numpy fallback",
+                            error=str(e)[:200])
+                self.use_hbm = False
+        return self._land_numpy(keys, records)
+
+    async def batches(self, samples):
+        """Async generator: sample dicts in → DeviceBatch out."""
+        keys: list[str] = []
+        records: list[bytes] = []
+        async for sample in samples:
+            keys.append(sample.get("__key__", ""))
+            records.append(self._record(sample))
+            if len(records) == self.batch_size:
+                yield self._land(keys, records)
+                keys, records = [], []
+        if records and not self.drop_last:
+            yield self._land(keys, records)

@@ -401,6 +401,25 @@ class HBMSink:
         u8 = jax.lax.bitcast_convert_type(flat, jnp.uint8).reshape(-1)
         return u8[: self.content_length]
 
+    def as_record_batch(self, count: int, record_bytes: int):
+        """The landed content as a ``(count, record_bytes)`` uint8 device
+        array, for piece-per-record landings (dataset/device_feed.py):
+        each piece slot holds one record zero-padded to the piece size,
+        so the batch is a reshape of the padded words plus a column
+        slice — no host copies, one device view of the assembly."""
+        if count != self.total_pieces:
+            raise ValueError(
+                f"record batch of {count} over a {self.total_pieces}-piece "
+                "sink")
+        if record_bytes > self.piece_size:
+            raise ValueError(
+                f"record_bytes {record_bytes} exceeds piece size "
+                f"{self.piece_size}")
+        flat = self._assemble()
+        u8 = jax.lax.bitcast_convert_type(flat, jnp.uint8).reshape(
+            self.total_pieces, self.piece_size)
+        return u8[:, :record_bytes]
+
     def as_tensor(self, dtype, shape):
         """Bitcast the landed bytes to a checkpoint tensor, staying on
         device (e.g. ('bfloat16', [8192, 4096]))."""

@@ -45,3 +45,69 @@ async def start_range_origin(content: bytes):
     await site.start()
     port = site._server.sockets[0].getsockname()[1]
     return runner, f"http://127.0.0.1:{port}/content", stats
+
+
+class GatewayFixture:
+    """In-process daemon data plane: an FS-backed object-storage gateway
+    on a REAL TaskManager, so gateway GETs / ranged-task reads genuinely
+    ride the P2P task machinery. The one fixture for every test/bench
+    that needs a live gateway without spawning a daemon process."""
+
+    def __init__(self, svc, port: int, tm, storage, backend, sinks=None):
+        self.svc = svc
+        self.port = port
+        self.tm = tm
+        self.storage = storage
+        self.backend = backend
+        self.sinks = sinks
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def object_url(self, bucket: str, key: str) -> str:
+        """The backend origin URL a gateway GET resolves for bucket/key —
+        what DaemonRangeFetcher and task-identity assertions need."""
+        return self.backend.object_url(bucket, key)
+
+    async def aclose(self) -> None:
+        await self.svc.close()
+        if self.sinks is not None:
+            self.sinks.close()
+        self.storage.close()
+
+
+async def start_gateway_fixture(workdir, *, device_sinks: bool = False,
+                                concurrency: int = 2,
+                                **svc_kwargs) -> GatewayFixture:
+    """Serve an ObjectStorageService on 127.0.0.1:<ephemeral> backed by
+    ``workdir/buckets`` (FS backend) and a piece store in ``workdir/p2p``.
+    ``device_sinks`` attaches a DeviceSinkManager (prefetch --device=tpu
+    paths). Callers ``await fixture.aclose()`` when done."""
+    import os
+
+    from dragonfly2_tpu.daemon.objectstorage import ObjectStorageService
+    from dragonfly2_tpu.daemon.peer.piece_manager import (
+        PieceManager,
+        PieceManagerOption,
+    )
+    from dragonfly2_tpu.daemon.peer.task_manager import TaskManager
+    from dragonfly2_tpu.daemon.transport import P2PTransport
+    from dragonfly2_tpu.pkg.objectstorage.fs import FSObjectStorage
+    from dragonfly2_tpu.storage import StorageManager, StorageOption
+
+    workdir = str(workdir)
+    backend = FSObjectStorage(root=os.path.join(workdir, "buckets"))
+    storage = StorageManager(
+        StorageOption(data_dir=os.path.join(workdir, "p2p")))
+    sinks = None
+    if device_sinks:
+        from dragonfly2_tpu.daemon.peer.device_sink import DeviceSinkManager
+
+        sinks = DeviceSinkManager()
+    tm = TaskManager(storage,
+                     PieceManager(PieceManagerOption(concurrency=concurrency)),
+                     device_sinks=sinks)
+    svc = ObjectStorageService(backend, P2PTransport(tm), **svc_kwargs)
+    port = await svc.serve("127.0.0.1", 0)
+    return GatewayFixture(svc, port, tm, storage, backend, sinks)

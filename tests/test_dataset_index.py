@@ -1,0 +1,239 @@
+"""Dataset plane: tar-shard indexing against pathological archives.
+
+The indexer is a from-scratch streaming header walk, so Python's tarfile
+serves as the independent oracle: member names, sizes and data offsets
+must agree for every dialect tarfile can write (ustar, GNU long names,
+pax), and failure modes (truncation, corruption) must surface as TYPED
+errors — a silently partial index would drop training samples.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import tarfile
+
+import pytest
+
+from dragonfly2_tpu.dataset import tar_index
+from dragonfly2_tpu.dataset.tar_index import (
+    ShardIndex,
+    TarIndexer,
+    TarIndexError,
+    TruncatedShardError,
+    group_samples,
+    index_tar_bytes,
+)
+
+
+def make_tar(entries, fmt=tarfile.USTAR_FORMAT) -> bytes:
+    """entries: (name, payload) for files, (name, None, linktype, target)
+    for links."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w", format=fmt) as tar:
+        for entry in entries:
+            if len(entry) == 2:
+                name, payload = entry
+                info = tarfile.TarInfo(name=name)
+                info.size = len(payload)
+                tar.addfile(info, io.BytesIO(payload))
+            else:
+                name, _, linktype, target = entry
+                info = tarfile.TarInfo(name=name)
+                info.type = linktype
+                info.linkname = target
+                tar.addfile(info)
+    return buf.getvalue()
+
+
+def oracle(data: bytes) -> list[tuple[str, int, int]]:
+    """tarfile's view: (name, data_offset, size) of regular members."""
+    out = []
+    with tarfile.open(fileobj=io.BytesIO(data)) as tar:
+        for ti in tar:
+            if ti.isreg():
+                out.append((ti.name, ti.offset_data, ti.size))
+    return out
+
+
+def webdataset_entries(n_samples: int, payload=lambda i: b"x" * (100 + i)):
+    entries = []
+    for i in range(n_samples):
+        entries.append((f"{i:06d}.jpg", payload(i)))
+        entries.append((f"{i:06d}.cls", str(i % 10).encode()))
+    return entries
+
+
+@pytest.mark.parametrize("fmt", [tarfile.USTAR_FORMAT, tarfile.GNU_FORMAT,
+                                 tarfile.PAX_FORMAT])
+def test_index_matches_tarfile_oracle(fmt):
+    data = make_tar(webdataset_entries(5), fmt=fmt)
+    idx = index_tar_bytes(data, "train-0.tar")
+    assert idx.size == len(data)
+    got = [(m.name, m.data_offset, m.size) for m in idx.members]
+    assert got == oracle(data)
+    assert [s.key for s in idx.samples] == [f"{i:06d}" for i in range(5)]
+    for i, s in enumerate(idx.samples):
+        parts = dict(s.parts)
+        assert set(parts) == {"jpg", "cls"}
+        jpg = idx.members[parts["jpg"]]
+        assert data[jpg.data_offset:jpg.data_offset + jpg.size] \
+            == b"x" * (100 + i)
+
+
+@pytest.mark.parametrize("fmt", [tarfile.GNU_FORMAT, tarfile.PAX_FORMAT])
+def test_long_names(fmt):
+    """>100-char member names ride GNU 'L' or pax 'x' extensions; the
+    extension blocks must not shift data offsets."""
+    deep = "a/" * 70
+    entries = [(f"{deep}{i:04d}.bin", b"payload-%d" % i) for i in range(3)]
+    data = make_tar(entries, fmt=fmt)
+    idx = index_tar_bytes(data)
+    assert [(m.name, m.data_offset, m.size) for m in idx.members] \
+        == oracle(data)
+    assert all(m.name.startswith(deep) for m in idx.members)
+
+
+def test_pax_non_ascii_and_long_linkname():
+    entries = [("émoji/" + "x" * 120 + ".jpg", b"d" * 7)]
+    data = make_tar(entries, fmt=tarfile.PAX_FORMAT)
+    idx = index_tar_bytes(data)
+    assert [(m.name, m.data_offset, m.size) for m in idx.members] \
+        == oracle(data)
+
+
+def test_links_recorded_not_sampled():
+    entries = [
+        ("0001.jpg", b"a" * 64),
+        ("0001.cls", b"3"),
+        ("alias.jpg", None, tarfile.SYMTYPE, "0001.jpg"),
+        ("hard.jpg", None, tarfile.LNKTYPE, "0001.jpg"),
+        ("0002.jpg", b"b" * 64),
+    ]
+    data = make_tar(entries)
+    idx = index_tar_bytes(data)
+    assert [(m.name, m.data_offset, m.size) for m in idx.members] \
+        == oracle(data)
+    assert [(m.name, m.typeflag, m.linkname) for m in idx.links] == \
+        [("alias.jpg", "2", "0001.jpg"), ("hard.jpg", "1", "0001.jpg")]
+    assert [s.key for s in idx.samples] == ["0001", "0002"]
+
+
+def test_non_512_aligned_final_block_tolerated():
+    """EOF right after the last data byte (no final padding, no
+    end-of-archive blocks) — seen in the wild; must index fully."""
+    data = make_tar(webdataset_entries(3))
+    last = oracle(data)[-1]
+    cut = data[: last[1] + last[2]]
+    assert len(cut) % 512 != 0
+    idx = index_tar_bytes(cut)
+    assert [(m.name, m.data_offset, m.size) for m in idx.members] \
+        == oracle(data)
+    assert len(idx.samples) == 3
+
+
+def test_missing_end_blocks_tolerated():
+    """EOF at a clean member boundary without the two zero blocks."""
+    data = make_tar(webdataset_entries(2))
+    last = oracle(data)[-1]
+    end = last[1] + last[2]
+    end += (-end) % 512   # keep the final padding, drop the zero blocks
+    idx = index_tar_bytes(data[:end])
+    assert len(idx.members) == 4
+
+
+def test_truncated_mid_data_raises_typed():
+    data = make_tar(webdataset_entries(3))
+    last = oracle(data)[-1]
+    cut = data[: last[1] + last[2] // 2]
+    with pytest.raises(TruncatedShardError):
+        index_tar_bytes(cut)
+
+
+def test_truncated_mid_header_raises_typed():
+    data = make_tar(webdataset_entries(2))
+    with pytest.raises(TruncatedShardError):
+        index_tar_bytes(data[:100])
+    # ...and mid-extension: cut inside a GNU longname header's payload.
+    long = make_tar([("n" * 150 + ".jpg", b"x")], fmt=tarfile.GNU_FORMAT)
+    with pytest.raises(TruncatedShardError):
+        index_tar_bytes(long[:512 + 64])
+
+
+def test_corrupt_checksum_raises():
+    data = bytearray(make_tar(webdataset_entries(1)))
+    data[0] ^= 0xFF   # clobber the first header's name byte
+    with pytest.raises(TarIndexError):
+        index_tar_bytes(bytes(data))
+
+
+def test_lone_zero_block_raises():
+    data = make_tar(webdataset_entries(1))
+    first_member_end = 512 + 512   # header + padded 100-byte payload
+    bad = (data[:first_member_end] + b"\0" * 512
+           + data[first_member_end:])
+    with pytest.raises(TarIndexError):
+        index_tar_bytes(bad)
+
+
+@pytest.mark.parametrize("chunk", [1, 7, 511, 512, 513, 1 << 16])
+def test_incremental_feed_any_chunking(chunk):
+    """The streaming indexer must be split-point independent."""
+    data = make_tar(webdataset_entries(4), fmt=tarfile.GNU_FORMAT)
+    ix = TarIndexer()
+    for i in range(0, len(data), chunk):
+        ix.feed(data[i:i + chunk])
+    idx = ix.finish("s")
+    assert [(m.name, m.data_offset, m.size) for m in idx.members] \
+        == oracle(data)
+    assert idx.size == len(data)
+
+
+def test_sample_grouping_rules():
+    members = [
+        tar_index.TarMember("a/b/0001.seg.png", 0, 512, 10),
+        tar_index.TarMember("a/b/0001.jpg", 1024, 1536, 10),
+        tar_index.TarMember("a/b/0002.jpg", 2048, 2560, 10),
+        tar_index.TarMember("a/c/0001.jpg", 3072, 3584, 10),  # distinct dir
+        tar_index.TarMember("a/b/.hidden", 4096, 4608, 10),   # no stem
+        tar_index.TarMember("a/b/0001.jpg", 5120, 5632, 10),  # dup ext
+    ]
+    samples = group_samples(members)
+    assert [s.key for s in samples] == ["a/b/0001", "a/b/0002", "a/c/0001"]
+    first = dict(samples[0].parts)
+    assert first == {"seg.png": 0, "jpg": 1}   # dup kept first
+
+
+def test_index_json_roundtrip():
+    data = make_tar(webdataset_entries(3) + [
+        ("alias.jpg", None, tarfile.SYMTYPE, "000000.jpg")])
+    idx = index_tar_bytes(data, "train-7.tar")
+    raw = idx.to_json_bytes()
+    back = ShardIndex.from_json_bytes(raw)
+    assert back.shard == "train-7.tar"
+    assert back.size == idx.size
+    assert back.members == idx.members
+    assert back.samples == idx.samples
+    assert [(m.name, m.typeflag, m.linkname) for m in back.links] \
+        == [(m.name, m.typeflag, m.linkname) for m in idx.links]
+
+
+def test_index_json_rejects_garbage():
+    with pytest.raises(TarIndexError):
+        ShardIndex.from_json_bytes(b"not json")
+    with pytest.raises(TarIndexError):
+        ShardIndex.from_json_bytes(json.dumps(
+            {"v": 99, "shard": "s", "size": 0, "members": [],
+             "samples": []}).encode())
+    # Sample referencing a member index out of range.
+    with pytest.raises(TarIndexError):
+        ShardIndex.from_json_bytes(json.dumps(
+            {"v": 1, "shard": "s", "size": 0, "members": [],
+             "samples": [["k", [["jpg", 0]]]]}).encode())
+
+
+def test_empty_archive():
+    data = make_tar([])
+    idx = index_tar_bytes(data)
+    assert idx.members == [] and idx.samples == []
+    assert idx.size == len(data)

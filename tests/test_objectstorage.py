@@ -512,6 +512,80 @@ def test_gateway_streaming_get(run_async, tmp_path):
     run_async(run())
 
 
+def test_stream_object_ranged_and_no_total_timeout(run_async, tmp_path):
+    """stream_object accepts a range like get_object, and long streams
+    ride a per-read timeout, not the session-wide total (a 60 s budget
+    must not kill a large cold shard mid-stream)."""
+
+    async def run():
+        svc, port, _ = await start_gateway(tmp_path)
+        # Pathologically small total timeout: streaming must not use it.
+        store = Dfstore(f"http://127.0.0.1:{port}", timeout=0.001,
+                        read_timeout=30.0)
+        assert store.stream_timeout.total is None
+        assert store.stream_timeout.sock_read == 30.0
+        try:
+            await asyncio.sleep(0.01)  # put via a fresh, sane-timeout store
+            setup = Dfstore(f"http://127.0.0.1:{port}")
+            payload = os.urandom(2 * 1024 * 1024 + 13)
+            await setup.create_bucket("w")
+            await setup.put_object("w", "t.tar", payload, mode="write_back")
+            await setup.close()
+            got = b""
+            async for chunk in await store.stream_object(
+                    "w", "t.tar", range_header="1000-99999"):
+                got += chunk
+            assert got == payload[1000:100000]
+            # bytes= prefix form too
+            got2 = b""
+            async for chunk in await store.stream_object(
+                    "w", "t.tar", range_header="bytes=0-9"):
+                got2 += chunk
+            assert got2 == payload[:10]
+            # Whole-object stream with the absurd total timeout still runs.
+            whole = b""
+            async for chunk in await store.stream_object("w", "t.tar"):
+                whole += chunk
+            assert whole == payload
+        finally:
+            await store.close()
+            await svc.close()
+
+    run_async(run())
+
+
+def test_copy_object_streams_without_buffering(run_async, tmp_path):
+    """copy_object must stream chunk-by-chunk (never a whole-object
+    get_object), return the digest, and produce a byte-exact copy."""
+
+    async def run():
+        svc, port, _ = await start_gateway(tmp_path)
+        store = Dfstore(f"http://127.0.0.1:{port}")
+
+        async def poisoned_get(*a, **k):
+            raise AssertionError("copy_object buffered via get_object")
+
+        store.get_object = poisoned_get
+        try:
+            setup = Dfstore(f"http://127.0.0.1:{port}")
+            payload = os.urandom(3 * 1024 * 1024 + 7)
+            await setup.create_bucket("c")
+            digest = await setup.put_object("c", "src.bin", payload,
+                                            mode="write_back")
+            copied_digest = await store.copy_object("c", "src.bin", "dst.bin",
+                                                    mode="write_back")
+            assert copied_digest == digest
+            assert await setup.get_object("c", "dst.bin") == payload
+            with pytest.raises(DfstoreError):
+                await store.copy_object("c", "ghost.bin", "dst2.bin")
+            await setup.close()
+        finally:
+            await store.close()
+            await svc.close()
+
+    run_async(run())
+
+
 def test_replication_task_id_matches_gateway_get(run_async, tmp_path):
     """Regression: replicated copies must live under the SAME task ID a
     gateway GET produces, or seeds prefetch into a task no GET ever hits."""
