@@ -139,7 +139,10 @@ async def run_bench(total_mb: int, n_peers: int, workdir: str,
                     device_sink: bool = False,
                     warm_seed: bool = False,
                     slices: int = 0,
+                    stripe: bool = False,
+                    measure_locality: bool = False,
                     host_hash_gbps: "float | None" = None) -> dict:
+    measure_locality = measure_locality or stripe
     # randbytes caps at 2^31 bits; build large content from 16 MiB blocks.
     rng = random.Random(99)
     content = b"".join(rng.randbytes(16 << 20)
@@ -195,9 +198,15 @@ async def run_bench(total_mb: int, n_peers: int, workdir: str,
         procs.append(_spawn(seed_args, os.path.join(workdir, "seed.log")))
         if slices and slices > n_peers:
             raise ValueError(f"--slices {slices} > --peers {n_peers}")
+        peer_metrics: dict[int, int] = {}
         for i in range(n_peers):
             peer_args = ["daemon", "--work-home", homes[f"peer{i}"],
                          "--scheduler", f"127.0.0.1:{sched_port}"]
+            if measure_locality:
+                # Per-daemon locality byte counters are the per-host DCN
+                # readout; each peer gets its own metrics endpoint.
+                peer_metrics[i] = _free_port()
+                peer_args += ["--metrics-port", str(peer_metrics[i])]
             if slices:
                 # Even partition into EXACTLY `slices` contiguous groups
                 # (i*slices//n_peers), so the published "slices" field
@@ -209,7 +218,10 @@ async def run_bench(total_mb: int, n_peers: int, workdir: str,
             if device_sink:
                 peer_args += ["--device-sink"]
             if profile and i == 0:
-                peer_args += ["--metrics-port", str(peer0_metrics)]
+                if measure_locality:
+                    peer0_metrics = peer_metrics[0]  # already serving one
+                else:
+                    peer_args += ["--metrics-port", str(peer0_metrics)]
             procs.append(_spawn(peer_args,
                                 os.path.join(workdir, f"peer{i}.log"),
                                 jax_cpu=device_sink))
@@ -262,6 +274,7 @@ async def run_bench(total_mb: int, n_peers: int, workdir: str,
                                              "dfdaemon.sock"),
                     meta=UrlMeta(digest=f"sha256:{sha}"),
                     device="tpu" if device_sink else "",
+                    pod_broadcast=stripe,
                     allow_source_fallback=False, timeout=600.0),
                 on_progress)
             if result.get("state") != "done":
@@ -360,6 +373,42 @@ async def run_bench(total_mb: int, n_peers: int, workdir: str,
             labeled = picks["intra"] + picks["cross"]
             if labeled:
                 result["intra_slice_frac"] = round(picks["intra"] / labeled, 3)
+        if measure_locality:
+            # Per-host DCN bytes from each daemon's own locality counters
+            # (conductor PIECE_BYTES): cross = bytes that really crossed
+            # slices (the seed carries an out-of-band slice label, so seed
+            # ingress counts as cross — exactly the DCN bill).
+            import aiohttp
+
+            from dragonfly2_tpu.pkg.metrics import parse_labeled_samples
+
+            per_host: dict[str, dict] = {}
+            async with aiohttp.ClientSession() as s:
+                for i, mport in peer_metrics.items():
+                    try:
+                        async with s.get(
+                                f"http://127.0.0.1:{mport}/metrics",
+                                timeout=aiohttp.ClientTimeout(
+                                    total=5)) as resp:
+                            samples = parse_labeled_samples(
+                                await resp.text(),
+                                "dragonfly_tpu_peer_piece_bytes_total",
+                                "locality")
+                    except Exception as e:  # noqa: BLE001 - diagnostics
+                        samples = {"scrape_error": str(e)}
+                    per_host[f"peer{i}"] = samples
+            result["stripe"] = stripe
+            result["per_host_dcn_mb"] = {
+                name: round(v.get("cross", 0) / (1 << 20), 2)
+                for name, v in per_host.items()}
+            dcn = [v.get("cross", 0) for v in per_host.values()
+                   if isinstance(v.get("cross", 0), int)]
+            intra = [v.get("intra", 0) for v in per_host.values()
+                     if isinstance(v.get("intra", 0), int)]
+            if dcn:
+                result["max_host_dcn_mb"] = round(max(dcn) / (1 << 20), 2)
+                result["total_dcn_mb"] = round(sum(dcn) / (1 << 20), 2)
+                result["total_intra_mb"] = round(sum(intra) / (1 << 20), 2)
         # The seed is the only origin client; its request fan-in must stay
         # within the configured concurrency (+1 for the initial HEAD-like
         # probe) — against real GCS this is per-task request pressure.
@@ -402,6 +451,12 @@ def main() -> int:
     ap.add_argument("--slices", type=int, default=0,
                     help="label peer daemons with N tpu slices and report "
                          "the scheduler's real intra/cross handout counts")
+    ap.add_argument("--stripe", action="store_true",
+                    help="paired striped-broadcast run: an unstriped "
+                         "control then a pod_broadcast (striped) run on "
+                         "the same topology, each reporting per-host DCN "
+                         "bytes from the daemons' locality counters; "
+                         "implies --warm-seed and --slices 2 unless set")
     ap.add_argument("--workdir", default="")
     args = ap.parse_args()
 
@@ -411,6 +466,48 @@ def main() -> int:
     # Calibrate BEFORE the fabric exists: ~10 daemon processes contending
     # with the calibration children would depress the reading.
     host_hash_gbps = _host_hash_gbps()
+    if args.stripe:
+        slices = args.slices or 2
+        runs = {}
+        for mode in ("unstriped", "striped"):
+            mode_dir = os.path.join(workdir, mode)
+            os.makedirs(mode_dir, exist_ok=True)
+            runs[mode] = asyncio.run(run_bench(
+                args.mb, args.peers, mode_dir,
+                origin_concurrency=args.origin_concurrency,
+                # Cold seed on purpose: the pod registers while the seed
+                # is still fetching origin, so stripe membership settles
+                # before pieces exist — the "checkpoint lands, pod pulls"
+                # shape. (Warm-seed striping works too, but the first
+                # registrant of a slice can reserve most pieces before
+                # its mates' stripe push arrives, blurring the per-host
+                # DCN accounting this bench exists to publish.)
+                warm_seed=args.warm_seed,
+                slices=slices,
+                stripe=(mode == "striped"),
+                measure_locality=True,
+                host_hash_gbps=host_hash_gbps))
+        result = {
+            "config": "p2p-fanout-striped",
+            "striped": runs["striped"],
+            "unstriped": runs["unstriped"],
+            "speedup": round(runs["striped"]["aggregate_gbps"]
+                             / runs["unstriped"]["aggregate_gbps"], 3),
+        }
+        if runs["striped"].get("total_dcn_mb") and \
+                runs["unstriped"].get("total_dcn_mb"):
+            result["dcn_bytes_ratio"] = round(
+                runs["striped"]["total_dcn_mb"]
+                / runs["unstriped"]["total_dcn_mb"], 3)
+        print(json.dumps(result))
+        if args.publish:
+            path = os.path.join(REPO, "BASELINE.json")
+            doc = json.load(open(path))
+            doc.setdefault("published", {})["config2_fanout_striped"] = result
+            with open(path, "w") as f:
+                json.dump(doc, f, indent=2)
+                f.write("\n")
+        return 0
     result = asyncio.run(run_bench(args.mb, args.peers, workdir,
                                    profile=args.profile,
                                    origin_concurrency=args.origin_concurrency,

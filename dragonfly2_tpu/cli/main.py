@@ -36,6 +36,10 @@ def _add_dfget(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--range", dest="range_", default="", help="byte range a-b")
     p.add_argument("--header", action="append", default=[], help="k:v (repeatable)")
     p.add_argument("--disable-back-source", action="store_true")
+    p.add_argument("--pod-broadcast", action="store_true",
+                   help="register as a striped slice broadcast: each "
+                        "same-slice host DCN-pulls 1/S of the pieces and "
+                        "the slice completes the copy internally")
     p.add_argument("--recursive", action="store_true")
     p.add_argument("--level", type=int, default=5, help="recursion depth")
     p.add_argument("--timeout", type=float, default=0.0)
@@ -73,6 +77,7 @@ def _run_dfget(args: argparse.Namespace) -> int:
         level=args.level,
         timeout=args.timeout,
         device=args.device,
+        pod_broadcast=args.pod_broadcast,
     )
     if not args.output and args.device != "tpu":
         sys.stderr.write("dfget: error: -O/--output is required "

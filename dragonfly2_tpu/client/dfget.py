@@ -32,6 +32,10 @@ class DfgetConfig:
     timeout: float = 0.0                 # 0 = none
     allow_source_fallback: bool = True   # direct fetch if daemon dead
     device: str = ""                     # "tpu": land in daemon's HBM sink
+    # Striped slice broadcast: the same content fans to >=2 hosts of this
+    # host's TPU slice — each pulls 1/S of the bytes over DCN and the
+    # slice completes the copy internally.
+    pod_broadcast: bool = False
 
 
 async def download(cfg: DfgetConfig, on_progress: Callable[[dict], None] | None = None) -> dict:
@@ -69,6 +73,7 @@ async def _daemon_download(cfg: DfgetConfig, on_progress) -> dict:
                 "meta": cfg.meta.to_wire(),
                 "disable_back_source": cfg.disable_back_source,
                 "device": cfg.device,
+                "pod_broadcast": cfg.pod_broadcast,
             },
         )
         final: dict | None = None

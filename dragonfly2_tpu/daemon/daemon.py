@@ -179,6 +179,7 @@ class Daemon:
             "header": dict(request.meta.header),
             "priority": request.meta.priority,
             "range": request.meta.range,
+            "pod_broadcast": getattr(request, "pod_broadcast", False),
         }
         return PeerTaskConductor(
             task_id=task_id,

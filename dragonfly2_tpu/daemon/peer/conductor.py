@@ -37,6 +37,14 @@ PIECE_DOWNLOAD_COUNT = metrics.counter(
     "peer_piece_download_total", "P2P piece downloads", ("result",))
 BACK_SOURCE_COUNT = metrics.counter(
     "peer_back_source_total", "Tasks that fell back to origin")
+# The striped-broadcast yardstick: P2P piece bytes split by parent
+# locality — intra rides the ICI fabric, cross is real DCN traffic,
+# unlabeled means either end lacked TPU coordinates. fanout_bench --stripe
+# scrapes this per daemon for the per-host-DCN-bytes acceptance bound.
+PIECE_BYTES = metrics.counter(
+    "peer_piece_bytes_total",
+    "P2P piece bytes downloaded, by parent ICI locality",
+    ("locality",))
 
 MAX_RESCHEDULES = 8
 
@@ -91,6 +99,12 @@ class PeerTaskConductor:
         self.dispatcher = PieceDispatcher()
         self.downloader = PieceDownloader()
         self.synchronizer: PieceTaskSynchronizer | None = None
+        # Striped slice broadcast: this host's ICI domain, and the bytes
+        # pulled per parent locality (intra = same slice / ICI, cross =
+        # DCN, unlabeled = no coordinates on one end). The task manager
+        # snapshots locality_bytes for benches/tests.
+        self.own_slice = (host_info or {}).get("tpu_slice", "") or ""
+        self.locality_bytes = {"intra": 0, "cross": 0, "unlabeled": 0}
         self._stream = None
         self._reschedules = 0
         self._from_p2p = False
@@ -127,6 +141,7 @@ class PeerTaskConductor:
             "range": self.meta.get("range", ""),
             "is_seed": self.is_seed,
             "disable_back_source": self.disable_back_source,
+            "pod_broadcast": bool(self.meta.get("pod_broadcast")),
         }
         # Registration phase: any transport failure BEFORE a scheduler
         # answer arrives (connect refused, connect-then-drop, silence)
@@ -346,8 +361,10 @@ class PeerTaskConductor:
         # drop_parent marks them blocked, and the next starvation pass
         # sends them in the reschedule blocklist (ref reportInvalidPeer).
         self.synchronizer = PieceTaskSynchronizer(
-            self.task_id, self.peer_id, self.dispatcher)
+            self.task_id, self.peer_id, self.dispatcher,
+            own_slice=self.own_slice)
         self.synchronizer.sync_parents(schedule_msg.get("parents") or [])
+        self._apply_stripe(schedule_msg.get("stripe"))
         # Resume support: pieces already on disk need no re-download.
         self.dispatcher.mark_known_downloaded(self.store.metadata.pieces.keys())
 
@@ -438,6 +455,36 @@ class PeerTaskConductor:
         arrives) loses ~the hash cost, and the common case saves all N."""
         return min(3.0, 0.05 + 2 * content_length / 1.0e9)
 
+    def _apply_stripe(self, stripe: dict | None) -> None:
+        """Enter/reshuffle/exit stripe mode from a scheduler handout. The
+        plan's mates ride a dedicated field (not the parent DAG — mutual
+        intra-slice serving would be a DAG cycle): sync them like parents,
+        marked same_slice, so non-stripe pieces fill intra-slice while the
+        conductor DCN-fetches only its own stripe."""
+        if stripe and int(stripe.get("slice_size", 0)) >= 2:
+            self.dispatcher.set_stripe(int(stripe["slice_size"]),
+                                       int(stripe.get("slice_rank", -1)))
+            mates = stripe.get("mates") or []
+            if mates and self.synchronizer is not None:
+                self.synchronizer.sync_parents(mates)
+            log.info("stripe plan applied", task=self.task_id[:16],
+                     slice_size=stripe["slice_size"],
+                     slice_rank=stripe.get("slice_rank"), mates=len(mates))
+        else:
+            self.dispatcher.clear_stripe()
+
+    def _note_piece_bytes(self, parent, size: int) -> None:
+        if size <= 0:
+            return
+        if not self.own_slice or not parent.tpu_slice:
+            key = "unlabeled"
+        elif parent.same_slice or parent.tpu_slice == self.own_slice:
+            key = "intra"
+        else:
+            key = "cross"
+        self.locality_bytes[key] += size
+        PIECE_BYTES.labels(key).inc(size)
+
     def _apply_task_meta(self, task_wire: dict) -> None:
         cl = task_wire.get("content_length", -1)
         ps = task_wire.get("piece_size", 0)
@@ -478,6 +525,7 @@ class PeerTaskConductor:
                     self._apply_task_meta(msg.get("task") or {})
                     if self.synchronizer is not None:
                         self.synchronizer.sync_parents(msg.get("parents") or [])
+                    self._apply_stripe(msg.get("stripe"))
                     self._sched_update.set()
                 elif kind in ("need_back_source", "schedule_failed"):
                     if kind == "need_back_source":
@@ -534,6 +582,7 @@ class PeerTaskConductor:
             if rec is not None:
                 self.dispatcher.report_success(a, rec.cost_ms)
                 PIECE_DOWNLOAD_COUNT.labels("ok").inc()
+                self._note_piece_bytes(p, rec.size)
                 await self._report_piece(rec, parent_id=p.peer_id)
                 if self.on_piece is not None:
                     await self.on_piece(self.store, rec)
@@ -576,6 +625,7 @@ class PeerTaskConductor:
                 task_id=self.task_id, peer_id=self.peer_id, limiter=self.limiter)
             self.dispatcher.report_success(assignment, rec.cost_ms)
             PIECE_DOWNLOAD_COUNT.labels("ok").inc()
+            self._note_piece_bytes(p, rec.size)
             await self._report_piece(rec, parent_id=p.peer_id)
             if self.on_piece is not None:
                 await self.on_piece(self.store, rec)

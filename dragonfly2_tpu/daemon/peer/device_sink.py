@@ -105,6 +105,17 @@ class TaskDeviceSink:
     def shard_to_mesh(self, mesh, axis_name: str = "d"):
         return self.sink.shard_to_mesh(mesh, axis_name)
 
+    def ici_broadcast(self, mesh, axis_name: str = "d", n_chunks: int = 4):
+        """Striped-broadcast consumption: replicate the landed content to
+        every device of the mesh via the chunked ring all-gather (ICI
+        completes the copy; the NIC is done once the stripe landed).
+        Requires a verified sink — a striped task must never expose
+        unverified bytes, on device exactly as over upload."""
+        if not self.verified:
+            raise DeviceSinkError(
+                f"ici_broadcast on unverified sink {self.task_id[:16]}")
+        return self.sink.ring_replicate(mesh, axis_name, n_chunks=n_chunks)
+
 
 class DeviceSinkManager:
     """Owns the per-task sinks a daemon is landing. Selected per request

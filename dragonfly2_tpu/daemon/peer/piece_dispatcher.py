@@ -18,6 +18,10 @@ log = dflog.get("peer.piece_dispatcher")
 
 EWMA_ALPHA = 0.3
 RANDOM_RATIO = 0.1  # reference defaultRandomRatio: explore parents
+# Cost EWMAs within this factor of the fastest holder count as tied:
+# the tie breaks on current in-flight assignment count, so equally-fast
+# holders share load instead of herding onto one.
+NEAR_TIE_RATIO = 1.25
 
 
 @dataclass
@@ -29,6 +33,13 @@ class ParentInfo:
     cost_ewma_ms: float = 100.0    # optimistic start
     failures: int = 0
     blocked: bool = False
+    # ICI locality: this parent shares the local host's tpu_slice, so
+    # pulls from it ride the intra-slice fabric, not the DCN NIC. In
+    # stripe mode it is the ONLY class allowed to serve non-stripe pieces.
+    same_slice: bool = False
+    tpu_slice: str = ""
+    # Assignments currently in flight against this parent (tie-breaker).
+    inflight: int = 0
 
 
 @dataclass
@@ -63,6 +74,46 @@ class PieceDispatcher:
         # done, or a potential certifier drops): completion-time waiters
         # (conductor._await_certification) re-evaluate on each set.
         self.certified_event = asyncio.Event()
+        # Striped slice broadcast wanted-set (scheduler stripe plan):
+        # size<=1 = unstriped. In stripe mode only pieces with
+        # piece_num % size == rank may be assigned to cross-slice (DCN)
+        # parents; every other piece fills intra-slice.
+        self._stripe_size = 0
+        self._stripe_rank = -1
+
+    # -- stripe mode -------------------------------------------------------
+
+    @property
+    def stripe(self) -> "tuple[int, int] | None":
+        if self._stripe_size >= 2:
+            return (self._stripe_size, self._stripe_rank)
+        return None
+
+    def set_stripe(self, slice_size: int, slice_rank: int) -> None:
+        """Enter (or reshuffle) stripe mode. Changing the plan re-opens
+        pieces whose assignability changed, so reservations waiting on a
+        dead mate's stripe release cleanly onto the new plan."""
+        if slice_size < 2 or not (0 <= slice_rank < slice_size):
+            self.clear_stripe()
+            return
+        if (slice_size, slice_rank) == (self._stripe_size, self._stripe_rank):
+            return
+        self._stripe_size, self._stripe_rank = slice_size, slice_rank
+        self._wakeup.set()
+
+    def clear_stripe(self) -> None:
+        """Unstriped fallback (lone host / scheduler stopped striping):
+        every piece becomes DCN-assignable again."""
+        if self._stripe_size:
+            self._stripe_size, self._stripe_rank = 0, -1
+            self._wakeup.set()
+
+    def in_stripe(self, piece_num: int) -> bool:
+        """Does this host DCN-fetch ``piece_num`` under the current plan?
+        True for everything when unstriped."""
+        if self._stripe_size < 2:
+            return True
+        return piece_num % self._stripe_size == self._stripe_rank
 
     @property
     def total_piece_count(self) -> int:
@@ -86,15 +137,20 @@ class PieceDispatcher:
 
     # -- topology updates --------------------------------------------------
 
-    def upsert_parent(self, peer_id: str, ip: str, upload_port: int) -> ParentInfo:
+    def upsert_parent(self, peer_id: str, ip: str, upload_port: int,
+                      *, same_slice: bool = False,
+                      tpu_slice: str = "") -> ParentInfo:
         p = self.parents.get(peer_id)
         if p is None:
-            p = ParentInfo(peer_id, ip, upload_port)
+            p = ParentInfo(peer_id, ip, upload_port,
+                           same_slice=same_slice, tpu_slice=tpu_slice)
             self.parents[peer_id] = p
             self._wakeup.set()
         else:
             p.ip, p.upload_port = ip, upload_port
             p.blocked = False
+            p.same_slice = p.same_slice or same_slice
+            p.tpu_slice = p.tpu_slice or tpu_slice
         return p
 
     def drop_parent(self, peer_id: str) -> None:
@@ -187,11 +243,13 @@ class PieceDispatcher:
         p = assignment.parent
         p.cost_ewma_ms = (1 - EWMA_ALPHA) * p.cost_ewma_ms + EWMA_ALPHA * cost_ms
         p.failures = 0
+        p.inflight = max(0, p.inflight - 1)
         self.mark_downloaded(assignment.piece_num)
 
     def report_failure(self, assignment: PieceAssignment, *, parent_gone: bool = False) -> None:
         p = assignment.parent
         p.failures += 1
+        p.inflight = max(0, p.inflight - 1)
         p.cost_ewma_ms *= 2  # punish
         if parent_gone or p.failures >= self._max_parent_failures:
             p.blocked = True
@@ -212,19 +270,35 @@ class PieceDispatcher:
 
     # -- assignment (reference getDesiredReq :104-168) ---------------------
 
-    def _pick_parent(self, piece_num: int) -> ParentInfo | None:
+    def _holders(self, piece_num: int) -> list[ParentInfo]:
+        """Eligible holders under the stripe wanted-set: non-stripe pieces
+        may ONLY come from same-slice parents (never DCN-assigned); stripe
+        pieces prefer a same-slice holder when one exists (a mate that
+        already has the piece beats re-crossing the DCN for it)."""
         holders = [p for p in self.active_parents() if piece_num in p.pieces]
+        if self._stripe_size < 2:
+            return holders
+        intra = [p for p in holders if p.same_slice]
+        if not self.in_stripe(piece_num):
+            return intra
+        return intra or holders
+
+    def _pick_parent(self, piece_num: int) -> ParentInfo | None:
+        holders = self._holders(piece_num)
         if not holders:
             return None
         if random.random() < RANDOM_RATIO:
             return random.choice(holders)
-        return min(holders, key=lambda p: p.cost_ewma_ms)
+        best = min(p.cost_ewma_ms for p in holders)
+        near = [p for p in holders if p.cost_ewma_ms <= best * NEAR_TIE_RATIO]
+        # Near-ties break on current in-flight load, so equally-fast
+        # holders share assignments instead of the min() herding every
+        # piece onto the single lowest-EWMA parent.
+        return min(near, key=lambda p: (p.inflight, p.cost_ewma_ms))
 
     def has_assignable(self) -> bool:
         """Non-mutating peek: could try_get() return an assignment now?"""
-        actives = self.active_parents()
-        return any(
-            any(n in p.pieces for p in actives) for n in self._needed)
+        return any(self._holders(n) for n in self._needed)
 
     def try_get(self) -> PieceAssignment | None:
         """Lowest-numbered needed piece with a live holder; unheld pieces go
@@ -243,6 +317,7 @@ class PieceDispatcher:
                 continue
             self._needed.discard(n)
             self._inflight.add(n)
+            parent.inflight += 1
             expected = -1
             if self.piece_size > 0 and self.content_length >= 0:
                 from dragonfly2_tpu.pkg.piece import piece_length
@@ -283,11 +358,18 @@ class PieceDispatcher:
 
         n = a.piece_num + 1
         while len(run) < max_len and n in self._needed and n in p.pieces:
+            if self._stripe_size >= 2 and not p.same_slice \
+                    and not self.in_stripe(n):
+                # Wanted-set boundary: a DCN parent's span must not spill
+                # into a mate's stripe (stripes interleave mod S, so cross
+                # runs naturally cap at one piece — intra runs stay long).
+                break
             digest = self.piece_digests.get(n, "")
             if digest and not digest.startswith("crc32c:"):
                 break
             self._needed.discard(n)
             self._inflight.add(n)
+            p.inflight += 1
             run.append(PieceAssignment(
                 n, p, piece_length(n, self.piece_size, self.content_length),
                 digest=digest))
@@ -297,6 +379,7 @@ class PieceDispatcher:
     def release_assignment(self, a: PieceAssignment) -> None:
         """Hand an unfetched reservation back (span fallback): no failure
         accounting — the piece simply becomes assignable again."""
+        a.parent.inflight = max(0, a.parent.inflight - 1)
         self._inflight.discard(a.piece_num)
         self._add_needed([a.piece_num])
         self._wakeup.set()

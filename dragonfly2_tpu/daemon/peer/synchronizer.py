@@ -18,6 +18,7 @@ import asyncio
 
 from dragonfly2_tpu.daemon.peer.piece_dispatcher import PieceDispatcher
 from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg.errors import Code, DfError
 from dragonfly2_tpu.pkg.types import NetAddr
 from dragonfly2_tpu.rpc import Client
 
@@ -27,12 +28,24 @@ log = dflog.get("peer.synchronizer")
 class PieceTaskSynchronizer:
     """Manages one sync stream per parent for a single conductor."""
 
+    # Idle-stream keep-alive: a parent that announced everything it has
+    # goes quiet while the child drains its assignment queue — that is a
+    # HEALTHY stream, not a dead one. Instead of one fatal 60 s recv
+    # timeout, recv in keep-alive-sized slices and send the documented
+    # {interested: true} on each idle slice. Class attrs so tests can
+    # shrink the cadence.
+    KEEPALIVE_INTERVAL = 15.0
+
     def __init__(self, task_id: str, peer_id: str, dispatcher: PieceDispatcher,
-                 on_parent_dead=None):
+                 on_parent_dead=None, own_slice: str = ""):
         self.task_id = task_id
         self.peer_id = peer_id
         self.dispatcher = dispatcher
         self.on_parent_dead = on_parent_dead
+        # This host's ICI domain: parents advertising the same tpu_slice
+        # are marked same_slice in the dispatcher (stripe wanted-set +
+        # locality byte accounting).
+        self.own_slice = own_slice
         self._tasks: dict[str, asyncio.Task] = {}
         self._clients: dict[str, Client] = {}
 
@@ -47,7 +60,12 @@ class PieceTaskSynchronizer:
             if not ip or not port or not upload_port:
                 log.warning("parent missing address", parent=peer_id[:24])
                 continue
-            self.dispatcher.upsert_parent(peer_id, ip, upload_port)
+            parent_slice = host.get("tpu_slice", "") or ""
+            self.dispatcher.upsert_parent(
+                peer_id, ip, upload_port,
+                same_slice=bool(self.own_slice)
+                and parent_slice == self.own_slice,
+                tpu_slice=parent_slice)
             # Seed known pieces from the schedule response, and the
             # relayed digests into the SHARED map only (no parent
             # attribution — relayed digests have no provenance and must
@@ -76,7 +94,21 @@ class PieceTaskSynchronizer:
             )
             done = False
             while True:
-                msg = await stream.recv(timeout=60.0)
+                try:
+                    msg = await stream.recv(timeout=self.KEEPALIVE_INTERVAL)
+                except DfError as e:
+                    if e.code != Code.RequestTimeout:
+                        raise
+                    # Idle slice, not a dead stream: the parent may simply
+                    # have announced everything it holds. Keep the stream
+                    # (and the parent) alive while the dispatcher still
+                    # considers it usable; a parent the dispatcher blocked
+                    # (failures, drop) has nothing left to say.
+                    info = self.dispatcher.parents.get(parent_peer_id)
+                    if info is None or info.blocked:
+                        break
+                    await stream.send({"interested": True})
+                    continue
                 if msg is None:
                     break
                 self.dispatcher.on_parent_pieces(

@@ -51,6 +51,10 @@ class FileTaskRequest:
     # Terminal device: "" = disk only; "tpu" additionally lands verified
     # pieces into an HBM sink (daemon/peer/device_sink.py) as they arrive.
     device: str = ""
+    # Striped slice broadcast: register the task as a pod broadcast so the
+    # scheduler stripes the DCN pull across same-slice hosts (1/S of the
+    # bytes each; the rest fills intra-slice).
+    pod_broadcast: bool = False
 
     def task_id(self) -> str:
         return idgen.task_id_v1(
@@ -183,6 +187,11 @@ class TaskManager:
         self.limiter = self.shaper._shared
         self.broker = PieceBroker()
         self._running: dict[str, _RunningTask] = {}
+        # Last completed P2P pull's bytes per parent locality
+        # (conductor.locality_bytes), keyed by task id — the striped
+        # e2e/bench per-host DCN-bytes readout. Bounded: small dicts,
+        # overwritten per task id, cleared with the entry cap below.
+        self.locality_bytes: dict[str, dict] = {}
 
     # -- shared download core ---------------------------------------------
 
@@ -219,7 +228,13 @@ class TaskManager:
                     task_id=task_id, peer_id=peer_id, request=req, store=store,
                     on_piece=on_piece, is_seed=is_seed, limiter=limiter,
                 )
-                await conductor.run()
+                try:
+                    await conductor.run()
+                finally:
+                    if len(self.locality_bytes) > 256:
+                        self.locality_bytes.clear()
+                    self.locality_bytes[task_id] = dict(
+                        getattr(conductor, "locality_bytes", {}) or {})
                 return conductor.from_p2p
             if self.pex is not None:
                 # Schedulerless P2P: gossip told us who holds this task.
@@ -632,7 +647,8 @@ class TaskManager:
         req = FileTaskRequest(url=spec.get("url", ""), output="", meta=meta,
                               disable_back_source=bool(
                                   spec.get("disable_back_source")),
-                              device=spec.get("device", ""))
+                              device=spec.get("device", ""),
+                              pod_broadcast=bool(spec.get("pod_broadcast")))
         if meta.range:
             req.range = Range.parse_http(meta.range)
         task_id = spec.get("task_id") or req.task_id()

@@ -73,6 +73,7 @@ class DaemonRpcServer:
             meta=UrlMeta.from_wire(body.get("meta")),
             disable_back_source=body.get("disable_back_source", False),
             device=device,
+            pod_broadcast=bool(body.get("pod_broadcast")),
         )
         if req.meta.range:
             # Canonicalize at the wire chokepoint: the header is task
@@ -192,6 +193,15 @@ class DaemonRpcServer:
             raise DfError(Code.StorageTaskNotFound, f"task {task_id} not on this peer")
         broker = self.task_manager.broker
         q = broker.subscribe(task_id)
+
+        async def drain_keepalives() -> None:
+            # Children send {interested: true} keep-alives on idle streams;
+            # without a reader they would pool in the stream inbox for the
+            # download's lifetime.
+            while await stream.recv() is not None:
+                pass
+
+        drainer = asyncio.ensure_future(drain_keepalives())
         try:
             if snapshot is not None:
                 await stream.send(snapshot)
@@ -213,6 +223,7 @@ class DaemonRpcServer:
                 if event.done:
                     return
         finally:
+            drainer.cancel()
             broker.unsubscribe(task_id, q)
 
     async def _get_piece_tasks(self, body, ctx: RpcContext):

@@ -448,3 +448,15 @@ class HBMSink:
         # device_put on a device array → XLA moves shards device-to-device
         # (ICI on a TPU slice), no host staging.
         return jax.device_put(buf, NamedSharding(mesh, P(axis_name)))
+
+    def ring_replicate(self, mesh, axis_name: str = "d", n_chunks: int = 4):
+        """The ICI leg of the striped broadcast: spread the landed content
+        over the mesh (one shard per device) and complete the copy with
+        the chunked ppermute ring, so every device ends with the full
+        word buffer without any further NIC traffic. Returns the
+        replicated uint32 array (padded words; callers trim/bitcast)."""
+        from dragonfly2_tpu.parallel.ici import chunked_ring_all_gather
+
+        return chunked_ring_all_gather(
+            mesh, self.shard_to_mesh(mesh, axis_name),
+            axis_name=axis_name, n_chunks=n_chunks)

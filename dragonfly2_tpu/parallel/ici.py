@@ -117,6 +117,116 @@ def ring_all_gather(mesh: Mesh, sharded, axis_name: str = "d"):
     return _ring_all_gather_jit(sharded, mesh=mesh, axis_name=axis_name)
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("axis_name", "mesh", "n_chunks"))
+def _chunked_ring_all_gather_jit(x, *, mesh: Mesh, axis_name: str,
+                                 n_chunks: int):
+    """Chunked ring all-gather: the local shard splits into ``n_chunks``
+    row slices, each gathered by its own N-1-hop ppermute ring. Chunking
+    bounds per-hop message size (the ICI link pipelines hop h of chunk c
+    against hop h-1 of chunk c+1 instead of serializing one shard-sized
+    transfer per hop) and is the unit the striped broadcast overlaps with
+    DCN landing (StripedBroadcast below). Output: the FULL content,
+    replicated, rows in global order."""
+    n = mesh.shape[axis_name]
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=P(axis_name), out_specs=P(),
+        **_NO_CHECK,
+    )
+    def gather(shard):
+        axis_index = jax.lax.axis_index(axis_name)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        rows = shard.shape[0]
+        bounds = [(rows * c // n_chunks, rows * (c + 1) // n_chunks)
+                  for c in range(n_chunks)]
+        outs = []
+        for r0, r1 in bounds:
+            if r1 <= r0:
+                continue
+            cur = jax.lax.slice_in_dim(shard, r0, r1, axis=0)
+
+            def body(i, carry):
+                blocks, c = carry
+                blocks = jax.lax.dynamic_update_index_in_dim(
+                    blocks, c, (axis_index - i) % n, axis=0)
+                c = jax.lax.ppermute(c, axis_name, perm)
+                return blocks, c
+
+            blocks0 = jnp.zeros((n,) + cur.shape, shard.dtype)
+            blocks, _ = jax.lax.fori_loop(0, n, body, (blocks0, cur))
+            outs.append(blocks)
+        full = (jnp.concatenate(outs, axis=1) if len(outs) > 1 else outs[0])
+        # [n, rows, ...] -> [n*rows, ...]: device i's shard occupied global
+        # rows [i*rows, (i+1)*rows), so the flatten restores global order.
+        return full.reshape((-1,) + shard.shape[1:])
+
+    return gather(x)
+
+
+def chunked_ring_all_gather(mesh: Mesh, sharded, axis_name: str = "d",
+                            n_chunks: int = 4):
+    """Every device ends with the full content (replicated), gathered as
+    ``n_chunks`` independent ppermute rings — the ICI leg of the striped
+    slice broadcast. Identical result to all_gather_shards; the chunking
+    exists for hop pipelining and DCN/ICI overlap."""
+    n_chunks = max(1, int(n_chunks))
+    return _chunked_ring_all_gather_jit(sharded, mesh=mesh,
+                                        axis_name=axis_name,
+                                        n_chunks=n_chunks)
+
+
+class StripedBroadcast:
+    """Pipelined striped broadcast driver: DCN landing overlapped with ICI
+    spread.
+
+    Each host of an S-host slice DCN-fetches 1/S of the content (its
+    stripe); the fabric completes the copy. Per stripe chunk k the caller
+    ``feed``s the freshly landed host bytes: feed scatters the chunk onto
+    the mesh and DISPATCHES its ring all-gather without blocking — jax
+    dispatch is async, so the ICI spread of chunk k runs while the daemon
+    lands chunk k+1 from the network. ``result()`` materializes the
+    replicated content with one blocking concatenate at the end.
+
+    Feeding order is the content order: chunk rows concatenate in feed
+    sequence. On the virtual CPU mesh (tests/dryrun) the same code path
+    executes end to end, minus the chip."""
+
+    def __init__(self, mesh: Mesh, axis_name: str = "d", n_chunks: int = 1):
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.n_chunks = max(1, int(n_chunks))
+        self._parts: list[tuple] = []   # (gathered jax.Array, valid_rows)
+
+    def feed(self, host_chunk: np.ndarray) -> None:
+        """Scatter one stripe chunk across the slice and dispatch its
+        gather (non-blocking). The leading dim is padded up to a mesh
+        multiple; result() trims the pad."""
+        n = self.mesh.shape[self.axis_name]
+        arr = np.asarray(host_chunk)
+        rows = arr.shape[0]
+        pad = (-rows) % n
+        if pad:
+            arr = np.concatenate(
+                [arr, np.zeros((pad,) + arr.shape[1:], arr.dtype)])
+        sharded = scatter_shards(self.mesh, arr, self.axis_name)
+        gathered = _chunked_ring_all_gather_jit(
+            sharded, mesh=self.mesh, axis_name=self.axis_name,
+            n_chunks=self.n_chunks)
+        self._parts.append((gathered, rows))
+
+    def result(self):
+        """Block for every dispatched gather and return the replicated
+        content (device array, rows in feed order)."""
+        if not self._parts:
+            raise ValueError("StripedBroadcast.result() before any feed()")
+        trimmed = [g[:rows] for g, rows in self._parts]
+        out = (jnp.concatenate(trimmed, axis=0) if len(trimmed) > 1
+               else trimmed[0])
+        return jax.block_until_ready(out)
+
+
 def bitcast_landed_bytes(buffer, dtype, shape):
     """Reinterpret fabric-landed uint8 HBM bytes as a checkpoint tensor
     without leaving the device (e.g. bf16 weights)."""

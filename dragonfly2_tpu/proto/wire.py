@@ -185,7 +185,10 @@ UNARY: dict[str, Msg] = {
         device=F(str),
         # sharded preheat: warm only this byte range ("bytes=a-b") — a
         # distinct ranged task; stage groups preheat their own spans
-        range=F(str)),
+        range=F(str),
+        # pod-wide preheat: register the triggered pull as a striped
+        # slice broadcast (scheduler answers with a stripe plan)
+        pod_broadcast=F(bool)),
     "Peer.StatTask": Msg("PeerStatTask", task_id=F(str, required=True)),
     "Peer.DeleteTask": Msg("PeerDeleteTask", task_id=F(str, required=True)),
 
@@ -242,12 +245,15 @@ STREAM_OPEN: dict[str, Msg] = {
         peer_id=F(str, required=True), task_id=F(str, required=True),
         url=F(str), tag=F(str), application=F(str), digest=F(str),
         filters=F(list, item=F(str)), header=F(dict), priority=F(int),
-        range=F(str), is_seed=F(bool), disable_back_source=F(bool)),
+        range=F(str), is_seed=F(bool), disable_back_source=F(bool),
+        # striped slice broadcast: the task fans to >=2 same-slice hosts;
+        # the scheduler answers with a stripe plan (piece%S ownership)
+        pod_broadcast=F(bool)),
     "Daemon.Download": Msg(
         "DownloadOpen",
         url=F(str, required=True), output=F(str),
         meta=F(dict, spec=URL_META), disable_back_source=F(bool),
-        device=F(str)),
+        device=F(str), pod_broadcast=F(bool)),
     "Daemon.ExportTask": Msg(
         "ExportTaskOpen",
         cache_id=F(str, required=True), output=F(str, required=True),

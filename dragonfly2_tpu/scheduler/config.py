@@ -48,6 +48,13 @@ class SchedulingConfig:
     # How long to hold a peer that refuses back-to-source (dfcache export)
     # in the schedule loop waiting for a parent to appear.
     no_source_patience: float = 30.0
+    # Striped slice broadcast (scheduling/stripe.py): peers that register
+    # with pod_broadcast=true always stripe once >= 2 same-slice
+    # broadcast peers share the task. Setting this >= 2 additionally
+    # auto-stripes ANY task with that many alive same-slice peers — off
+    # by default so plain fan-outs keep the classic full-copy semantics
+    # unless the deployment opts in.
+    stripe_min_slice_peers: int = 0
     # Evaluator weights (reference evaluator_base.go:28-46); topology terms
     # replace IDC/location weighting when TPU topology metadata is present.
     weight_finished_pieces: float = 0.2

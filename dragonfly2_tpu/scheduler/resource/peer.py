@@ -74,6 +74,11 @@ class Peer:
         self.piece_costs: deque[int] = deque(maxlen=PIECE_COST_WINDOW)
         self.block_parents: set[str] = set()      # parents this peer refuses
         self.reschedule_count = 0
+        # Striped slice broadcast: registered with the pod_broadcast flag
+        # (scheduling/stripe.py), and the last stripe plan pushed to it —
+        # membership changes re-push only when the plan differs.
+        self.pod_broadcast = False
+        self.stripe: dict | None = None
         self.created_at = time.time()
         self.updated_at = time.time()
         # live stream handle for pushing schedule responses (service layer)

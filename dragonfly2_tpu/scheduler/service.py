@@ -38,6 +38,7 @@ from dragonfly2_tpu.scheduler.resource import (
     TaskState,
 )
 from dragonfly2_tpu.scheduler.scheduling import Scheduling
+from dragonfly2_tpu.scheduler.scheduling import stripe as stripe_mod
 from dragonfly2_tpu.scheduler.scheduling.scheduling import ScheduleResult
 from dragonfly2_tpu.scheduler.seed_client import SeedPeerClientPool
 
@@ -54,6 +55,12 @@ PARENT_PICK_COUNT = metrics.counter(
     "Scheduled parent handouts by ICI locality: intra (same tpu_slice), "
     "cross (different slices), unlabeled (either end without coordinates)",
     ("locality",))
+
+STRIPE_HANDOUT_COUNT = metrics.counter(
+    "scheduler_stripe_handouts_total",
+    "Striped-broadcast plan deliveries: striped (handout carried a stripe) "
+    "or reshuffle (membership-change push to a live slice member)",
+    ("kind",))
 
 
 class SchedulerService:
@@ -126,6 +133,10 @@ class SchedulerService:
                 disable_back_source=bool(open_body.get("disable_back_source")),
             )
         )
+        if open_body.get("pod_broadcast"):
+            # Sticky across re-announces: once a peer declared the task a
+            # pod broadcast it stays a stripe member until it leaves.
+            peer.pod_broadcast = True
         return host, task, peer
 
     # ------------------------------------------------------------------ #
@@ -309,11 +320,23 @@ class SchedulerService:
             if peer.fsm.can("download"):
                 peer.fsm.event("download")
             self._mark_task_running(task)
-            await stream.send({
+            msg = {
                 "type": "normal_task",
                 "task": task.to_wire(),
                 "parents": [p.to_wire() for p in result.parents],
-            })
+            }
+            stripe = self._stripe_for(task, peer)
+            peer.stripe = stripe
+            if stripe is not None:
+                msg["stripe"] = stripe
+                STRIPE_HANDOUT_COUNT.labels("striped").inc()
+            await stream.send(msg)
+            if peer.host.tpu_slice:
+                # Membership may have just changed (this peer joined or
+                # reshuffled): re-push differing stripe plans to the other
+                # slice members so every host's wanted-set stays disjoint.
+                aio.spawn(self._push_stripe_updates(
+                    task, peer.host.tpu_slice, exclude=peer.id))
         elif result.kind == ScheduleResult.NEED_BACK_SOURCE:
             self._mark_task_running(task)
             self._to_back_source(task, peer, result.reason)
@@ -322,6 +345,83 @@ class SchedulerService:
         else:
             self._fail_peer(peer)
             await stream.send({"type": "schedule_failed", "reason": result.reason})
+
+    # -- striped slice broadcast (scheduling/stripe.py) --------------------
+
+    def _stripe_members(self, task: Task, slice_name: str) -> list[Peer]:
+        """Alive broadcast peers of ``task`` on ``slice_name``. Succeeded
+        peers stay members: they hold every piece, so keeping their rank
+        costs nothing and spares a reshuffle per finisher; failed/left
+        peers trigger the real reshuffle."""
+        out = []
+        for pid in task.slice_index.get(slice_name, ()):
+            q = task.load_peer(pid)
+            if q is None or q.fsm.current in (PeerState.FAILED,
+                                              PeerState.LEAVE):
+                continue
+            out.append(q)
+        auto = self.config.scheduling.stripe_min_slice_peers
+        if 2 <= auto <= len(out):
+            return out
+        return [q for q in out if q.pod_broadcast]
+
+    def _stripe_for(self, task: Task, peer: Peer) -> dict | None:
+        """This peer's stripe plan, or None (unstriped fallback). Ranged
+        tasks never stripe — the range already narrows the byte window,
+        and mod-S piece ownership over a slice-relative grid would differ
+        per range."""
+        if not peer.host.tpu_slice or peer.range_header or peer.is_seed:
+            return None
+        members = self._stripe_members(task, peer.host.tpu_slice)
+        if peer not in members:
+            return None
+        plan = stripe_mod.plan_stripe(
+            [stripe_mod.member_key(q.host.tpu_worker_index, q.host.id, q.id)
+             for q in members], peer.id)
+        if plan is None:
+            return None
+        # Mates ride a dedicated channel, NOT the parent DAG: intra-slice
+        # exchange is mutual (A serves B's stripe while B serves A's),
+        # which the acyclic parent DAG cannot express — and ICI transfers
+        # don't consume NIC upload slots, so DAG upload accounting would
+        # mis-bill them anyway.
+        plan["slice"] = peer.host.tpu_slice
+        plan["mates"] = [q.to_wire() for q in members
+                         if q.id != peer.id and q.host.upload_port > 0]
+        return plan
+
+    async def _push_stripe_updates(self, task: Task, slice_name: str,
+                                   exclude: str = "") -> None:
+        """Membership changed (join, death, reshuffle): push differing
+        stripe plans to the slice's live members over their announce
+        streams. Parents refresh too — a new mate should also enter the
+        DCN candidate picture where the DAG allows it."""
+        for pid in list(task.slice_index.get(slice_name, ())):
+            if pid == exclude:
+                continue
+            q = task.load_peer(pid)
+            if (q is None or q.announce_stream is None or q.is_done()
+                    or q.fsm.current == PeerState.BACK_TO_SOURCE):
+                continue
+            stripe = self._stripe_for(task, q)
+            if stripe == q.stripe:
+                continue
+            q.stripe = stripe
+            msg = {"type": "normal_task", "task": task.to_wire(),
+                   "parents": []}
+            if stripe is not None:
+                msg["stripe"] = stripe
+            parents = self.scheduling.find_candidate_parents(q)
+            if parents:
+                self.scheduling.reattach_peer(q, parents)
+                msg["parents"] = [p.to_wire() for p in parents]
+            try:
+                await q.announce_stream.send(msg)
+                STRIPE_HANDOUT_COUNT.labels("reshuffle").inc()
+            except Exception:
+                # A dying stream reaps through _on_stream_gone; the push
+                # is best-effort by design.
+                pass
 
     def _mark_task_running(self, task: Task) -> None:
         if task.fsm.can("download"):
@@ -539,6 +639,12 @@ class SchedulerService:
                 task.delete_peer_in_edges(peer.id)
             except Exception:
                 pass
+            if peer.host.tpu_slice and (peer.pod_broadcast or peer.stripe):
+                # Slice peer death: surviving members reshuffle to S-1
+                # stripes (a lone survivor gets no stripe field and falls
+                # back to the unstriped path).
+                aio.spawn(self._push_stripe_updates(
+                    task, peer.host.tpu_slice, exclude=peer.id))
 
     # ------------------------------------------------------------------ #
     # unary RPCs

@@ -1,0 +1,92 @@
+"""BASELINE.json structural validation (fast, tier-1).
+
+Benches publish directly into BASELINE.json["published"]; nothing else
+ever re-reads it programmatically, so a half-written entry (NaN from a
+zero-division, a missing config after a refactor, a truncated write)
+would rot silently. This pins the contract: required configs present,
+every numeric leaf finite, and the striped pair keeps its paired shape.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+
+BASELINE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "BASELINE.json")
+
+# Entries the README / ROADMAP cite; removing one is a deliberate act
+# that should have to touch this list.
+REQUIRED_CONFIGS = (
+    "config1_single",
+    "config2_fanout",
+    "config5_pod_sim",
+    "config2_fanout_striped",
+    "config6_stripe_sim",
+)
+
+
+def _walk_numbers(node, path=""):
+    if isinstance(node, dict):
+        for k, v in node.items():
+            yield from _walk_numbers(v, f"{path}.{k}")
+    elif isinstance(node, (list, tuple)):
+        for i, v in enumerate(node):
+            yield from _walk_numbers(v, f"{path}[{i}]")
+    elif isinstance(node, bool):
+        return
+    elif isinstance(node, (int, float)):
+        yield path, node
+
+
+def _load():
+    with open(BASELINE) as f:
+        return json.load(f)
+
+
+def test_baseline_top_level_shape():
+    doc = _load()
+    assert isinstance(doc.get("metric"), str) and doc["metric"]
+    assert isinstance(doc.get("configs"), list) and doc["configs"]
+    assert isinstance(doc.get("published"), dict) and doc["published"]
+
+
+def test_required_configs_present():
+    published = _load()["published"]
+    missing = [c for c in REQUIRED_CONFIGS if c not in published]
+    assert not missing, f"BASELINE.json lost published configs: {missing}"
+
+
+def test_all_numeric_fields_finite():
+    bad = [(p, v) for p, v in _walk_numbers(_load())
+           if not math.isfinite(v)]
+    assert not bad, f"non-finite numbers in BASELINE.json: {bad[:10]}"
+
+
+def test_striped_entries_paired_shape():
+    """The striped publications are PAIRED runs: both modes present, from
+    the same topology, with the headline ratios derived from them."""
+    published = _load()["published"]
+    for key in ("config2_fanout_striped", "config6_stripe_sim"):
+        entry = published[key]
+        assert "striped" in entry and "unstriped" in entry, key
+        assert entry["speedup"] > 0, key
+        s, u = entry["striped"], entry["unstriped"]
+        for r in (s, u):
+            assert r["aggregate_gbps"] > 0, key
+            assert r["p50_ttfp_s"] >= 0, key
+            assert "per_host_dcn_mb" in r, key
+        # The point of the feature: striping must not DCN-pull more.
+        assert s["max_host_dcn_mb"] <= u["max_host_dcn_mb"], key
+
+
+def test_stripe_sim_meets_acceptance_bounds():
+    """The recorded sim pair keeps the published claim: per-host DCN
+    bytes <= file/S + piece slack, and >= 1.5x aggregate throughput vs
+    the unstriped control."""
+    entry = _load()["published"]["config6_stripe_sim"]
+    s = entry["striped"]
+    bound = s["content_mb"] / s["hosts_per_slice"] + s["piece_mb"]
+    assert s["max_host_dcn_mb"] <= bound, (s["max_host_dcn_mb"], bound)
+    assert entry["speedup"] >= 1.5, entry["speedup"]

@@ -1,0 +1,611 @@
+"""Striped slice broadcast: planner, dispatcher wanted-set, scheduler
+handouts/reshuffles, synchronizer keep-alive, and the 2-slice e2e.
+
+The tentpole invariants:
+  - the stripe plan is a pure function of (slice membership, identity):
+    same inputs on every host -> disjoint, exactly-covering stripes;
+  - a striped dispatcher never DCN-assigns a non-stripe piece (wanted-set
+    semantics), and reshuffles release cleanly when a slice peer dies;
+  - the scheduler hands stripes out on registration and pushes reshuffles
+    on membership change, with the lone-host unstriped fallback;
+  - an idle sync stream is NOT a dead parent (keep-alive satellite).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import random
+
+import pytest
+
+from dragonfly2_tpu.daemon.peer.piece_dispatcher import PieceDispatcher
+from dragonfly2_tpu.scheduler.config import SchedulerConfig
+from dragonfly2_tpu.scheduler.scheduling import stripe as stripe_mod
+from dragonfly2_tpu.scheduler.service import SchedulerService
+
+N_PIECES = 10
+PIECE_SIZE = 1 << 20
+
+
+# --------------------------------------------------------------------- #
+# Plan determinism
+# --------------------------------------------------------------------- #
+
+class TestStripePlan:
+    def test_deterministic_and_order_insensitive(self):
+        members = [(1, "hb", "pb"), (0, "ha", "pa"), (2, "hc", "pc")]
+        plans = [stripe_mod.plan_stripe(list(perm), "pb")
+                 for perm in (members, members[::-1],
+                              [members[2], members[0], members[1]])]
+        assert plans[0] == plans[1] == plans[2]
+        assert plans[0]["slice_size"] == 3
+        assert plans[0]["members"] == ["pa", "pb", "pc"]
+        assert plans[0]["slice_rank"] == 1
+
+    def test_disjoint_exact_cover(self):
+        # Every piece is owned by exactly one member's stripe.
+        members = [(i % 4, f"h{i}", f"p{i}") for i in range(7)]
+        plans = {m[2]: stripe_mod.plan_stripe(members, m[2])
+                 for m in members}
+        sizes = {p["slice_size"] for p in plans.values()}
+        assert sizes == {7}
+        ranks = sorted(p["slice_rank"] for p in plans.values())
+        assert ranks == list(range(7))
+        for piece in range(199):
+            owners = [pid for pid, p in plans.items()
+                      if stripe_mod.in_stripe(piece, p["slice_size"],
+                                              p["slice_rank"])]
+            assert len(owners) == 1, (piece, owners)
+
+    def test_stripe_piece_counts_sum(self):
+        for total in (0, 1, 5, 16, 17):
+            counts = [stripe_mod.stripe_piece_count(total, 5, r)
+                      for r in range(5)]
+            assert sum(counts) == total
+            assert max(counts) - min(counts) <= 1
+
+    def test_lone_host_and_unknown_peer(self):
+        assert stripe_mod.plan_stripe([(0, "h", "p")], "p") is None
+        assert stripe_mod.plan_stripe(
+            [(0, "h", "p"), (1, "i", "q")], "zz") is None
+
+    def test_duplicate_peer_id_collapses(self):
+        plan = stripe_mod.plan_stripe(
+            [(0, "h", "p"), (5, "other", "p"), (1, "i", "q")], "q")
+        assert plan["slice_size"] == 2
+
+
+# --------------------------------------------------------------------- #
+# Dispatcher wanted-set semantics
+# --------------------------------------------------------------------- #
+
+def _dispatcher(total=8) -> PieceDispatcher:
+    d = PieceDispatcher()
+    d.total_piece_count = total
+    d.piece_size = PIECE_SIZE
+    d.content_length = total * PIECE_SIZE
+    return d
+
+
+class TestDispatcherStripe:
+    def test_non_stripe_pieces_never_dcn_assigned(self, monkeypatch):
+        monkeypatch.setattr(random, "random", lambda: 1.0)  # no explore
+        d = _dispatcher(8)
+        cross = d.upsert_parent("cross", "10.0.0.1", 80, tpu_slice="other")
+        cross.pieces.update(range(8))
+        d.set_stripe(4, 1)
+        got = []
+        while (a := d.try_get()) is not None:
+            assert a.parent is cross
+            got.append(a.piece_num)
+        assert got == [1, 5]          # rank 1 of 4: pieces 1 and 5 only
+        assert not d.has_assignable()
+
+        # A mate advertising non-stripe pieces makes them assignable —
+        # intra only.
+        mate = d.upsert_parent("mate", "10.0.0.2", 81, same_slice=True,
+                               tpu_slice="mine")
+        d.on_parent_pieces("mate", [0, 2, 3])
+        got2 = []
+        while (a := d.try_get()) is not None:
+            assert a.parent is mate
+            got2.append(a.piece_num)
+        assert got2 == [0, 2, 3]
+
+    def test_stripe_pieces_prefer_intra_holder(self, monkeypatch):
+        monkeypatch.setattr(random, "random", lambda: 1.0)
+        d = _dispatcher(4)
+        cross = d.upsert_parent("cross", "10.0.0.1", 80, tpu_slice="other")
+        cross.pieces.update(range(4))
+        mate = d.upsert_parent("mate", "10.0.0.2", 81, same_slice=True)
+        mate.pieces.add(0)
+        d.set_stripe(2, 0)
+        a = d.try_get()
+        # Piece 0 is in OUR stripe but a mate already has it: don't
+        # re-cross the DCN for it.
+        assert a.piece_num == 0 and a.parent is mate
+        b = d.try_get()
+        assert b.piece_num == 2 and b.parent is cross
+
+    def test_reshuffle_releases_cleanly(self, monkeypatch):
+        """A slice peer dies -> S shrinks -> pieces the dead mate owned
+        become DCN-assignable under the new plan; pieces still owned by
+        live mates stay off the DCN."""
+        monkeypatch.setattr(random, "random", lambda: 1.0)
+        d = _dispatcher(8)
+        cross = d.upsert_parent("cross", "10.0.0.1", 80, tpu_slice="other")
+        cross.pieces.update(range(8))
+        d.set_stripe(4, 0)
+        while d.try_get() is not None:
+            pass                      # drain our stripe: 0, 4
+        assert not d.has_assignable()
+        d.set_stripe(2, 0)            # two mates died: reshuffle to S=2
+        got = []
+        while (a := d.try_get()) is not None:
+            got.append(a.piece_num)
+        assert got == [2, 6]          # newly ours under S=2 (evens)
+        assert not d.has_assignable()  # odds belong to the survivor mate
+        d.clear_stripe()              # lone-host fallback: everything DCN
+        got2 = []
+        while (a := d.try_get()) is not None:
+            got2.append(a.piece_num)
+        assert got2 == [1, 3, 5, 7]
+
+    def test_extend_run_stops_at_stripe_boundary(self, monkeypatch):
+        monkeypatch.setattr(
+            "dragonfly2_tpu.storage.local_store._native", lambda: object())
+        monkeypatch.setattr(random, "random", lambda: 1.0)
+        d = _dispatcher(8)
+        cross = d.upsert_parent("cross", "10.0.0.1", 80, tpu_slice="other")
+        cross.pieces.update(range(8))
+        mate = d.upsert_parent("mate", "10.0.0.2", 81, same_slice=True)
+        mate.pieces.update(range(8))
+        d.set_stripe(2, 0)
+        a = d.try_get()
+        assert a.piece_num == 0
+        if a.parent is cross:
+            # A cross span must not spill into the mate's stripe.
+            run = d.extend_run(a, 8)
+            assert [r.piece_num for r in run] == [0]
+        else:
+            # Intra spans may cover both stripes.
+            run = d.extend_run(a, 8)
+            assert len(run) > 1
+        for r in run[1:]:
+            d.release_assignment(r)
+
+    def test_near_tie_breaks_on_inflight(self, monkeypatch):
+        monkeypatch.setattr(random, "random", lambda: 1.0)
+        d = _dispatcher(8)
+        a = d.upsert_parent("a", "10.0.0.1", 80)
+        b = d.upsert_parent("b", "10.0.0.2", 81)
+        a.pieces.update(range(8))
+        b.pieces.update(range(8))
+        used = []
+        for _ in range(6):
+            asg = d.try_get()
+            used.append(asg.parent.peer_id)
+        # Equal cost EWMAs: load spreads instead of herding onto one.
+        assert used.count("a") == 3 and used.count("b") == 3, used
+
+    def test_clear_tie_still_prefers_fast_parent(self, monkeypatch):
+        monkeypatch.setattr(random, "random", lambda: 1.0)
+        d = _dispatcher(8)
+        fast = d.upsert_parent("fast", "10.0.0.1", 80)
+        slow = d.upsert_parent("slow", "10.0.0.2", 81)
+        fast.pieces.update(range(8))
+        slow.pieces.update(range(8))
+        fast.cost_ewma_ms = 10.0
+        slow.cost_ewma_ms = 200.0     # far outside the near-tie band
+        for _ in range(4):
+            assert d.try_get().parent is fast
+
+
+# --------------------------------------------------------------------- #
+# Scheduler: handout, membership-change push, death reshuffle
+# --------------------------------------------------------------------- #
+
+class FakeStream:
+    def __init__(self, open_body):
+        self.open_body = open_body
+        self.to_sched: asyncio.Queue = asyncio.Queue()
+        self.to_peer: asyncio.Queue = asyncio.Queue()
+
+    async def send(self, body):
+        await self.to_peer.put(body)
+
+    async def recv(self, timeout=None):
+        return await self.to_sched.get()
+
+
+async def _serve(svc, stream):
+    try:
+        await svc.announce_peer(stream, None)
+    except Exception:
+        pass
+
+
+def _body(peer_id, host_id, *, slice_name="", worker=-1, broadcast=False,
+          port=8000, upload_port=9000):
+    b = {
+        "host": {"id": host_id, "hostname": host_id, "ip": "10.0.0.1",
+                 "port": port, "upload_port": upload_port,
+                 "tpu_slice": slice_name, "tpu_worker_index": worker},
+        "peer_id": peer_id,
+        "task_id": "stripe-task",
+        "url": "http://origin/ckpt",
+    }
+    if broadcast:
+        b["pod_broadcast"] = True
+    return b
+
+
+async def _finish_source_peer(svc) -> FakeStream:
+    """A plain sourcing peer that completes, so broadcast registrants get
+    real candidate parents."""
+    stream = FakeStream(_body("peer-src", "host-src"))
+    asyncio.ensure_future(_serve(svc, stream))
+    await stream.to_sched.put({"type": "register"})
+    msg = await asyncio.wait_for(stream.to_peer.get(), 10)
+    assert msg["type"] == "need_back_source", msg
+    await stream.to_sched.put({
+        "type": "download_started", "content_length": N_PIECES * PIECE_SIZE,
+        "piece_size": PIECE_SIZE, "total_piece_count": N_PIECES})
+    for n in range(N_PIECES):
+        await stream.to_sched.put({
+            "type": "piece_finished",
+            "piece": {"piece_num": n, "range_start": n * PIECE_SIZE,
+                      "range_size": PIECE_SIZE, "digest": "",
+                      "download_cost_ms": 2, "dst_peer_id": ""}})
+    await stream.to_sched.put({
+        "type": "download_finished", "content_length": N_PIECES * PIECE_SIZE,
+        "piece_size": PIECE_SIZE, "total_piece_count": N_PIECES})
+    return stream
+
+
+class TestSchedulerStripe:
+    def _svc(self):
+        cfg = SchedulerConfig()
+        cfg.scheduling.retry_interval = 0.02
+        cfg.scheduling.no_source_patience = 0.5
+        cfg.seed_peer_enabled = False
+        return SchedulerService(cfg)
+
+    def test_handout_reshuffle_and_lone_fallback(self, run_async):
+        async def body():
+            svc = self._svc()
+            await _finish_source_peer(svc)
+
+            a = FakeStream(_body("peer-a", "host-a", slice_name="slice-0",
+                                 worker=0, broadcast=True, port=8001,
+                                 upload_port=9001))
+            serve_a = asyncio.ensure_future(_serve(svc, a))
+            await a.to_sched.put({"type": "register"})
+            msg_a = await asyncio.wait_for(a.to_peer.get(), 10)
+            assert msg_a["type"] == "normal_task"
+            assert "stripe" not in msg_a     # lone host: unstriped
+
+            b = FakeStream(_body("peer-b", "host-b", slice_name="slice-0",
+                                 worker=1, broadcast=True, port=8002,
+                                 upload_port=9002))
+            serve_b = asyncio.ensure_future(_serve(svc, b))
+            await b.to_sched.put({"type": "register"})
+            msg_b = await asyncio.wait_for(b.to_peer.get(), 10)
+            assert msg_b["type"] == "normal_task"
+            stripe_b = msg_b["stripe"]
+            assert stripe_b["slice_size"] == 2
+            assert stripe_b["slice_rank"] == 1     # worker 1 sorts second
+            assert stripe_b["members"] == ["peer-a", "peer-b"]
+            assert [m["id"] for m in stripe_b["mates"]] == ["peer-a"]
+
+            # Membership-change push: peer-a gets the reshuffled plan.
+            push_a = await asyncio.wait_for(a.to_peer.get(), 10)
+            assert push_a["type"] == "normal_task"
+            stripe_a = push_a["stripe"]
+            assert stripe_a["slice_size"] == 2 and stripe_a["slice_rank"] == 0
+            assert [m["id"] for m in stripe_a["mates"]] == ["peer-b"]
+            # Disjoint exact cover across the two plans.
+            for piece in range(50):
+                owners = sum(stripe_mod.in_stripe(piece, 2, p["slice_rank"])
+                             for p in (stripe_a, stripe_b))
+                assert owners == 1
+
+            # Death reshuffle: b's stream drops -> a falls back unstriped.
+            await b.to_sched.put(None)
+            await asyncio.wait_for(serve_b, 10)
+            push_a2 = await asyncio.wait_for(a.to_peer.get(), 10)
+            assert push_a2["type"] == "normal_task"
+            assert "stripe" not in push_a2   # lone survivor: no stripe
+            await a.to_sched.put(None)
+            await asyncio.wait_for(serve_a, 10)
+
+        run_async(body(), timeout=30)
+
+    def test_plain_peers_never_striped(self, run_async):
+        async def body():
+            svc = self._svc()
+            await _finish_source_peer(svc)
+            streams = []
+            for i in range(3):
+                s = FakeStream(_body(f"peer-{i}", f"host-{i}",
+                                     slice_name="slice-0", worker=i,
+                                     port=8100 + i, upload_port=9100 + i))
+                streams.append(s)
+                asyncio.ensure_future(_serve(svc, s))
+                await s.to_sched.put({"type": "register"})
+                msg = await asyncio.wait_for(s.to_peer.get(), 10)
+                assert msg["type"] == "normal_task"
+                assert "stripe" not in msg   # no pod_broadcast, no auto
+            for s in streams:
+                await s.to_sched.put(None)
+
+        run_async(body(), timeout=30)
+
+    def test_auto_stripe_threshold(self, run_async):
+        async def body():
+            svc = self._svc()
+            svc.config.scheduling.stripe_min_slice_peers = 2
+            await _finish_source_peer(svc)
+            s1 = FakeStream(_body("peer-1", "host-1", slice_name="slice-0",
+                                  worker=0, port=8201, upload_port=9201))
+            asyncio.ensure_future(_serve(svc, s1))
+            await s1.to_sched.put({"type": "register"})
+            m1 = await asyncio.wait_for(s1.to_peer.get(), 10)
+            assert "stripe" not in m1
+            s2 = FakeStream(_body("peer-2", "host-2", slice_name="slice-0",
+                                  worker=1, port=8202, upload_port=9202))
+            asyncio.ensure_future(_serve(svc, s2))
+            await s2.to_sched.put({"type": "register"})
+            m2 = await asyncio.wait_for(s2.to_peer.get(), 10)
+            # Auto mode: plain peers stripe once the slice holds >= the
+            # configured threshold.
+            assert m2["stripe"]["slice_size"] == 2
+            await s1.to_sched.put(None)
+            await s2.to_sched.put(None)
+
+        run_async(body(), timeout=30)
+
+
+# --------------------------------------------------------------------- #
+# Synchronizer keep-alive (satellite)
+# --------------------------------------------------------------------- #
+
+class TestSynchronizerKeepalive:
+    def test_idle_stream_is_not_a_dead_parent(self, run_async):
+        """A parent that announced everything and went quiet must stay an
+        active parent; the child sends {interested: true} keep-alives."""
+        from dragonfly2_tpu.daemon.peer.synchronizer import (
+            PieceTaskSynchronizer,
+        )
+        from dragonfly2_tpu.pkg.types import NetAddr
+        from dragonfly2_tpu.rpc import Server
+
+        async def body():
+            received = []
+            hold = asyncio.Event()
+
+            async def handler(stream, ctx):
+                await stream.send({"pieces": [0, 1], "total_piece_count": 4,
+                                   "content_length": 4 * PIECE_SIZE,
+                                   "piece_size": PIECE_SIZE, "done": False,
+                                   "digests": {}})
+                while True:
+                    msg = await stream.recv()
+                    if msg is None:
+                        return
+                    received.append(msg)
+                    if len(received) >= 2:
+                        hold.set()
+
+            server = Server("test.parent")
+            server.register_stream("Peer.SyncPieceTasks", handler)
+            await server.serve(NetAddr.tcp("127.0.0.1", 0))
+            port = server.port()
+            try:
+                dispatcher = PieceDispatcher()
+                sync = PieceTaskSynchronizer("t-keepalive", "child-peer",
+                                             dispatcher)
+                sync.KEEPALIVE_INTERVAL = 0.1
+                dispatcher.upsert_parent("parent-1", "127.0.0.1", 9000)
+                sync._tasks["parent-1"] = asyncio.ensure_future(
+                    sync._sync_one("parent-1", "127.0.0.1", port))
+                # Well past several keep-alive slices (old code: one idle
+                # 60 s recv timeout dropped the parent).
+                await asyncio.wait_for(hold.wait(), 10)
+                p = dispatcher.parents["parent-1"]
+                assert not p.blocked
+                assert p.pieces == {0, 1}
+                assert all(m.get("interested") for m in received)
+                await sync.close()
+            finally:
+                await server.close()
+
+        run_async(body(), timeout=30)
+
+    def test_blocked_parent_stops_keepalives(self, run_async):
+        from dragonfly2_tpu.daemon.peer.synchronizer import (
+            PieceTaskSynchronizer,
+        )
+        from dragonfly2_tpu.pkg.types import NetAddr
+        from dragonfly2_tpu.rpc import Server
+
+        async def body():
+            async def handler(stream, ctx):
+                await stream.send({"pieces": [0], "total_piece_count": 2,
+                                   "content_length": 2 * PIECE_SIZE,
+                                   "piece_size": PIECE_SIZE, "done": False,
+                                   "digests": {}})
+                while await stream.recv() is not None:
+                    pass
+
+            server = Server("test.parent2")
+            server.register_stream("Peer.SyncPieceTasks", handler)
+            await server.serve(NetAddr.tcp("127.0.0.1", 0))
+            try:
+                dispatcher = PieceDispatcher()
+                sync = PieceTaskSynchronizer("t-blocked", "child-peer",
+                                             dispatcher)
+                sync.KEEPALIVE_INTERVAL = 0.05
+                p = dispatcher.upsert_parent("parent-1", "127.0.0.1", 9000)
+                task = asyncio.ensure_future(
+                    sync._sync_one("parent-1", "127.0.0.1", server.port()))
+                sync._tasks["parent-1"] = task
+                await asyncio.sleep(0.1)
+                p.blocked = True        # dispatcher gave up on this parent
+                await asyncio.wait_for(task, 10)  # stream exits on its own
+                await sync.close()
+            finally:
+                await server.close()
+
+        run_async(body(), timeout=30)
+
+
+# --------------------------------------------------------------------- #
+# Striped 2-slice x 4-host e2e (real in-process daemons)
+# --------------------------------------------------------------------- #
+
+@pytest.mark.slow
+class TestStripedFanoutE2E:
+    def test_two_slices_dcn_bytes_and_content(self, run_async, tmp_path):
+        """Cold fan-out to 2 slices x 4 hosts with pod_broadcast: every
+        host's bytes sha-verify, and each host's cross-slice (DCN) bytes
+        land near file/S instead of the full file."""
+        from tests.test_p2p_e2e import (
+            daemon_config,
+            start_origin,
+            start_scheduler,
+        )
+        from dragonfly2_tpu.client import dfget as dfget_lib
+        from dragonfly2_tpu.daemon.daemon import Daemon
+        from dragonfly2_tpu.proto.common import UrlMeta
+
+        content = bytes(random.Random(42).randbytes(24 * 1024 * 1024))
+        sha = "sha256:" + hashlib.sha256(content).hexdigest()
+
+        async def body():
+            origin, oport, stats = await start_origin()
+            # start_origin serves the fixed test blob; patch the route to
+            # our content by overriding the handler state is overkill —
+            # serve our own origin instead.
+            await origin.cleanup()
+            from aiohttp import web
+
+            from dragonfly2_tpu.pkg.piece import Range
+
+            async def blob(request):
+                rng = request.headers.get("Range")
+                if rng:
+                    r = Range.parse_http(rng, len(content))
+                    data = content[r.start:r.start + r.length]
+                    return web.Response(status=206, body=data, headers={
+                        "Content-Range":
+                            f"bytes {r.start}-{r.start + r.length - 1}"
+                            f"/{len(content)}",
+                        "Accept-Ranges": "bytes"})
+                return web.Response(body=content,
+                                    headers={"Accept-Ranges": "bytes"})
+
+            app = web.Application()
+            app.router.add_get("/blob", blob)
+            runner = web.AppRunner(app, access_log=None)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            oport = site._server.sockets[0].getsockname()[1]
+
+            sched = await start_scheduler()
+            url = f"http://127.0.0.1:{oport}/blob"
+            daemons = []
+            try:
+                seed_cfg = daemon_config(tmp_path, "seed", sched.port(),
+                                         seed=True)
+                seed_cfg.host.tpu_slice = "slice-seed"
+                seed = Daemon(seed_cfg)
+                await seed.start()
+                daemons.append(seed)
+                peers = []
+                for i in range(8):
+                    cfg = daemon_config(tmp_path, f"peer{i}", sched.port())
+                    cfg.host.tpu_slice = f"slice-{i // 4}"
+                    cfg.host.tpu_worker_index = i % 4
+                    # One assignment in flight per worker pass: stripe
+                    # pushes land before a racing first registrant can
+                    # reserve the whole piece space.
+                    cfg.download.parent_concurrency = 2
+                    d = Daemon(cfg)
+                    await d.start()
+                    daemons.append(d)
+                    peers.append(d)
+
+                async def pull(i: int):
+                    return await dfget_lib.download(dfget_lib.DfgetConfig(
+                        url=url, output=str(tmp_path / f"out{i}.bin"),
+                        daemon_sock=peers[i].config.unix_sock,
+                        meta=UrlMeta(digest=sha),
+                        pod_broadcast=True,
+                        allow_source_fallback=False, timeout=180.0))
+
+                results = await asyncio.gather(*[pull(i) for i in range(8)])
+                task_id = results[0]["task_id"]
+                for i, r in enumerate(results):
+                    assert r["state"] == "done", r
+                    data = (tmp_path / f"out{i}.bin").read_bytes()
+                    assert hashlib.sha256(data).hexdigest() == sha[7:], i
+
+                piece_size = 4 << 20
+                file_mb = len(content)
+                crosses = []
+                for i, d in enumerate(peers):
+                    loc = d.task_manager.locality_bytes.get(task_id, {})
+                    crosses.append(loc.get("cross", 0))
+                    assert loc.get("unlabeled", 0) == 0, (i, loc)
+                # Every host's DCN bill stays well under the full file:
+                # file/S plus slack for pieces reserved before the stripe
+                # push landed (registration race, span reservations).
+                bound = file_mb / 4 + 3 * piece_size
+                for i, c in enumerate(crosses):
+                    assert c <= bound, (i, c, bound, crosses)
+                # The slice actually exchanged pieces internally.
+                total_intra = sum(
+                    d.task_manager.locality_bytes[task_id].get("intra", 0)
+                    for d in peers)
+                assert total_intra > 0
+                # Aggregate DCN stays near one copy per slice, far from
+                # the unstriped 8x file.
+                assert sum(crosses) <= 2 * file_mb + 8 * 3 * piece_size
+            finally:
+                for d in daemons:
+                    await d.stop()
+                await sched.stop()
+                await runner.cleanup()
+
+        run_async(body(), timeout=300)
+
+
+# --------------------------------------------------------------------- #
+# Sim bench wiring (fast: small deterministic run + its own checks)
+# --------------------------------------------------------------------- #
+
+class TestStripeSim:
+    def test_paired_sim_bounds(self):
+        import importlib
+
+        bench = importlib.import_module("benchmarks.stripe_sim_bench")
+        result = bench.run_paired(n_slices=2, hosts_per_slice=4,
+                                  n_pieces=32, piece_size=1 << 20)
+        bench.check(result)
+        s = result["striped"]
+        # Exact stripe accounting: every host DCN-pulls file/S.
+        assert s["max_host_dcn_mb"] <= s["content_mb"] / 4 + s["piece_mb"]
+        assert result["speedup"] >= 1.5
+
+    def test_sim_deterministic(self):
+        import importlib
+
+        bench = importlib.import_module("benchmarks.stripe_sim_bench")
+        a = bench.run_sim(n_slices=2, hosts_per_slice=2, n_pieces=16,
+                          piece_size=1 << 20, striped=True)
+        b = bench.run_sim(n_slices=2, hosts_per_slice=2, n_pieces=16,
+                          piece_size=1 << 20, striped=True)
+        assert a == b

@@ -11,8 +11,10 @@ import jax.numpy as jnp  # noqa: E402
 from dragonfly2_tpu.ops.checksum import checksum_numpy, chunk_checksums  # noqa: E402
 from dragonfly2_tpu.ops.hbm_sink import HBMSink  # noqa: E402
 from dragonfly2_tpu.parallel.ici import (  # noqa: E402
+    StripedBroadcast,
     all_gather_shards,
     bitcast_landed_bytes,
+    chunked_ring_all_gather,
     make_mesh,
     replicate_to_mesh,
     ring_all_gather,
@@ -93,6 +95,19 @@ class TestHBMSink:
         np.testing.assert_array_equal(
             np.asarray(sharded), np.frombuffer(content, "<u4"))
 
+    def test_ring_replicate(self):
+        # The striped broadcast's ICI leg: shard the landed content over
+        # the mesh, complete the copy with the chunked ppermute ring.
+        mesh = make_mesh(8)
+        content = np.random.RandomState(4).bytes(8 * 1024 + 100)  # tail pad
+        sink = HBMSink(len(content), piece_size=1024)
+        for n in range((len(content) + 1023) // 1024):
+            sink.land_piece(n, content[n * 1024:(n + 1) * 1024])
+        out = sink.ring_replicate(mesh, n_chunks=3)
+        assert out.sharding.is_fully_replicated
+        got = np.asarray(out).view("<u1")[:len(content)].tobytes()
+        assert got == content
+
 
 class TestICI:
     def test_scatter_then_all_gather(self):
@@ -123,6 +138,31 @@ class TestICI:
         words = jnp.asarray(np.frombuffer(vals.tobytes(), "<u1"))
         t = bitcast_landed_bytes(words, "float32", (4, 4))
         np.testing.assert_array_equal(np.asarray(t).reshape(-1), vals)
+
+    def test_chunked_ring_all_gather_matches_all_gather(self):
+        mesh = make_mesh(8)
+        data = np.arange(8 * 24 * 3, dtype=np.uint32).reshape(8 * 24, 3)
+        sharded = scatter_shards(mesh, data)
+        for n_chunks in (1, 3, 4, 24, 100):
+            out = chunked_ring_all_gather(mesh, sharded, n_chunks=n_chunks)
+            assert out.sharding.is_fully_replicated
+            np.testing.assert_array_equal(np.asarray(out), data)
+
+    def test_striped_broadcast_pipelines_chunks(self):
+        # The DCN/ICI overlap driver: chunks fed in landing order come
+        # back as the full content, replicated, regardless of chunk size
+        # vs mesh-size alignment.
+        mesh = make_mesh(8)
+        content = np.arange(101, dtype=np.uint32)
+        sb = StripedBroadcast(mesh, n_chunks=2)
+        for lo in range(0, 101, 17):
+            sb.feed(content[lo:lo + 17])
+        out = sb.result()
+        np.testing.assert_array_equal(np.asarray(out), content)
+
+    def test_striped_broadcast_empty_raises(self):
+        with pytest.raises(ValueError):
+            StripedBroadcast(make_mesh(8)).result()
 
 
 class TestTopology:
