@@ -1,0 +1,198 @@
+"""Chaos degradation bench: completion-time ratio degraded/clean.
+
+Runs the SAME 4-peer + 1-seed in-process pod fan-out twice against a
+local origin: once clean, once with the seeded chaos schedule killing 25%
+of the parents (one peer's upload endpoint refuses every piece request).
+The headline number is the wall-clock ratio degraded/clean — the price of
+losing a quarter of the swarm's serving capacity while still completing
+byte-identical.
+
+Usage:
+  python benchmarks/chaos_bench.py [--mb 16] [--seed 77] [--publish]
+
+Publishes BASELINE.json["published"]["config7_chaos"].
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import hashlib
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_PEERS = 4
+
+
+async def _start_origin(content: bytes):
+    from aiohttp import web
+
+    from dragonfly2_tpu.pkg.piece import Range
+
+    async def blob(request):
+        rng = request.headers.get("Range")
+        if rng:
+            r = Range.parse_http(rng, len(content))
+            return web.Response(
+                status=206, body=content[r.start:r.start + r.length],
+                headers={"Content-Range":
+                         f"bytes {r.start}-{r.start + r.length - 1}"
+                         f"/{len(content)}",
+                         "Accept-Ranges": "bytes"})
+        return web.Response(body=content,
+                            headers={"Accept-Ranges": "bytes"})
+
+    app = web.Application()
+    app.router.add_get("/blob", blob)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    return runner, site._server.sockets[0].getsockname()[1]
+
+
+async def _run_pod(work: str, content: bytes, sha: str, *,
+                   chaos_seed: int | None) -> dict:
+    """One pod run: warm the seed + peer0, then fan the task out cold to
+    peers 1..N. In the degraded run, peer0 — a warm, piece-complete
+    parent the scheduler WILL hand out — has its upload endpoint refused
+    by the seeded schedule the moment the cold wave starts: a true 25%
+    parent death mid-swarm, not a parent nobody ever asked. Returns the
+    COLD wave's wall clock plus fault accounting."""
+    from tests.test_p2p_e2e import daemon_config, start_scheduler
+
+    from dragonfly2_tpu.client import dfget as dfget_lib
+    from dragonfly2_tpu.daemon.daemon import Daemon
+    from dragonfly2_tpu.pkg import chaos as chaos_mod
+    from dragonfly2_tpu.proto.common import UrlMeta
+
+    origin, oport = await _start_origin(content)
+    sched = await start_scheduler()
+    url = f"http://127.0.0.1:{oport}/blob"
+    daemons = []
+    fabric = None
+    try:
+        from pathlib import Path
+
+        base = Path(work)
+        seed = Daemon(daemon_config(base, "seed", sched.port(), seed=True))
+        await seed.start()
+        daemons.append(seed)
+        peers = []
+        for i in range(N_PEERS):
+            d = Daemon(daemon_config(base, f"peer{i}", sched.port()))
+            await d.start()
+            daemons.append(d)
+            peers.append(d)
+
+        async def pull(i):
+            return await dfget_lib.download(dfget_lib.DfgetConfig(
+                url=url, output=str(base / f"out{i}.bin"),
+                daemon_sock=peers[i].config.unix_sock,
+                meta=UrlMeta(digest=sha),
+                allow_source_fallback=False, timeout=300.0))
+
+        # Warm phase: peer0 completes cleanly and becomes a parent.
+        warm = await pull(0)
+        if not (isinstance(warm, dict) and warm.get("state") == "done"):
+            raise RuntimeError(f"warm phase failed: {warm}")
+
+        if chaos_seed is not None:
+            victim = f"127.0.0.1:{peers[0].upload.port}"
+            fabric = chaos_mod.enable(chaos_mod.parse_spec({
+                "seed": chaos_seed, "rules": [
+                    {"site": "piece.request", "kind": "refuse",
+                     "rate": 1.0, "key_substr": victim}]}))
+
+        t0 = time.monotonic()
+        results = await asyncio.gather(
+            *[pull(i) for i in range(1, N_PEERS)], return_exceptions=True)
+        wall = time.monotonic() - t0
+        ok = all(isinstance(r, dict) and r.get("state") == "done"
+                 for r in results)
+        identical = ok and all(
+            hashlib.sha256((base / f"out{i}.bin").read_bytes()).hexdigest()
+            == sha[7:] for i in range(1, N_PEERS))
+        return {"wall_s": round(wall, 3), "ok": ok,
+                "byte_identical": identical,
+                "faults": fabric.injected_by_kind() if fabric else {}}
+    finally:
+        if chaos_seed is not None:
+            chaos_mod.disable()
+        for d in daemons:
+            await d.stop()
+        await sched.stop()
+        await origin.cleanup()
+
+
+def run_paired(mb: int, seed: int) -> dict:
+    content = bytes(random.Random(seed).randbytes(mb * 1024 * 1024))
+    sha = "sha256:" + hashlib.sha256(content).hexdigest()
+
+    def once(chaos_seed):
+        work = tempfile.mkdtemp(prefix="chaos-bench-")
+        try:
+            return asyncio.run(_run_pod(work, content, sha,
+                                        chaos_seed=chaos_seed))
+        finally:
+            shutil.rmtree(work, ignore_errors=True)
+
+    clean = once(None)
+    degraded = once(seed)
+    ratio = (degraded["wall_s"] / clean["wall_s"]
+             if clean["wall_s"] > 0 else 0.0)
+    return {
+        "config": "chaos-degradation",
+        "hosts": N_PEERS,
+        "seed_peers": 1,
+        "content_mb": mb,
+        "chaos_seed": seed,
+        "dead_parent_fraction": 1.0 / N_PEERS,
+        "clean": clean,
+        "degraded": degraded,
+        "ratio": round(ratio, 3),
+        "byte_identical": bool(degraded["byte_identical"]
+                               and clean["byte_identical"]),
+        "note": ("paired in-process pod fan-out; degraded run refuses one "
+                 "peer's upload endpoint (25% parent death) via the seeded "
+                 "chaos fabric — completion stays byte-identical, the "
+                 "ratio prices the lost serving capacity"),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=77)
+    ap.add_argument("--publish", action="store_true",
+                    help="record the result in BASELINE.json['published']")
+    args = ap.parse_args()
+
+    result = run_paired(args.mb, args.seed)
+    print(json.dumps(result))
+    if not result["byte_identical"]:
+        print("FAIL: degraded pod did not complete byte-identical",
+              file=sys.stderr)
+        return 1
+    if args.publish:
+        path = os.path.join(REPO, "BASELINE.json")
+        doc = json.load(open(path))
+        doc.setdefault("published", {})["config7_chaos"] = result
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -190,6 +190,7 @@ class Daemon:
             piece_manager=self.piece_manager,
             host_info=host_info,
             meta=meta,
+            quarantine=self.task_manager.quarantine,
             is_seed=is_seed or self.config.seed_peer,
             piece_parallelism=self.config.download.parent_concurrency,
             limiter=limiter if limiter is not None else self.task_manager.limiter,
@@ -272,6 +273,13 @@ class Daemon:
             raise
 
     async def _start_inner(self) -> None:
+        # Chaos fabric: armed ONLY when DF_CHAOS is set (benches/e2e fault
+        # drills). The guard keeps pkg/chaos entirely unimported — and the
+        # data plane hook-free — in normal operation.
+        if os.environ.get("DF_CHAOS"):
+            from dragonfly2_tpu.pkg import chaos
+
+            chaos.maybe_enable_from_env()
         # Warm the native data-plane probe off-loop: a cold first import
         # compiles the C++ library (seconds of g++), which must not freeze
         # the event loop at the first piece write on the hot path.

@@ -21,11 +21,19 @@ from __future__ import annotations
 import asyncio
 import os
 
-from dragonfly2_tpu.daemon.peer.piece_dispatcher import PieceAssignment, PieceDispatcher
-from dragonfly2_tpu.daemon.peer.piece_downloader import PieceDownloader
+from dragonfly2_tpu.daemon.peer.piece_dispatcher import (
+    PieceAssignment,
+    PieceDispatcher,
+    parent_key,
+)
+from dragonfly2_tpu.daemon.peer.piece_downloader import (
+    PieceDownloader,
+    failure_reason,
+)
 from dragonfly2_tpu.daemon.peer.piece_manager import PieceManager
 from dragonfly2_tpu.daemon.peer.synchronizer import PieceTaskSynchronizer
 from dragonfly2_tpu.pkg import dflog, metrics
+from dragonfly2_tpu.pkg import retry as retrylib
 from dragonfly2_tpu.pkg.errors import Code, DfError
 from dragonfly2_tpu.pkg.piece import PieceInfo, Range, compute_piece_count
 from dragonfly2_tpu.pkg.ratelimit import Limiter
@@ -37,6 +45,20 @@ PIECE_DOWNLOAD_COUNT = metrics.counter(
     "peer_piece_download_total", "P2P piece downloads", ("result",))
 BACK_SOURCE_COUNT = metrics.counter(
     "peer_back_source_total", "Tasks that fell back to origin")
+# Typed degradation telemetry: every piece failure by reason code, parent
+# quarantine entries by the reason that tipped them, and announce-stream
+# recoveries. These are what the chaos e2e (and operators) read to see
+# WHICH degradation path fired, not just that something failed.
+PIECE_FAIL_REASON = metrics.counter(
+    "peer_piece_failures_total",
+    "P2P piece failures by typed reason code", ("reason",))
+PARENT_QUARANTINE_COUNT = metrics.counter(
+    "peer_parent_quarantine_total",
+    "Parents entering the daemon-wide quarantine, by tipping reason",
+    ("reason",))
+ANNOUNCE_RECONNECT_COUNT = metrics.counter(
+    "peer_announce_reconnects_total",
+    "Mid-download announce-stream recovery attempts", ("result",))
 # The striped-broadcast yardstick: P2P piece bytes split by parent
 # locality — intra rides the ICI fabric, cross is real DCN traffic,
 # unlabeled means either end lacked TPU coordinates. fanout_bench --stripe
@@ -67,6 +89,7 @@ class PeerTaskConductor:
         on_piece=None,
         disable_back_source: bool = False,
         local_range_source=None,
+        quarantine=None,
     ):
         self.task_id = task_id
         self.peer_id = peer_id
@@ -96,7 +119,10 @@ class PeerTaskConductor:
         self.content_range = (Range.parse_http(range_header)
                               if range_header else None)
 
-        self.dispatcher = PieceDispatcher()
+        # Daemon-wide bad-parent quarantine (pkg/quarantine), shared across
+        # conductors via the task manager; None = no quarantine filter.
+        self.quarantine = quarantine
+        self.dispatcher = PieceDispatcher(quarantine=quarantine)
         self.downloader = PieceDownloader()
         self.synchronizer: PieceTaskSynchronizer | None = None
         # Striped slice broadcast: this host's ICI domain, and the bytes
@@ -122,6 +148,13 @@ class PeerTaskConductor:
         self._pending_reports: list[dict] = []
         self._flush_task: asyncio.Task | None = None
         self._last_flush = 0.0
+        # Mid-download announce-stream recovery state: the register body
+        # (saved for re-registration), the serialized-reconnect lock, and
+        # the terminal flag that stops recovery racing teardown.
+        self._open_body: dict | None = None
+        self._announce_lock = asyncio.Lock()
+        self._announce_done = False
+        self._stream_reconnects = 0
 
     # ------------------------------------------------------------------ #
 
@@ -143,6 +176,7 @@ class PeerTaskConductor:
             "disable_back_source": self.disable_back_source,
             "pod_broadcast": bool(self.meta.get("pod_broadcast")),
         }
+        self._open_body = open_body
         # Registration phase: any transport failure BEFORE a scheduler
         # answer arrives (connect refused, connect-then-drop, silence)
         # demotes to back-to-source instead of failing the task (reference
@@ -473,6 +507,22 @@ class PeerTaskConductor:
         else:
             self.dispatcher.clear_stripe()
 
+    def _note_piece_failure(self, parent, err: DfError) -> str:
+        """Typed failure accounting: classify the error, feed the
+        daemon-wide quarantine, emit the reason-coded metric. Returns the
+        reason string for the scheduler report."""
+        reason = failure_reason(err)
+        PIECE_FAIL_REASON.labels(reason).inc()
+        if self.quarantine is not None:
+            if self.quarantine.penalize(parent_key(parent), reason):
+                PARENT_QUARANTINE_COUNT.labels(reason).inc()
+                log.warning("parent quarantined",
+                            parent=parent.peer_id[:24],
+                            endpoint=parent_key(parent), reason=reason,
+                            task=self.task_id[:16])
+                self.dispatcher._wakeup.set()
+        return reason
+
     def _note_piece_bytes(self, parent, size: int) -> None:
         if size <= 0:
             return
@@ -514,11 +564,24 @@ class PeerTaskConductor:
     async def _receive_scheduler_loop(self) -> None:
         """The ONLY reader of the scheduler stream after registration:
         applies pushed parent sets / back-source demotions and signals
-        waiters (reference receivePeerPacket :673)."""
+        waiters (reference receivePeerPacket :673). A stream death
+        MID-DOWNLOAD (scheduler crash/restart, net partition) is not
+        terminal: the piece workers keep pulling from their live parents
+        while this loop reconnects with ring failover, re-registers
+        preserving completed pieces, and flushes the buffered reports —
+        only an exhausted reconnect budget demotes to back-to-source."""
         try:
             while True:
-                msg = await self._stream.recv()
+                try:
+                    msg = await self._stream.recv()
+                except DfError:
+                    msg = None   # stream lost: same recovery as a close
                 if msg is None:
+                    if self._announce_done or self._complete():
+                        return
+                    if await self._recover_announce_stream():
+                        continue
+                    self._degrade_after_scheduler_loss()
                     return
                 kind = msg.get("type")
                 if kind == "normal_task":
@@ -536,8 +599,110 @@ class PeerTaskConductor:
                     for pid in list(self.dispatcher.parents):
                         self.dispatcher.drop_parent(pid)
                     self._sched_update.set()
-        except (asyncio.CancelledError, DfError):
+        except asyncio.CancelledError:
             pass
+
+    # Announce-stream recovery budget: attempts per disruption. With the
+    # ANNOUNCE backoff policy the whole budget spans a few seconds — long
+    # enough for a scheduler restart, short enough that origin fallback
+    # still beats a wedged transfer. MAX_STREAM_RECONNECTS caps the
+    # task-lifetime total: a perpetually flapping scheduler must
+    # eventually push the task to the degradation path, not hold the
+    # receiver in a reconnect loop forever.
+    RECONNECT_BUDGET = 4
+    MAX_STREAM_RECONNECTS = 8
+
+    def _degrade_after_scheduler_loss(self) -> None:
+        """Reconnect budget exhausted: the schedulerless endgame. With
+        origin allowed the workers hand the remainder to back-to-source
+        (pieces on disk are kept); without it they ride out their current
+        parents and fail via the starvation path if those run dry."""
+        log.warning("announce stream unrecoverable; degrading",
+                    task=self.task_id[:16],
+                    back_source=not self.disable_back_source)
+        if not self.disable_back_source:
+            self._need_back_source = True
+            for pid in list(self.dispatcher.parents):
+                self.dispatcher.drop_parent(pid)
+        self._sched_update.set()
+
+    async def _recover_announce_stream(self) -> bool:
+        """Reopen the announce stream (ring failover lives in
+        scheduler_client), re-register, re-report completed pieces, flush
+        buffered piece reports. Returns False when the budget is spent or
+        the scheduler authoritatively rejected us."""
+        async with self._announce_lock:
+            if self._announce_done:
+                return False
+            if self._stream is not None and not self._stream.closed:
+                return True   # a racing caller already recovered it
+            if self._stream_reconnects >= self.MAX_STREAM_RECONNECTS:
+                ANNOUNCE_RECONNECT_COUNT.labels("exhausted").inc()
+                return False
+            policy = retrylib.ANNOUNCE
+            for attempt in range(self.RECONNECT_BUDGET):
+                await asyncio.sleep(policy.delay(attempt))
+                if self._announce_done:
+                    return False
+                try:
+                    stream = await self.scheduler_client.open_announce_stream(
+                        self._open_body)
+                    await stream.send({"type": "register"})
+                    msg = await stream.recv(timeout=30.0)
+                except DfError as e:
+                    ANNOUNCE_RECONNECT_COUNT.labels("retry").inc()
+                    log.warning("announce reconnect failed",
+                                task=self.task_id[:16], attempt=attempt,
+                                error=str(e))
+                    continue
+                if msg is None:
+                    ANNOUNCE_RECONNECT_COUNT.labels("retry").inc()
+                    continue
+                old, self._stream = self._stream, stream
+                if old is not None:
+                    await old.close()
+                self._stream_reconnects += 1
+                kind = msg.get("type")
+                if kind == "normal_task":
+                    self._apply_task_meta(msg.get("task") or {})
+                    if self.synchronizer is not None:
+                        self.synchronizer.sync_parents(
+                            msg.get("parents") or [])
+                    self._apply_stripe(msg.get("stripe"))
+                elif kind == "need_back_source":
+                    self._need_back_source = True
+                    for pid in list(self.dispatcher.parents):
+                        self.dispatcher.drop_parent(pid)
+                elif kind == "schedule_failed":
+                    # An ANSWER, not an outage: the scheduler's verdict
+                    # stands; fall through to degradation.
+                    ANNOUNCE_RECONNECT_COUNT.labels("rejected").inc()
+                    self._sched_update.set()
+                    return False
+                self._sched_update.set()
+                # Re-register preserving completed pieces: a restarted
+                # scheduler (or a failover ring member) has no idea what
+                # this peer already holds — report every landed piece so
+                # it becomes a usable parent again immediately. The
+                # scheduler applies reports idempotently, so overlap with
+                # still-buffered reports is harmless.
+                for rec in self.store.get_pieces():
+                    self._pending_reports.append({
+                        "piece_num": rec.num,
+                        "range_start": rec.offset,
+                        "range_size": rec.size,
+                        "digest": rec.digest,
+                        "download_cost_ms": rec.cost_ms,
+                        "dst_peer_id": "",
+                    })
+                await self._flush_reports()
+                ANNOUNCE_RECONNECT_COUNT.labels("ok").inc()
+                log.info("announce stream recovered",
+                         task=self.task_id[:16], attempt=attempt,
+                         reconnects=self._stream_reconnects)
+                return True
+            ANNOUNCE_RECONNECT_COUNT.labels("exhausted").inc()
+            return False
 
     # Coalescing bound: one ranged GET covers up to this many contiguous
     # pieces (32 MiB at the default 4 MiB piece size). Availability gates
@@ -597,14 +762,17 @@ class PeerTaskConductor:
                 # still count individually, matching the per-piece path.
                 if any(e is err for e in penalized):
                     self.dispatcher.release_assignment(a)
+                    reason = failure_reason(err)
                 else:
                     penalized.append(err)
                     self.dispatcher.report_failure(a, parent_gone=gone)
+                    reason = self._note_piece_failure(p, err)
                 await self._safe_send({
                     "type": "piece_failed",
                     "piece_num": a.piece_num,
                     "parent_id": p.peer_id,
                     "temporary": not gone,
+                    "reason": reason,
                 })
 
         return await self.downloader.download_span_to_store(
@@ -633,11 +801,13 @@ class PeerTaskConductor:
             PIECE_DOWNLOAD_COUNT.labels("fail").inc()
             gone = is_parent_gone(e)
             self.dispatcher.report_failure(assignment, parent_gone=gone)
+            reason = self._note_piece_failure(p, e)
             await self._safe_send({
                 "type": "piece_failed",
                 "piece_num": assignment.piece_num,
                 "parent_id": p.peer_id,
                 "temporary": not gone,
+                "reason": reason,
             })
 
     async def _handle_starvation(self) -> bool:
@@ -658,7 +828,7 @@ class PeerTaskConductor:
             if self._reschedules > MAX_RESCHEDULES:
                 raise DfError(Code.ClientScheduleTimeout,
                               f"starved after {MAX_RESCHEDULES} reschedules")
-            blocklist = [pid for pid, p in self.dispatcher.parents.items() if p.blocked]
+            blocklist = self.dispatcher.unusable_parent_ids()
             self._sched_update.clear()
             await self._safe_send({"type": "reschedule", "blocklist": blocklist,
                                    "description": "piece starvation"})
@@ -696,45 +866,61 @@ class PeerTaskConductor:
             wait = self._last_flush + self._REPORT_FLUSH_S - loop.time()
             if wait > 0:
                 await asyncio.sleep(wait)
-            await self._flush_reports()
+            if not await self._flush_reports():
+                # Stream down: reports stay BUFFERED (not dropped) for the
+                # announce-recovery flush; spinning here would just burn
+                # the loop until the receiver finishes reconnecting.
+                return
             if not self._pending_reports:
                 return
 
-    async def _flush_reports(self) -> None:
+    async def _flush_reports(self) -> bool:
+        """Send buffered piece reports. Returns False when the stream was
+        down — the batch is RESTORED, not dropped, so the reports survive
+        for the announce-stream recovery path to flush."""
         async with self._report_lock:
             if not self._pending_reports:
-                return
+                return True
             batch, self._pending_reports = self._pending_reports, []
             self._last_flush = asyncio.get_running_loop().time()
             try:
                 if len(batch) == 1:
-                    await self._safe_send({"type": "piece_finished",
-                                           "piece": batch[0]})
+                    sent = await self._safe_send({"type": "piece_finished",
+                                                  "piece": batch[0]})
                 else:
-                    await self._safe_send({"type": "pieces_finished",
-                                           "pieces": batch})
+                    sent = await self._safe_send({"type": "pieces_finished",
+                                                  "pieces": batch})
             except BaseException:
                 # A cancellation (teardown racing a flush) must not drop
                 # the popped batch: restore it so the teardown's own final
                 # flush still reports these pieces.
                 self._pending_reports = batch + self._pending_reports
                 raise
+            if not sent:
+                self._pending_reports = batch + self._pending_reports
+            return sent
 
-    async def _safe_send(self, msg: dict) -> None:
+    async def _safe_send(self, msg: dict) -> bool:
+        """Send on the announce stream; returns False when the stream is
+        down (the receiver loop owns reconnection — callers must not race
+        it with their own)."""
         # Scheduler-visible ordering: buffered piece reports precede any
         # terminal or reschedule message (the scheduler's piece counts must
         # be current when it acts on those).
         if msg.get("type") in ("download_finished", "reschedule",
                                "download_failed"):
             await self._flush_reports()
-        if self._stream is None or self._stream.closed:
-            return
+        stream = self._stream
+        if stream is None or stream.closed:
+            return False
         try:
-            await self._stream.send(msg)
+            await stream.send(msg)
+            return True
         except DfError:
-            pass
+            return False
 
     async def _teardown(self) -> None:
+        self._announce_done = True   # recovery must not race teardown
         if self._flush_task is not None and not self._flush_task.done():
             self._flush_task.cancel()
         await self._flush_reports()

@@ -50,8 +50,18 @@ class PieceAssignment:
     digest: str = ""   # parent-advertised "algo:encoded"; verified on write
 
 
+def parent_key(p: ParentInfo) -> str:
+    """Daemon-wide quarantine key: the serving endpoint, not the per-task
+    peer id — a parent that served corrupt bytes for task A is equally
+    untrusted for task B, and a restarted peer id must not reset it."""
+    return f"{p.ip}:{p.upload_port}"
+
+
 class PieceDispatcher:
-    def __init__(self, *, max_parent_failures: int = 3):
+    def __init__(self, *, max_parent_failures: int = 3, quarantine=None):
+        # Daemon-wide decaying-penalty blocklist (pkg/quarantine
+        # ParentQuarantine), shared across conductors; None = no filter.
+        self.quarantine = quarantine
         self.parents: dict[str, ParentInfo] = {}
         self._total_piece_count = -1
         self.piece_size = 0
@@ -161,7 +171,21 @@ class PieceDispatcher:
         self.certified_event.set()
 
     def active_parents(self) -> list[ParentInfo]:
-        return [p for p in self.parents.values() if not p.blocked]
+        # Quarantine is consulted live (it decays): a parent quarantined a
+        # minute ago re-enters selection the moment its window lapses,
+        # with no topology push needed.
+        q = self.quarantine
+        return [p for p in self.parents.values()
+                if not p.blocked
+                and (q is None or not q.is_quarantined(parent_key(p)))]
+
+    def unusable_parent_ids(self) -> list[str]:
+        """Blocked or currently-quarantined parents — the reschedule
+        blocklist (the scheduler must not hand these right back)."""
+        q = self.quarantine
+        return [pid for pid, p in self.parents.items()
+                if p.blocked
+                or (q is not None and q.is_quarantined(parent_key(p)))]
 
     def note_parent_done(self, peer_id: str) -> None:
         """The sync stream saw done=True from this parent: its completion

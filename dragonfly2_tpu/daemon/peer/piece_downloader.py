@@ -23,12 +23,43 @@ import aiohttp
 
 from dragonfly2_tpu.pkg import dflog
 from dragonfly2_tpu.pkg import digest as pkgdigest
+from dragonfly2_tpu.pkg import retry as retrylib
 from dragonfly2_tpu.pkg.errors import Code, DfError
 from dragonfly2_tpu.storage.local_store import _native
 
 log = dflog.get("peer.piece_downloader")
 
 _RECV_CHUNK = 256 << 10
+
+# Chaos fabric hook (pkg/chaos.enable() arms it; None = inert). While any
+# piece.* rule is loaded the native fast path is bypassed so injected
+# faults flow through the hookable aiohttp path.
+_chaos = None
+
+
+def _err(code: Code, msg: str, reason: str) -> DfError:
+    """Coded per-piece error carrying its typed failure reason — the
+    quarantine/demotion vocabulary (pkg/quarantine.REASON_WEIGHTS)."""
+    return DfError(code, msg, {"reason": reason})
+
+
+def failure_reason(e: DfError) -> str:
+    """Classify a piece failure into the typed reason-code vocabulary:
+    explicit metadata first (raise sites on this path tag themselves),
+    then the storage layer's digest-mismatch message, then the code."""
+    r = e.metadata.get("reason", "")
+    if r:
+        return r
+    if "digest mismatch" in e.message:
+        return "corrupt"
+    return {
+        Code.ClientConnectionError: "refused",
+        Code.ClientPieceRequestFail: "transport",
+        Code.ClientPieceDownloadFail: "truncated",
+        Code.ClientRequestLimitFail: "throttle",
+        Code.ClientPieceNotFound: "not_found",
+        Code.RequestTimeout: "stall",
+    }.get(e.code, "transport")
 
 
 async def assemble_piece(chunks, expected_size: int,
@@ -63,15 +94,17 @@ async def assemble_piece(chunks, expected_size: int,
     got = 0
     async for chunk in chunks:
         if expected_size >= 0 and got + len(chunk) > expected_size:
-            raise DfError(Code.ClientPieceDownloadFail,
-                          f"body exceeds expected size {expected_size}")
+            raise _err(Code.ClientPieceDownloadFail,
+                       f"body exceeds expected size {expected_size}",
+                       "truncated")
         out.append(chunk)
         got += len(chunk)
         if hasher is not None:
             hasher.update(chunk)
     if expected_size >= 0 and got != expected_size:
-        raise DfError(Code.ClientPieceDownloadFail,
-                      f"body size {got} != expected {expected_size}")
+        raise _err(Code.ClientPieceDownloadFail,
+                   f"body size {got} != expected {expected_size}",
+                   "truncated")
     digest_str = f"{algorithm}:{hasher.hexdigest()}" if hasher else ""
     return out, got, digest_str
 
@@ -214,20 +247,25 @@ def _upload_status_error(status: int, parent: str, what: str) -> DfError | None:
     by the single-piece and span native paths so a new status case cannot
     diverge between them."""
     if status in (404, 416):
-        return DfError(Code.ClientPieceNotFound,
-                       f"parent {parent} lacks {what} ({status})")
+        return _err(Code.ClientPieceNotFound,
+                    f"parent {parent} lacks {what} ({status})", "not_found")
     if status == 429:
-        return DfError(Code.ClientRequestLimitFail,
-                       f"parent {parent} throttled")
+        return _err(Code.ClientRequestLimitFail,
+                    f"parent {parent} throttled", "throttle")
     if status not in (200, 206):
-        return DfError(Code.ClientPieceRequestFail,
-                       f"parent {parent} returned {status} for {what}")
+        return _err(Code.ClientPieceRequestFail,
+                    f"parent {parent} returned {status} for {what}",
+                    "http5xx" if status >= 500 else "transport")
     return None
 
 
 class PieceDownloader:
-    def __init__(self, timeout: float = 30.0):
+    def __init__(self, timeout: float = 30.0, idle_timeout: float = 10.0):
         self._timeout = timeout
+        # Per-chunk progress watchdog (pkg/retry.watch_idle): the overall
+        # timeout bounds the transfer, this bounds the gap between chunks
+        # so a slow-loris parent trips in seconds, not at the deadline.
+        self._idle_timeout = idle_timeout
         self._session: aiohttp.ClientSession | None = None
         self._session_loop = None
         self._pool = NativeConnPool(int(timeout * 1000))
@@ -252,28 +290,52 @@ class PieceDownloader:
         verifies at the commit point with no second pass and no re-read."""
         url = (f"http://{parent_ip}:{parent_upload_port}"
                f"/download/{task_id[:3]}/{task_id}")
+        parent = f"{parent_ip}:{parent_upload_port}"
+        chaos_key = f"{parent}|{task_id}|{piece_num}"
+        if _chaos is not None:
+            fault = _chaos.on_request("piece.request", chaos_key)
+            if fault is not None:
+                if fault.kind == "stall":
+                    await asyncio.sleep(fault.stall_s)
+                elif fault.kind == "http5xx":
+                    raise _err(Code.ClientPieceRequestFail,
+                               f"parent {parent} returned {fault.status} "
+                               f"for piece {piece_num} (chaos)", "http5xx")
+                else:
+                    raise _err(Code.ClientPieceRequestFail,
+                               f"piece {piece_num} from {parent}: "
+                               f"chaos {fault.kind}", "refused")
         start = time.monotonic()
         sess = await self._sess()
         try:
             async with sess.get(url, params={"peerId": src_peer_id,
                                              "pieceNum": str(piece_num)}) as resp:
-                if resp.status == 404:
-                    raise DfError(Code.ClientPieceNotFound,
-                                  f"parent {parent_ip}:{parent_upload_port} lacks piece {piece_num}")
-                if resp.status == 429:
-                    raise DfError(Code.ClientRequestLimitFail,
-                                  f"parent {parent_ip}:{parent_upload_port} throttled")
-                # 206: the upload server serves pieces as sendfile'd byte
-                # ranges (Partial Content) — equally complete payloads.
-                if resp.status not in (200, 206):
-                    raise DfError(Code.ClientPieceRequestFail,
-                                  f"parent returned {resp.status} for piece {piece_num}")
+                status_err = _upload_status_error(
+                    resp.status, parent, f"piece {piece_num}")
+                if status_err is not None:
+                    raise status_err
+                body = resp.content.iter_chunked(_RECV_CHUNK)
+                if _chaos is not None:
+                    body = _chaos.wrap_body("piece.body", chaos_key, body)
                 chunks, size, digest_str = await assemble_piece(
-                    resp.content.iter_chunked(_RECV_CHUNK), expected_size,
-                    expected_digest)
-        except aiohttp.ClientError as e:
-            raise DfError(Code.ClientPieceRequestFail,
-                          f"piece {piece_num} from {parent_ip}:{parent_upload_port}: {e}")
+                    retrylib.watch_idle(body, self._idle_timeout,
+                                        what=f"piece {piece_num} from {parent}"),
+                    expected_size, expected_digest)
+        except retrylib.ProgressTimeout as e:
+            # The stall watchdog tripped: the parent is connected but not
+            # producing. Treat like a dead parent (reschedule elsewhere).
+            raise _err(Code.ClientPieceRequestFail,
+                       f"piece {piece_num} from {parent}: {e}", "stall")
+        except asyncio.TimeoutError:
+            # aiohttp total-timeout surfaces as a bare TimeoutError, NOT a
+            # ClientError — uncaught it would escape the coded-DfError
+            # contract and fail the whole task instead of one piece.
+            raise _err(Code.ClientPieceRequestFail,
+                       f"piece {piece_num} from {parent}: "
+                       f"timed out after {self._timeout}s", "stall")
+        except (aiohttp.ClientError, ConnectionResetError) as e:
+            raise _err(Code.ClientPieceRequestFail,
+                       f"piece {piece_num} from {parent}: {e}", "transport")
         cost_ms = int((time.monotonic() - start) * 1000)
         return chunks, size, cost_ms, digest_str
 
@@ -291,6 +353,8 @@ class PieceDownloader:
         the crc check, so a bad body leaves no visible trace."""
         nb = _native()
         piece_size = store.metadata.piece_size
+        if _chaos is not None and _chaos.targets("piece"):
+            return None   # chaos aims at pieces: use the hookable path
         if (nb is None or expected_size < 0 or piece_size <= 0
                 or expected_size > piece_size or store.has_piece(piece_num)):
             return None
@@ -324,8 +388,9 @@ class PieceDownloader:
                 h, from_pool = await self._pool.acquire(
                     nb, parent_ip, parent_upload_port)
             except nb.NativeHttpError as e:
-                raise DfError(Code.ClientPieceRequestFail,
-                              f"piece {piece_num} from {parent_ip}:{parent_upload_port}: {e}")
+                raise _err(Code.ClientPieceRequestFail,
+                           f"piece {piece_num} from {parent_ip}:{parent_upload_port}: {e}",
+                           "refused")
             dup_fd = os.dup(store.data_fd())
 
             def abandon(h=h, dup_fd=dup_fd) -> None:
@@ -350,10 +415,12 @@ class PieceDownloader:
                 if e.code == nb.HTTP_E_LENMISMATCH:
                     # Wrong-size body is a per-piece data failure (matches
                     # the aiohttp path), not grounds to evict the parent.
-                    raise DfError(Code.ClientPieceDownloadFail,
-                                  f"piece {piece_num} from {parent_ip}:{parent_upload_port}: {e}")
-                raise DfError(Code.ClientPieceRequestFail,
-                              f"piece {piece_num} from {parent_ip}:{parent_upload_port}: {e}")
+                    raise _err(Code.ClientPieceDownloadFail,
+                               f"piece {piece_num} from {parent_ip}:{parent_upload_port}: {e}",
+                               "truncated")
+                raise _err(Code.ClientPieceRequestFail,
+                           f"piece {piece_num} from {parent_ip}:{parent_upload_port}: {e}",
+                           "transport")
             os.close(dup_fd)
             self._pool.release(nb, parent_ip, parent_upload_port, h, keep)
             break
@@ -362,8 +429,9 @@ class PieceDownloader:
         if status_err is not None:
             raise status_err
         if want_crc >= 0 and crc != want_crc:
-            raise DfError(Code.ClientPieceDownloadFail,
-                          f"piece {piece_num} digest mismatch: want {want_crc:08x}, got {crc:08x}")
+            raise _err(Code.ClientPieceDownloadFail,
+                       f"piece {piece_num} digest mismatch: want {want_crc:08x}, got {crc:08x}",
+                       "corrupt")
         cost_ms = int((time.monotonic() - start) * 1000)
         # Off-loop: the batched metadata save inside record_piece json-dumps
         # the whole accumulated piece map — a repeated loop stall on
@@ -396,6 +464,8 @@ class PieceDownloader:
         pieces; landed pieces stay recorded."""
         nb = _native()
         piece_size = store.metadata.piece_size
+        if _chaos is not None and _chaos.targets("piece"):
+            return False   # chaos aims at pieces: per-piece hookable path
         if nb is None or len(run) < 2 or piece_size <= 0:
             return False
         want_crcs: list[int] = []
@@ -439,10 +509,10 @@ class PieceDownloader:
                 h, from_pool = await self._pool.acquire(
                     nb, parent_ip, parent_upload_port)
             except nb.NativeHttpError as e:
-                return await fail_all(DfError(
+                return await fail_all(_err(
                     Code.ClientPieceRequestFail,
                     f"span {run[0].piece_num}-{run[-1].piece_num} from "
-                    f"{parent_ip}:{parent_upload_port}: {e}"))
+                    f"{parent_ip}:{parent_upload_port}: {e}", "refused"))
             dup_fd = os.dup(store.data_fd())
             abandoned = False
 
@@ -466,10 +536,10 @@ class PieceDownloader:
                     cleanup()
                     if from_pool:
                         continue  # stale keep-alive: retry on a fresh conn
-                    return await fail_all(DfError(
+                    return await fail_all(_err(
                         Code.ClientPieceRequestFail,
                         f"span {run[0].piece_num}-{run[-1].piece_num} from "
-                        f"{parent_ip}:{parent_upload_port}: {e}"))
+                        f"{parent_ip}:{parent_upload_port}: {e}", "transport"))
                 break
             except asyncio.CancelledError:
                 raise  # cleanup deferred to the worker thread
@@ -487,9 +557,10 @@ class PieceDownloader:
                 # Geometry disagreement: data failure, stream state unknown.
                 abandoned = True
                 cleanup()
-                return await fail_all(DfError(
+                return await fail_all(_err(
                     Code.ClientPieceDownloadFail,
-                    f"span Content-Length {clen} != expected {total}"))
+                    f"span Content-Length {clen} != expected {total}",
+                    "truncated"))
 
             transport_err: DfError | None = None
             for i, a in enumerate(run):
@@ -504,19 +575,21 @@ class PieceDownloader:
                                       h, dup_fd, a.piece_num * piece_size,
                                       a.expected_size)
                 except nb.NativeHttpError as e:
-                    transport_err = DfError(
+                    transport_err = _err(
                         Code.ClientPieceRequestFail,
                         f"piece {a.piece_num} mid-span from "
-                        f"{parent_ip}:{parent_upload_port}: {e}")
+                        f"{parent_ip}:{parent_upload_port}: {e}",
+                        "transport")
                     await on_result(a, None, transport_err)
                     continue
                 if want_crcs[i] >= 0 and crc != want_crcs[i]:
                     # Wrong bytes are on disk but unrecorded: invisible to
                     # serving/reuse until a good write lands over them.
-                    await on_result(a, None, DfError(
+                    await on_result(a, None, _err(
                         Code.ClientPieceDownloadFail,
                         f"piece {a.piece_num} digest mismatch: "
-                        f"want {want_crcs[i]:08x}, got {crc:08x}"))
+                        f"want {want_crcs[i]:08x}, got {crc:08x}",
+                        "corrupt"))
                     continue
                 cost_ms = int((time.monotonic() - t0) * 1000)
                 rec = await asyncio.to_thread(

@@ -22,6 +22,7 @@ from dragonfly2_tpu.daemon.peer.piece_downloader import (
 )
 from dragonfly2_tpu.pkg import dflog
 from dragonfly2_tpu.pkg import digest as pkgdigest
+from dragonfly2_tpu.pkg import retry as retrylib
 from dragonfly2_tpu.pkg.errors import Code, SourceError
 from dragonfly2_tpu.pkg.piece import Range, compute_piece_count, compute_piece_size
 from dragonfly2_tpu.pkg.ratelimit import Limiter
@@ -42,6 +43,16 @@ class PieceManagerOption:
     compute_digest: bool = True           # per-piece md5 during write
     concurrent_min_length: int = 32 << 20 # below this, a single stream wins
     chunk_size: int = 1 << 20
+    # Origin fetch retry budget: attempts for TEMPORARY failures only
+    # (connect resets, 5xx, short reads). Permanent client errors
+    # (403/404/416 — SourceError.temporary=False) fail on the first try:
+    # re-asking the origin for a URL it authoritatively rejected can never
+    # succeed, it only delays the task's failure verdict.
+    origin_attempts: int = 3
+    # Origin body chunk-gap watchdog (pkg/retry.watch_idle): bounds the
+    # silence between chunks so a stalled origin trips in bounded time
+    # instead of at the 300s request deadline. <= 0 disables.
+    origin_idle_timeout: float = 60.0
 
 
 class PieceManager:
@@ -104,17 +115,27 @@ class PieceManager:
                     except SourceError:
                         support_range = False
             if support_range:
-                await self._download_known_length_concurrent(
+                fetch = lambda: self._download_known_length_concurrent(  # noqa: E731
                     store, client, request, content_range, on_piece, limiter)
             else:
-                await self._download_streaming(
+                fetch = lambda: self._download_streaming(  # noqa: E731
                     store, client, request, content_range, on_piece, limiter,
                     known_length=content_length)
         else:
             if store.metadata.piece_size <= 0:
                 store.update_task(piece_size=compute_piece_size(-1))
-            await self._download_streaming(
-                store, client, request, content_range, on_piece, limiter, known_length=-1)
+            fetch = lambda: self._download_streaming(  # noqa: E731
+                store, client, request, content_range, on_piece, limiter,
+                known_length=-1)
+
+        # Origin retry rides the ONE policy module (capped exponential,
+        # full jitter) and retries TEMPORARY failures only: a 5xx burst or
+        # a dropped stream earns another attempt (landed pieces are
+        # skipped on resume), a permanent 403/404/416 fails immediately.
+        await retrylib.run(
+            fetch, policy=retrylib.SOURCE,
+            max_attempts=max(1, self.opt.origin_attempts),
+            retryable=lambda e: isinstance(e, SourceError) and e.temporary)
 
         if not store.is_complete():
             raise SourceError(
@@ -272,9 +293,11 @@ class PieceManager:
         # launches, so commits (and the prefix-hasher's in-memory frontier
         # feed) stay in piece order.
         pending: "asyncio.Future | None" = None
+        body = retrylib.watch_idle(resp.body, self.opt.origin_idle_timeout,
+                                   what=f"origin {request.url[:96]}")
         try:
             try:
-                async for chunk in resp.body:
+                async for chunk in body:
                     total += len(chunk)
                     cv = memoryview(chunk)
                     while len(cv):
@@ -300,6 +323,11 @@ class PieceManager:
                     pending.cancel()
                     await asyncio.gather(pending, return_exceptions=True)
                 raise
+        except retrylib.ProgressTimeout as e:
+            # Stalled origin (slow-loris): temporary — the retry policy
+            # may try again; landed pieces are skipped on resume.
+            raise SourceError(str(e), Code.BackToSourceAborted,
+                              temporary=True)
         finally:
             await resp.close()
         # Length check BEFORE the trailing partial piece lands: a dropped
@@ -375,9 +403,12 @@ class PieceManager:
             t0 = time.monotonic()
             # Depth-1 landing pipeline per group (see _download_streaming).
             pending: "asyncio.Future | None" = None
+            body = retrylib.watch_idle(
+                resp.body, self.opt.origin_idle_timeout,
+                what=f"origin group [{first},{last}) {request.url[:96]}")
             try:
                 try:
-                    async for chunk in resp.body:
+                    async for chunk in body:
                         got += len(chunk)
                         cv = memoryview(chunk)
                         while len(cv):
@@ -407,6 +438,9 @@ class PieceManager:
                         pending.cancel()
                         await asyncio.gather(pending, return_exceptions=True)
                     raise
+            except retrylib.ProgressTimeout as e:
+                raise SourceError(str(e), Code.BackToSourceAborted,
+                                  temporary=True)
             finally:
                 await resp.close()
             # Length check first — a short stream must not persist its

@@ -179,7 +179,13 @@ class TaskManager:
         # + task-possession broadcast (reference client/daemon/pex/).
         self.pex = pex
         from dragonfly2_tpu.daemon.peer.traffic_shaper import TrafficShaper
+        from dragonfly2_tpu.pkg.quarantine import ParentQuarantine
 
+        # Daemon-wide bad-parent quarantine: ONE decaying-penalty registry
+        # shared by every conductor (and the PEX pull path), keyed by the
+        # parent's serving endpoint — a parent that served corrupt bytes
+        # for one task is not trusted for the next.
+        self.quarantine = ParentQuarantine()
         self.shaper = TrafficShaper(
             total_rate_limit if total_rate_limit > 0 else float("inf"),
             algorithm=traffic_shaper)
@@ -293,7 +299,7 @@ class TaskManager:
         holders = [m for m in holders if m.peer_port and m.upload_port]
         if not holders:
             return False
-        dispatcher = PieceDispatcher()
+        dispatcher = PieceDispatcher(quarantine=self.quarantine)
         synchronizer = PieceTaskSynchronizer(task_id, peer_id, dispatcher)
         downloader = PieceDownloader()
         dispatcher.mark_known_downloaded(store.metadata.pieces.keys())
@@ -319,6 +325,15 @@ class TaskManager:
                 except DfError as e:
                     dispatcher.report_failure(assignment,
                                               parent_gone=is_parent_gone(e))
+                    from dragonfly2_tpu.daemon.peer.piece_downloader import (
+                        failure_reason,
+                    )
+                    from dragonfly2_tpu.daemon.peer.piece_dispatcher import (
+                        parent_key,
+                    )
+
+                    self.quarantine.penalize(parent_key(assignment.parent),
+                                             failure_reason(e))
                     continue
                 dispatcher.report_success(assignment, rec.cost_ms)
                 await on_piece(store, rec)

@@ -1,0 +1,353 @@
+"""Chaos fabric: deterministic, seeded fault injection for the data plane.
+
+The P2P fabric's failure story (parent death mid-piece, corrupt bodies,
+slow-loris stalls, scheduler crashes, origin 5xx bursts) was grown
+piecemeal and exercised by hope. This module injects those faults FROM A
+SEEDED SCHEDULE at the three choke points every byte and control message
+already flows through:
+
+  rpc.connect     rpc/client.Client._ensure_conn        refuse | stall
+  rpc.recv        rpc/framing.FrameReader.read          drop | stall
+  rpc.send        rpc/framing.FrameWriter.write         drop | stall
+  piece.request   daemon/peer/piece_downloader GET      refuse | http5xx | stall
+  piece.body      piece body stream                     truncate | corrupt | drop | stall
+  source.request  source client download/probe          refuse | http5xx | stall
+  source.body     origin body stream                    truncate | corrupt | drop | stall
+
+``rpc.recv`` drop against the scheduler connection IS the
+scheduler-member-crash simulation from the daemon's point of view: the
+read loop dies, every pending call and stream fails, and the announce
+recovery path has to do its job.
+
+Determinism: the decision for the n-th invocation of a given
+``(site, key)`` is a pure function of ``(seed, site, key, n, rule)`` —
+independent of event-loop interleaving across keys — so one seed
+reproduces the identical fault schedule run after run, and a failing
+schedule can be replayed.
+
+Inert by default, zero hot-path overhead: the hooked modules hold a
+module-level ``_chaos = None`` that only ``enable()`` ever assigns; the
+hot path pays one ``is not None`` check and never imports this module
+(tests/test_chaos.py pins both properties).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import random
+from dataclasses import dataclass, field
+from typing import AsyncIterator
+
+from dragonfly2_tpu.pkg import dflog, metrics
+
+log = dflog.get("chaos")
+
+FAULT_COUNT = metrics.counter(
+    "chaos_faults_injected_total",
+    "Faults injected by the chaos fabric", ("site", "kind"))
+
+# site prefix -> fault kinds it knows how to express
+KINDS = ("refuse", "drop", "truncate", "corrupt", "stall", "http5xx")
+
+ENV_VAR = "DF_CHAOS"
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One fault rule. Matches by exact ``site``; ``key_substr`` narrows to
+    invocations whose key contains it (e.g. one parent's ip:port)."""
+
+    site: str
+    kind: str
+    rate: float = 0.0          # per-invocation probability (seeded stream)
+    at: tuple = ()             # explicit 1-based invocation indices that fire
+    key_substr: str = ""
+    max_fires: int = -1        # -1 = unlimited
+    stall_s: float = 0.5       # sleep for kind == "stall"
+    status: int = 503          # response status for kind == "http5xx"
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown chaos fault kind {self.kind!r}")
+
+
+@dataclass(frozen=True)
+class Fault:
+    """A decision to inject, handed back to the choke point."""
+
+    site: str
+    kind: str
+    stall_s: float = 0.5
+    status: int = 503
+
+
+@dataclass
+class ChaosFabric:
+    """The seeded schedule + injection helpers.
+
+    ``decide(site, key)`` advances the (site, key) invocation counter and
+    returns the Fault to inject (or None). All the async helpers below
+    translate a Fault into the native failure shape of their call site.
+    """
+
+    seed: int = 0
+    rules: list = field(default_factory=list)
+
+    def __post_init__(self):
+        self._counts: dict[tuple[str, str], int] = {}
+        self._fires: dict[int, int] = {}       # rule index -> times fired
+        self.injected: list[tuple[str, str, int, str]] = []  # (site,key,n,kind)
+
+    # -- schedule ----------------------------------------------------------
+
+    @staticmethod
+    def _draw(seed: int, site: str, key: str, n: int, rule_idx: int) -> float:
+        # A fresh Random per decision keyed on the full coordinates: the
+        # n-th decision for (site, key) is interleaving-independent.
+        return random.Random(f"{seed}|{site}|{key}|{n}|{rule_idx}").random()
+
+    def decide(self, site: str, key: str = "") -> Fault | None:
+        n = self._counts.get((site, key), 0) + 1
+        self._counts[(site, key)] = n
+        for idx, rule in enumerate(self.rules):
+            if rule.site != site:
+                continue
+            if rule.key_substr and rule.key_substr not in key:
+                continue
+            if rule.max_fires >= 0 and self._fires.get(idx, 0) >= rule.max_fires:
+                continue
+            hit = (n in rule.at) if rule.at else (
+                rule.rate > 0.0
+                and self._draw(self.seed, site, key, n, idx) < rule.rate)
+            if not hit:
+                continue
+            self._fires[idx] = self._fires.get(idx, 0) + 1
+            self.injected.append((site, key, n, rule.kind))
+            FAULT_COUNT.labels(site, rule.kind).inc()
+            log.info("chaos fault", site=site, key=key[:64], n=n,
+                     kind=rule.kind)
+            return Fault(site, rule.kind, rule.stall_s, rule.status)
+        return None
+
+    def targets(self, site_prefix: str) -> bool:
+        """Does any rule touch sites under ``site_prefix``? The native
+        piece path asks this once per task to route bytes through the
+        (hookable) Python path while chaos aims at it."""
+        return any(r.site.startswith(site_prefix) for r in self.rules)
+
+    def injected_by_kind(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for _site, _key, _n, kind in self.injected:
+            out[kind] = out.get(kind, 0) + 1
+        return out
+
+    # -- injection helpers (async; called only when a hook is armed) -------
+
+    async def on_connect(self, site: str, key: str, exc_factory) -> None:
+        """Connect-shaped choke point: refuse/drop raise ``exc_factory(msg)``,
+        stall sleeps then proceeds."""
+        fault = self.decide(site, key)
+        if fault is None:
+            return
+        if fault.kind == "stall":
+            await asyncio.sleep(fault.stall_s)
+            return
+        if fault.kind == "http5xx":
+            raise exc_factory(f"chaos: injected {fault.status} at {site}")
+        raise exc_factory(f"chaos: injected {fault.kind} at {site}")
+
+    async def on_frame(self, site: str, key: str) -> str | None:
+        """Frame-level choke point (rpc.recv / rpc.send): returns "drop"
+        when the connection should be considered lost, None to proceed.
+        Stall sleeps inline (the frame still goes through afterwards)."""
+        fault = self.decide(site, key)
+        if fault is None:
+            return None
+        if fault.kind == "stall":
+            await asyncio.sleep(fault.stall_s)
+            return None
+        return "drop"
+
+    def on_request(self, site: str, key: str) -> Fault | None:
+        """Request-shaped choke point (piece/source HTTP request): the
+        caller maps the Fault into its own coded error / status. Stall is
+        returned too — the caller sleeps where it can hold its timeout
+        accounting together."""
+        return self.decide(site, key)
+
+    async def wrap_body(self, site: str, key: str,
+                        chunks: AsyncIterator[bytes]) -> AsyncIterator[bytes]:
+        """Body-stream choke point. One decision per stream, drawn at the
+        first chunk so empty streams don't consume schedule entries:
+
+          truncate  yield half of the first chunk, then end (clean EOF —
+                    the length/digest checks must catch it)
+          corrupt   flip one bit in the first chunk (crc32c must trip)
+          drop      yield the first chunk, then die mid-stream
+          stall     sleep before the first chunk (progress watchdogs trip)
+        """
+        fault: Fault | None = None
+        first = True
+        async for chunk in chunks:
+            if first:
+                first = False
+                fault = self.decide(site, key)
+                if fault is not None:
+                    if fault.kind == "stall":
+                        await asyncio.sleep(fault.stall_s)
+                    elif fault.kind == "truncate":
+                        if len(chunk) > 1:
+                            yield bytes(chunk)[: max(1, len(chunk) // 2)]
+                        return
+                    elif fault.kind == "corrupt":
+                        b = bytearray(chunk)
+                        b[len(b) // 2] ^= 0x01
+                        yield bytes(b)
+                        fault = None   # rest of the stream flows clean
+                        continue
+                    elif fault.kind == "drop":
+                        yield chunk
+                        raise ConnectionResetError(
+                            f"chaos: injected drop at {site}")
+            yield chunk
+
+    def wrap_source(self, client):
+        """Proxy a source ResourceClient so origin requests/bodies pass
+        through the source.* sites. Proxies are cached per client so the
+        registry hands out stable objects."""
+        cache = getattr(self, "_source_proxies", None)
+        if cache is None:
+            cache = self._source_proxies = {}
+        proxy = cache.get(id(client))
+        if proxy is None:
+            proxy = _ChaosSourceClient(self, client)
+            cache[id(client)] = proxy
+        return proxy
+
+
+class _ChaosSourceClient:
+    """Source-client proxy: injects at source.request / source.body and
+    delegates everything else untouched."""
+
+    def __init__(self, fabric: ChaosFabric, inner):
+        self._fabric = fabric
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def native_fetch_plan(self, request):
+        # The native origin path bypasses Python byte handling entirely;
+        # while chaos aims at the source sites, route through the hookable
+        # aiohttp path instead.
+        if self._fabric.targets("source"):
+            return None
+        plan_fn = getattr(self._inner, "native_fetch_plan", None)
+        return plan_fn(request) if plan_fn is not None else None
+
+    async def download(self, request):
+        from dragonfly2_tpu.pkg.errors import Code, SourceError
+
+        fault = self._fabric.on_request("source.request", request.url)
+        if fault is not None:
+            if fault.kind == "stall":
+                await asyncio.sleep(fault.stall_s)
+            elif fault.kind == "http5xx":
+                raise SourceError(
+                    f"chaos: origin {fault.status}: {request.url}",
+                    Code.BackToSourceAborted, temporary=True)
+            else:
+                raise SourceError(
+                    f"chaos: origin connect refused: {request.url}",
+                    Code.BackToSourceAborted, temporary=True)
+        resp = await self._inner.download(request)
+        wrapped = self._fabric.wrap_body("source.body", request.url,
+                                         resp.body)
+
+        async def body():
+            # Injected drops surface as the coded temporary SourceError
+            # the real clients raise for a mid-stream connection loss.
+            try:
+                async for chunk in wrapped:
+                    yield chunk
+            except ConnectionResetError as e:
+                raise SourceError(f"chaos: origin read {request.url}: {e}",
+                                  Code.BackToSourceAborted, temporary=True)
+
+        resp.body = body()
+        return resp
+
+
+# --------------------------------------------------------------------- #
+# Arming / disarming the hooks
+# --------------------------------------------------------------------- #
+
+_enabled: ChaosFabric | None = None
+
+
+def _hooked_modules():
+    # Imported HERE, not by the hot modules: with chaos off they never
+    # see this module at all.
+    from dragonfly2_tpu.daemon.peer import piece_downloader
+    from dragonfly2_tpu.rpc import client as rpc_client
+    from dragonfly2_tpu.rpc import framing as rpc_framing
+    from dragonfly2_tpu.source import client as source_client
+
+    return (rpc_client, rpc_framing, piece_downloader, source_client)
+
+
+def enable(fabric: ChaosFabric) -> ChaosFabric:
+    """Arm the fabric at every choke point (process-wide)."""
+    global _enabled
+    _enabled = fabric
+    for mod in _hooked_modules():
+        mod._chaos = fabric
+    log.info("chaos fabric ENABLED", seed=fabric.seed,
+             rules=len(fabric.rules))
+    return fabric
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = None
+    for mod in _hooked_modules():
+        mod._chaos = None
+
+
+def enabled() -> ChaosFabric | None:
+    return _enabled
+
+
+def parse_spec(spec: "str | dict") -> ChaosFabric:
+    """Build a fabric from a JSON spec (or an already-parsed dict):
+
+        {"seed": 7, "rules": [
+            {"site": "piece.body", "kind": "corrupt", "rate": 0.25},
+            {"site": "rpc.recv", "kind": "drop", "at": [3]}]}
+    """
+    if isinstance(spec, str):
+        spec = json.loads(spec)
+    rules = [Rule(site=r["site"], kind=r["kind"],
+                  rate=float(r.get("rate", 0.0)),
+                  at=tuple(r.get("at") or ()),
+                  key_substr=r.get("key_substr", ""),
+                  max_fires=int(r.get("max_fires", -1)),
+                  stall_s=float(r.get("stall_s", 0.5)),
+                  status=int(r.get("status", 503)))
+             for r in spec.get("rules") or []]
+    return ChaosFabric(seed=int(spec.get("seed", 0)), rules=rules)
+
+
+def maybe_enable_from_env() -> ChaosFabric | None:
+    """Arm from ``DF_CHAOS`` (inline JSON, or ``@/path/to/spec.json``).
+    Unset/empty → no-op. Called by daemon/scheduler bootstrap so real-
+    process runs (benches, e2e) can inject without code changes."""
+    raw = os.environ.get(ENV_VAR, "").strip()
+    if not raw:
+        return None
+    if raw.startswith("@"):
+        with open(raw[1:]) as f:
+            raw = f.read()
+    return enable(parse_spec(raw))

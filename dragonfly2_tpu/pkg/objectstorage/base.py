@@ -11,7 +11,15 @@ from typing import AsyncIterator
 
 
 class ObjectStorageError(Exception):
-    pass
+    """Backend failure. ``status`` carries the HTTP status when one was
+    received (0 = connection-level / non-HTTP failure) so callers can
+    separate permanent client errors (403/404: never retry) from
+    retryable server/transport trouble — the source clients' ``temporary``
+    classification rides on it."""
+
+    def __init__(self, message: str = "", status: int = 0):
+        super().__init__(message)
+        self.status = status
 
 
 @dataclass

@@ -114,13 +114,20 @@ class S3ObjectStorage(ObjectStorage):
         headers = self._auth_headers(method, path, query, payload_sha)
         headers.update(extra_headers or {})
         url = self.endpoint + quote(path) + (f"?{query}" if query else "")
-        resp = await self._http().request(method, url, data=_as_body(data),
-                                          headers=headers)
+        try:
+            resp = await self._http().request(method, url, data=_as_body(data),
+                                              headers=headers)
+        except aiohttp.ClientError as e:
+            # Connection-level failure (endpoint down, DNS, reset): status
+            # stays 0 so callers classify it as retryable, not as an
+            # authoritative backend answer.
+            raise ObjectStorageError(f"s3 {method} {path}: {e}")
         if resp.status not in ok:
             body = (await resp.text())[:300]
             resp.release()
             raise ObjectStorageError(
-                f"s3 {method} {path}: HTTP {resp.status} {body}")
+                f"s3 {method} {path}: HTTP {resp.status} {body}",
+                status=resp.status)
         return resp
 
     # -- buckets -----------------------------------------------------------

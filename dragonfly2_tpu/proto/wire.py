@@ -283,7 +283,10 @@ STREAM_MSGS: dict[str, dict[str, Msg]] = {
             pieces=F(list, required=True, item=F(dict, spec=PIECE))),
         "piece_failed": Msg(
             "PieceFailed", piece_num=F(int), parent_id=F(str),
-            temporary=F(bool)),
+            temporary=F(bool),
+            # Typed failure reason (pkg/quarantine.REASON_WEIGHTS
+            # vocabulary): feeds the scheduler-side parent demotion.
+            reason=F(str)),
         "reschedule": Msg(
             "Reschedule", blocklist=F(list, item=F(str)),
             description=F(str)),

@@ -1,7 +1,9 @@
 """drpc client: one multiplexed connection per target, unary + streams.
 
 Mirrors pkg/rpc client constructors (scheduler/dfdaemon/manager clients):
-lazy connect, automatic reconnect on next use, coded-error translation.
+lazy connect, automatic reconnect on next use with capped jittered
+backoff (a flapping scheduler must not be hammered by every call-site's
+eager redial), coded-error translation.
 """
 
 from __future__ import annotations
@@ -10,7 +12,7 @@ import asyncio
 import itertools
 from typing import Any
 
-from dragonfly2_tpu.pkg import dflog, tracing
+from dragonfly2_tpu.pkg import dflog, retry, tracing
 from dragonfly2_tpu.pkg.errors import Code, DfError, error_from_wire
 from dragonfly2_tpu.pkg.types import NetAddr
 from dragonfly2_tpu.rpc.framing import (
@@ -29,6 +31,9 @@ from dragonfly2_tpu.rpc.framing import (
 )
 
 log = dflog.get("rpc.client")
+
+# Chaos fabric hook (pkg/chaos.enable() arms it; None = inert).
+_chaos = None
 
 
 class RpcError(DfError):
@@ -87,6 +92,11 @@ class ClientStream:
 
 
 class Client:
+    # Reconnect pacing (pkg/retry.RECONNECT): consecutive connect failures
+    # push the next dial out by a capped, fully-jittered exponential delay
+    # instead of redialing eagerly on every next use.
+    BACKOFF = retry.RECONNECT
+
     def __init__(self, addr: NetAddr, connect_timeout: float = 5.0,
                  *, ssl_context=None):
         self.addr = addr
@@ -98,11 +108,34 @@ class Client:
         self._pending: dict[int, asyncio.Future] = {}
         self._streams: dict[int, ClientStream] = {}
         self._conn_lock = asyncio.Lock()
+        self._connect_failures = 0
+        self._next_connect_at = 0.0
+
+    def _note_connect_failure(self) -> None:
+        delay = self.BACKOFF.delay(self._connect_failures)
+        self._connect_failures += 1
+        self._next_connect_at = (
+            asyncio.get_running_loop().time() + delay)
 
     async def _ensure_conn(self) -> FrameWriter:
         async with self._conn_lock:
             if self._fw is not None and self._reader_task is not None and not self._reader_task.done():
                 return self._fw
+            # Backoff pacing after failed dials. Sleeping here (under the
+            # lock) is the point: every caller of a flapping endpoint
+            # coalesces behind one appropriately-delayed dial instead of
+            # each issuing its own.
+            wait = self._next_connect_at - asyncio.get_running_loop().time()
+            if wait > 0:
+                await asyncio.sleep(wait)
+            if _chaos is not None:
+                try:
+                    await _chaos.on_connect(
+                        "rpc.connect", str(self.addr),
+                        lambda m: RpcError(Code.ClientConnectionError, m))
+                except RpcError:
+                    self._note_connect_failure()
+                    raise
             try:
                 if self.addr.type == "tcp":
                     host, port = self.addr.host_port()
@@ -137,9 +170,13 @@ class Client:
                         asyncio.open_unix_connection(self.addr.addr), self._connect_timeout
                     )
             except (OSError, asyncio.TimeoutError) as e:
+                self._note_connect_failure()
                 raise RpcError(Code.ClientConnectionError, f"connect {self.addr}: {e}")
-            self._fw = FrameWriter(writer)
-            self._reader_task = asyncio.ensure_future(self._read_loop(FrameReader(reader)))
+            self._connect_failures = 0
+            self._next_connect_at = 0.0
+            self._fw = FrameWriter(writer, chaos_key=str(self.addr))
+            self._reader_task = asyncio.ensure_future(
+                self._read_loop(FrameReader(reader, chaos_key=str(self.addr))))
             return self._fw
 
     async def _read_loop(self, fr: FrameReader) -> None:

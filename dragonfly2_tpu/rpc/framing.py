@@ -26,6 +26,11 @@ import msgpack
 
 MAX_FRAME = 64 * 1024 * 1024  # hard cap; piece payloads don't ride drpc
 
+# Chaos fabric hook (pkg/chaos.enable() arms it; None = inert). A dropped
+# rpc.recv here is how tests/benches simulate a scheduler-member crash:
+# the reader sees EOF, the owner fails every pending call and stream.
+_chaos = None
+
 CALL = 1
 RESULT = 2
 SOPEN = 3
@@ -108,11 +113,15 @@ async def stream_recv(inbox: asyncio.Queue, closed: asyncio.Event, timeout: floa
 
 
 class FrameReader:
-    def __init__(self, reader: asyncio.StreamReader):
+    def __init__(self, reader: asyncio.StreamReader, chaos_key: str = ""):
         self._r = reader
+        self.chaos_key = chaos_key
 
     async def read(self) -> Frame | None:
         """Read one frame; None on clean EOF."""
+        if _chaos is not None and \
+                await _chaos.on_frame("rpc.recv", self.chaos_key) == "drop":
+            return None   # injected connection loss: owner sees EOF
         try:
             header = await self._r.readexactly(4)
         except (asyncio.IncompleteReadError, ConnectionResetError):
@@ -128,11 +137,15 @@ class FrameReader:
 
 
 class FrameWriter:
-    def __init__(self, writer: asyncio.StreamWriter):
+    def __init__(self, writer: asyncio.StreamWriter, chaos_key: str = ""):
         self._w = writer
         self._lock = asyncio.Lock()
+        self.chaos_key = chaos_key
 
     async def write(self, frame: Frame) -> None:
+        if _chaos is not None and \
+                await _chaos.on_frame("rpc.send", self.chaos_key) == "drop":
+            raise ConnectionResetError("chaos: injected send drop")
         header, payload = frame.pack_parts()
         async with self._lock:
             # Two writes, no concat: StreamWriter buffers both before the

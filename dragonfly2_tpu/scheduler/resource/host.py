@@ -11,6 +11,7 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass, field
 
+from dragonfly2_tpu.pkg.quarantine import DecayingPenalty, penalize_entry
 from dragonfly2_tpu.pkg.types import HostType
 from dragonfly2_tpu.scheduler.config import (
     PEER_CONCURRENT_UPLOAD_LIMIT,
@@ -73,6 +74,22 @@ class Host:
         self.updated_at = time.time()
         # peer ids on this host (peer GC on LeaveHost)
         self.peer_ids: set[str] = set()
+        # Bad-serve quarantine (pkg/quarantine discipline, same constants
+        # as the daemon side): children's typed piece_failed reports add
+        # reason-weighted, half-life-decaying penalty; while quarantined
+        # the host is filtered from EVERY peer's candidate set — one
+        # child's crc mismatch protects the whole pod.
+        self._penalty = DecayingPenalty()
+
+    # -- bad-serve quarantine ----------------------------------------------
+
+    def note_served_bad(self, reason: str) -> bool:
+        """Record a typed serving failure. Returns True when the host just
+        ENTERED quarantine (callers report that edge)."""
+        return penalize_entry(self._penalty, reason, time.monotonic())
+
+    def quarantined(self) -> bool:
+        return time.monotonic() < self._penalty.quarantined_until
 
     # -- upload accounting (evaluator free-upload term) --------------------
 

@@ -215,6 +215,11 @@ class Scheduling:
                     return False
         if parent.host.free_upload_count() <= 0:
             return False
+        if parent.host.quarantined():
+            # Pod-wide demotion: typed piece_failed reports (corrupt /
+            # truncated / stalled serving) quarantined this host; it stays
+            # out of EVERY peer's candidate set until the penalty decays.
+            return False
         if self.evaluator.is_bad_node(parent):
             return False
         # DAG sanity: adding child under parent must not create a cycle or a

@@ -62,6 +62,16 @@ STRIPE_HANDOUT_COUNT = metrics.counter(
     "or reshuffle (membership-change push to a live slice member)",
     ("kind",))
 
+PARENT_DEMOTION_COUNT = metrics.counter(
+    "scheduler_parent_quarantine_total",
+    "Hosts entering scheduler-side quarantine from typed piece_failed "
+    "reports, by tipping reason", ("reason",))
+
+PEER_REREGISTER_COUNT = metrics.counter(
+    "scheduler_peer_reregister_total",
+    "Terminal peers replaced by a fresh registration (announce-stream "
+    "recovery after a drop)")
+
 
 class SchedulerService:
     def __init__(self, config: SchedulerConfig | None = None):
@@ -122,6 +132,19 @@ class SchedulerService:
                 range_header=open_body.get("range", ""),
             )
         )
+        stale = self.peers.load(open_body["peer_id"])
+        if stale is not None and stale.fsm.current in (PeerState.FAILED,
+                                                       PeerState.LEAVE):
+            # Announce-stream recovery: the daemon's stream died mid-task
+            # (scheduler restart, net blip) and _on_stream_gone failed the
+            # peer. The SAME peer id re-registering is the conductor
+            # reconnecting — replace the terminal record with a fresh one;
+            # its completed pieces re-arrive via the recovery re-report
+            # (idempotent application) so it becomes a usable parent again.
+            self.peers.delete(stale.id)
+            PEER_REREGISTER_COUNT.inc()
+            log.info("terminal peer re-registered", peer=stale.id[:24],
+                     prior_state=stale.fsm.current)
         peer = self.peers.load_or_store(
             Peer(
                 open_body["peer_id"],
@@ -558,6 +581,17 @@ class SchedulerService:
             if parent is not None:
                 parent.host.upload_count += 1
                 parent.host.upload_failed_count += 1
+                # Typed reason → pod-wide demotion: enough reason-weighted
+                # strikes (corrupt bytes tip in one) quarantine the HOST,
+                # filtering it from every peer's candidate set — not just
+                # this reporter's blocklist.
+                reason = msg.get("reason", "")
+                if reason and parent.host.note_served_bad(reason):
+                    PARENT_DEMOTION_COUNT.labels(reason).inc()
+                    log.warning("parent host quarantined",
+                                host=parent.host.id, reason=reason,
+                                reporter=peer.id[:24])
+                    task.notify_parents_changed()
 
     # -- reschedule (reference :1157 handleRescheduleRequest) --------------
 

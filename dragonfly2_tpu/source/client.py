@@ -17,6 +17,11 @@ from dragonfly2_tpu.pkg.errors import Code, SourceError
 
 UNKNOWN_SOURCE_FILE_LEN = -2
 
+# Chaos fabric hook (pkg/chaos.enable() arms it; None = inert). When
+# armed, Registry.get wraps clients so origin requests/bodies pass the
+# source.request / source.body injection sites.
+_chaos = None
+
 
 @dataclass
 class Request:
@@ -155,6 +160,8 @@ class Registry:
             client = self._try_plugin(scheme.lower())
         if client is None:
             raise SourceError(f"no source client for scheme {scheme!r}", Code.UnsupportedProtocol)
+        if _chaos is not None:
+            return _chaos.wrap_source(client)
         return client
 
     def _try_plugin(self, scheme: str) -> ResourceClient | None:

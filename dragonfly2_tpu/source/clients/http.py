@@ -30,8 +30,24 @@ def _status_error(status: int, url: str) -> SourceError:
         return SourceError(f"origin 404: {url}", Code.SourceNotFound)
     if status in (401, 403):
         return SourceError(f"origin {status}: {url}", Code.SourceForbidden)
-    temporary = status in (408, 429, 500, 502, 503, 504)
+    if status == 416:
+        return SourceError(f"origin 416: {url}", Code.SourceRangeUnsupported)
+    # Retryable: explicit transient statuses + the whole 5xx family.
+    # Remaining 4xx are the CALLER's fault — retrying burns the
+    # back-to-source budget on a request that can never succeed.
+    temporary = status in (408, 429) or status >= 500
     return SourceError(f"origin {status}: {url}", Code.BackToSourceAborted, temporary=temporary)
+
+
+def _client_error(e: "aiohttp.ClientError", url: str, what: str) -> SourceError:
+    """Map an aiohttp failure to a coded SourceError. A ClientResponseError
+    carries a REAL origin status — classify it like one (a 403/404 raised
+    this way must not come back temporary=True and burn origin retries);
+    everything else is connection-level and genuinely temporary."""
+    if isinstance(e, aiohttp.ClientResponseError) and e.status:
+        return _status_error(e.status, url)
+    return SourceError(f"origin {what} {url}: {e}",
+                       Code.BackToSourceAborted, temporary=True)
 
 
 class HTTPSourceClient(ResourceClient):
@@ -132,8 +148,7 @@ class HTTPSourceClient(ResourceClient):
             resp = await sess.get(request.url, headers=request.header,
                                   timeout=aiohttp.ClientTimeout(total=request.timeout))
         except aiohttp.ClientError as e:
-            raise SourceError(f"origin connect {request.url}: {e}",
-                              Code.BackToSourceAborted, temporary=True)
+            raise _client_error(e, request.url, "connect")
         if resp.status >= 400:
             status = resp.status
             resp.release()
@@ -144,8 +159,7 @@ class HTTPSourceClient(ResourceClient):
                 async for chunk in resp.content.iter_chunked(CHUNK):
                     yield chunk
             except aiohttp.ClientError as e:
-                raise SourceError(f"origin read {request.url}: {e}",
-                                  Code.BackToSourceAborted, temporary=True)
+                raise _client_error(e, request.url, "read")
 
         # content_length is the stream length (for 206, the range size — the
         # caller asked for exactly that many bytes).
@@ -191,8 +205,7 @@ class HTTPSourceClient(ResourceClient):
                 if resp.status >= 400:
                     raise _status_error(resp.status, request.url)
         except aiohttp.ClientError as e:
-            raise SourceError(f"origin probe {request.url}: {e}",
-                              Code.BackToSourceAborted, temporary=True)
+            raise _client_error(e, request.url, "probe")
         return UNKNOWN_SOURCE_FILE_LEN, False
 
     async def get_content_length(self, request: Request) -> int:

@@ -59,7 +59,7 @@ class S3SourceClient(ResourceClient):
         try:
             meta = await self._backend.get_object_metadata(bucket, key)
         except ObjectStorageError as e:
-            raise SourceError(f"s3 stat {request.url}: {e}", Code.SourceNotFound)
+            raise self._stat_error(e, request.url)
         if rng_header:
             r = Range.parse_http(rng_header, meta.content_length)
             start, end = r.start, r.start + r.length - 1
@@ -69,17 +69,40 @@ class S3SourceClient(ResourceClient):
         try:
             chunks = await self._backend.get_object(bucket, key, start, end)
         except ObjectStorageError as e:
-            raise SourceError(f"s3 get {request.url}: {e}",
-                              Code.BackToSourceAborted, temporary=True)
+            # Classify by backend status (0 = connection-level): permanent
+            # client errors (403/404) must not come back temporary=True
+            # and burn the back-to-source retry budget (the gcs/hdfs
+            # ``status >= 500`` convention).
+            if e.status == 404:
+                raise SourceError(f"{self.scheme} get {request.url}: {e}",
+                                  Code.SourceNotFound)
+            if e.status in (401, 403):
+                raise SourceError(f"{self.scheme} get {request.url}: {e}",
+                                  Code.SourceForbidden)
+            raise SourceError(f"{self.scheme} get {request.url}: {e}",
+                              Code.BackToSourceAborted,
+                              temporary=e.status == 0 or e.status >= 500)
         return Response(chunks, status=206 if rng_header else 200,
                         content_length=content_length, support_range=True)
+
+    def _stat_error(self, e: ObjectStorageError, url: str) -> SourceError:
+        if e.status in (401, 403):
+            return SourceError(f"{self.scheme} stat {url}: {e}",
+                               Code.SourceForbidden)
+        if e.status == 0 or e.status >= 500:
+            # Endpoint unreachable / server trouble: retryable — NOT the
+            # authoritative not-found a 404 would be.
+            return SourceError(f"{self.scheme} stat {url}: {e}",
+                               Code.BackToSourceAborted, temporary=True)
+        return SourceError(f"{self.scheme} stat {url}: {e}",
+                           Code.SourceNotFound)
 
     async def get_content_length(self, request: Request) -> int:
         bucket, key = self._parse(request.url)
         try:
             return (await self._backend.get_object_metadata(bucket, key)).content_length
         except ObjectStorageError as e:
-            raise SourceError(f"s3 stat {request.url}: {e}", Code.SourceNotFound)
+            raise self._stat_error(e, request.url)
 
     async def is_support_range(self, request: Request) -> bool:
         return True
@@ -90,7 +113,7 @@ class S3SourceClient(ResourceClient):
             metas = await self._backend.list_object_metadatas(
                 bucket, prefix=prefix.rstrip("/") + "/" if prefix else "")
         except ObjectStorageError as e:
-            raise SourceError(f"s3 list {request.url}: {e}", Code.SourceNotFound)
+            raise self._stat_error(e, request.url)
         return [ListEntry(url=f"{self.scheme}://{bucket}/{m.key}", name=m.key,
                           is_dir=False, content_length=m.content_length)
                 for m in metas]

@@ -13,6 +13,8 @@ import json
 import math
 import os
 
+import pytest
+
 BASELINE = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "BASELINE.json")
 
@@ -24,6 +26,7 @@ REQUIRED_CONFIGS = (
     "config5_pod_sim",
     "config2_fanout_striped",
     "config6_stripe_sim",
+    "config7_chaos",
 )
 
 
@@ -79,6 +82,23 @@ def test_striped_entries_paired_shape():
             assert "per_host_dcn_mb" in r, key
         # The point of the feature: striping must not DCN-pull more.
         assert s["max_host_dcn_mb"] <= u["max_host_dcn_mb"], key
+
+
+def test_chaos_entry_paired_shape():
+    """config7_chaos is a PAIRED degradation run: clean + degraded walls
+    from the same pod, degraded completes byte-identical, the schedule
+    actually injected (a zero-fault 'degraded' run measures nothing),
+    and the ratio derives from the pair."""
+    entry = _load()["published"]["config7_chaos"]
+    assert entry["byte_identical"] is True
+    clean, degraded = entry["clean"], entry["degraded"]
+    for run in (clean, degraded):
+        assert run["wall_s"] > 0 and run["ok"] is True
+        assert run["byte_identical"] is True
+    assert degraded["faults"], "degraded run injected no faults"
+    assert 0 < entry["dead_parent_fraction"] < 1
+    assert entry["ratio"] == pytest.approx(
+        degraded["wall_s"] / clean["wall_s"], rel=1e-2)
 
 
 def test_stripe_sim_meets_acceptance_bounds():

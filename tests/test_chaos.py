@@ -1,0 +1,1074 @@
+"""Chaos fabric + graceful-degradation tests.
+
+Fast tier-1: schedule determinism, disabled-by-default/zero-overhead
+guards, per-fault injection units (corruption → crc reject → quarantine,
+truncation, stall → watchdog, refusal), announce-stream recovery with
+report flush, rpc reconnect backoff, source-client temporary
+classification, scheduler-side typed demotion.
+
+Slow (@chaos): a seeded 4-host pod e2e completing byte-identical under
+25% parent death + corruption bursts, and converging to back-to-source
+when every parent is refused.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import math
+import subprocess
+import sys
+
+import pytest
+
+from dragonfly2_tpu.pkg import chaos as chaos_mod
+from dragonfly2_tpu.pkg import digest as pkgdigest
+from dragonfly2_tpu.pkg import retry as retrylib
+from dragonfly2_tpu.pkg.errors import Code, DfError, SourceError, StorageError
+from dragonfly2_tpu.pkg.quarantine import ParentQuarantine
+from dragonfly2_tpu.storage import StorageManager, StorageOption, TaskStoreMetadata
+
+
+@pytest.fixture(autouse=True)
+def _chaos_disabled():
+    """Every test starts and ends with the fabric disarmed."""
+    chaos_mod.disable()
+    yield
+    chaos_mod.disable()
+
+
+def make_store(tmp_path, task_id="chaos-t", piece_size=4, content_length=8):
+    sm = StorageManager(StorageOption(data_dir=str(tmp_path / "data")))
+    return sm.register_task(TaskStoreMetadata(
+        task_id=task_id, peer_id="p1", url="http://x/f",
+        piece_size=piece_size, content_length=content_length,
+        total_piece_count=math.ceil(content_length / piece_size)
+        if content_length >= 0 else -1))
+
+
+# --------------------------------------------------------------------- #
+# Schedule determinism
+# --------------------------------------------------------------------- #
+
+class TestSchedule:
+    SPEC = {"seed": 42, "rules": [
+        {"site": "piece.body", "kind": "corrupt", "rate": 0.3},
+        {"site": "piece.request", "kind": "refuse", "rate": 0.2,
+         "key_substr": "10.0.0.9"},
+        {"site": "rpc.recv", "kind": "drop", "at": [3]},
+    ]}
+
+    @staticmethod
+    def _drive(fabric):
+        out = []
+        # Interleave keys deliberately: determinism must hold per
+        # (site, key) stream, independent of global call order.
+        for n in range(40):
+            for key in ("10.0.0.1:80|t|%d" % (n % 5), "10.0.0.9:80|t|0"):
+                f = fabric.decide("piece.body" if n % 2 else "piece.request",
+                                  key)
+                out.append(f.kind if f else None)
+            out.append((lambda f: f.kind if f else None)(
+                fabric.decide("rpc.recv", "sched")))
+        return out
+
+    def test_same_seed_identical_schedule(self):
+        a = chaos_mod.parse_spec(dict(self.SPEC))
+        b = chaos_mod.parse_spec(dict(self.SPEC))
+        assert self._drive(a) == self._drive(b)
+        assert a.injected == b.injected
+
+    def test_interleaving_independent(self):
+        a = chaos_mod.parse_spec(dict(self.SPEC))
+        b = chaos_mod.parse_spec(dict(self.SPEC))
+        # Drive b's (site,key) streams in a shuffled global order: each
+        # stream's n-th decision must still match a's.
+        decisions_a = {}
+        for n in range(12):
+            f = a.decide("piece.body", "K1")
+            decisions_a.setdefault("K1", []).append(f.kind if f else None)
+            f = a.decide("piece.body", "K2")
+            decisions_a.setdefault("K2", []).append(f.kind if f else None)
+        decisions_b = {"K1": [], "K2": []}
+        for n in range(12):   # all of K2 first, then K1
+            f = b.decide("piece.body", "K2")
+            decisions_b["K2"].append(f.kind if f else None)
+        for n in range(12):
+            f = b.decide("piece.body", "K1")
+            decisions_b["K1"].append(f.kind if f else None)
+        assert decisions_a == decisions_b
+
+    def test_different_seed_differs(self):
+        a = chaos_mod.parse_spec(dict(self.SPEC))
+        other = dict(self.SPEC, seed=43)
+        b = chaos_mod.parse_spec(other)
+        assert self._drive(a) != self._drive(b)
+
+    def test_at_and_max_fires(self):
+        fabric = chaos_mod.parse_spec({"seed": 1, "rules": [
+            {"site": "s", "kind": "drop", "at": [2, 4], "max_fires": 1}]})
+        kinds = [fabric.decide("s", "k") for _ in range(5)]
+        assert [k.kind if k else None for k in kinds] == \
+            [None, "drop", None, None, None]   # max_fires caps the 2nd at
+
+
+# --------------------------------------------------------------------- #
+# Disabled by default: inert, unimported, hook-free
+# --------------------------------------------------------------------- #
+
+class TestDisabledByDefault:
+    def test_hooks_are_none_by_default(self):
+        from dragonfly2_tpu.daemon.peer import piece_downloader
+        from dragonfly2_tpu.rpc import client as rpc_client
+        from dragonfly2_tpu.rpc import framing as rpc_framing
+        from dragonfly2_tpu.source import client as source_client
+
+        for mod in (piece_downloader, rpc_client, rpc_framing,
+                    source_client):
+            assert mod._chaos is None, mod.__name__
+
+    def test_enable_disable_roundtrip(self):
+        from dragonfly2_tpu.daemon.peer import piece_downloader
+
+        fabric = chaos_mod.parse_spec({"seed": 0, "rules": []})
+        chaos_mod.enable(fabric)
+        assert piece_downloader._chaos is fabric
+        assert chaos_mod.enabled() is fabric
+        chaos_mod.disable()
+        assert piece_downloader._chaos is None
+        assert chaos_mod.enabled() is None
+
+    def test_piece_write_path_never_imports_chaos(self):
+        """The zero-overhead guard: importing the entire piece write path
+        (downloader, store, rpc, source registry, conductor) must not pull
+        in pkg.chaos — with the fabric off, no chaos symbol is reachable
+        from the hot path."""
+        code = (
+            "import sys\n"
+            "import dragonfly2_tpu.daemon.peer.conductor\n"
+            "import dragonfly2_tpu.daemon.peer.piece_downloader\n"
+            "import dragonfly2_tpu.daemon.peer.piece_manager\n"
+            "import dragonfly2_tpu.storage.local_store\n"
+            "import dragonfly2_tpu.rpc.client\n"
+            "import dragonfly2_tpu.source.client\n"
+            "assert 'dragonfly2_tpu.pkg.chaos' not in sys.modules, "
+            "'chaos leaked into the piece write path'\n"
+            "print('CLEAN')\n"
+        )
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert "CLEAN" in out.stdout
+
+    def test_env_arming_requires_env(self, monkeypatch):
+        monkeypatch.delenv(chaos_mod.ENV_VAR, raising=False)
+        assert chaos_mod.maybe_enable_from_env() is None
+        monkeypatch.setenv(chaos_mod.ENV_VAR,
+                           '{"seed": 5, "rules": []}')
+        fabric = chaos_mod.maybe_enable_from_env()
+        assert fabric is not None and fabric.seed == 5
+
+
+# --------------------------------------------------------------------- #
+# Per-fault injection through the real piece download path
+# --------------------------------------------------------------------- #
+
+async def _serve_piece(content: bytes):
+    """Minimal parent upload server: GET /download/... -> content."""
+    from aiohttp import web
+
+    async def handler(request):
+        return web.Response(body=content)
+
+    app = web.Application()
+    app.router.add_get("/download/{pre}/{tid}", handler)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    return runner, site._server.sockets[0].getsockname()[1]
+
+
+class TestPieceFaults:
+    CONTENT = b"abcd"
+    DIGEST = "crc32c:" + pkgdigest.hash_bytes("crc32c", b"abcd").encoded
+
+    def test_corrupt_trips_crc_and_quarantine(self, run_async, tmp_path):
+        from dragonfly2_tpu.daemon.peer.piece_dispatcher import PieceDispatcher
+        from dragonfly2_tpu.daemon.peer.piece_downloader import (
+            PieceDownloader,
+            failure_reason,
+        )
+
+        async def body():
+            runner, port = await _serve_piece(self.CONTENT)
+            store = make_store(tmp_path, content_length=4)
+            chaos_mod.enable(chaos_mod.parse_spec({"seed": 7, "rules": [
+                {"site": "piece.body", "kind": "corrupt", "rate": 1.0}]}))
+            dl = PieceDownloader()
+            try:
+                chunks, size, _cost, received = await dl.download_piece(
+                    "127.0.0.1", port, "chaos-t", 0, expected_size=4,
+                    expected_digest=self.DIGEST)
+                assert size == 4
+                got = b"".join(bytes(c) for c in chunks)
+                assert got != self.CONTENT     # the bit flip happened
+                with pytest.raises(StorageError) as ei:
+                    store.write_piece_chunks(
+                        0, chunks, received, expected_digest=self.DIGEST)
+                e = ei.value
+                assert e.code == Code.ClientPieceDownloadFail
+                assert failure_reason(e) == "corrupt"
+                # One corrupt strike quarantines the parent daemon-wide...
+                q = ParentQuarantine()
+                assert q.penalize(f"127.0.0.1:{port}", failure_reason(e))
+                # ...and the dispatcher stops selecting it.
+                d = PieceDispatcher(quarantine=q)
+                p = d.upsert_parent("bad", "127.0.0.1", port)
+                p.pieces.add(0)
+                d.total_piece_count = 1
+                assert d.active_parents() == []
+                assert not d.has_assignable()
+                assert "bad" in d.unusable_parent_ids()
+                # A clean write still lands after chaos is disarmed.
+                chaos_mod.disable()
+                chunks2, _s, _c, rec2 = await dl.download_piece(
+                    "127.0.0.1", port, "chaos-t", 0, expected_size=4,
+                    expected_digest=self.DIGEST)
+                rec = store.write_piece_chunks(
+                    0, chunks2, rec2, expected_digest=self.DIGEST)
+                assert rec.size == 4
+            finally:
+                await dl.close()
+                await runner.cleanup()
+
+        run_async(body(), timeout=60)
+
+    def test_truncate_rejected_as_truncated(self, run_async):
+        from dragonfly2_tpu.daemon.peer.piece_downloader import (
+            PieceDownloader,
+            failure_reason,
+        )
+
+        async def body():
+            runner, port = await _serve_piece(self.CONTENT)
+            chaos_mod.enable(chaos_mod.parse_spec({"seed": 3, "rules": [
+                {"site": "piece.body", "kind": "truncate", "rate": 1.0}]}))
+            dl = PieceDownloader()
+            try:
+                with pytest.raises(DfError) as ei:
+                    await dl.download_piece("127.0.0.1", port, "chaos-t", 0,
+                                            expected_size=4)
+                assert ei.value.code == Code.ClientPieceDownloadFail
+                assert failure_reason(ei.value) == "truncated"
+            finally:
+                await dl.close()
+                await runner.cleanup()
+
+        run_async(body(), timeout=60)
+
+    def test_stall_trips_watchdog_and_reschedules(self, run_async):
+        from dragonfly2_tpu.daemon.peer.piece_dispatcher import PieceDispatcher
+        from dragonfly2_tpu.daemon.peer.piece_downloader import (
+            PieceDownloader,
+            failure_reason,
+            is_parent_gone,
+        )
+
+        async def body():
+            runner, port = await _serve_piece(self.CONTENT)
+            chaos_mod.enable(chaos_mod.parse_spec({"seed": 9, "rules": [
+                {"site": "piece.body", "kind": "stall", "rate": 1.0,
+                 "stall_s": 5.0}]}))
+            dl = PieceDownloader(idle_timeout=0.2)
+            try:
+                with pytest.raises(DfError) as ei:
+                    await dl.download_piece("127.0.0.1", port, "chaos-t", 0,
+                                            expected_size=4)
+                e = ei.value
+                assert failure_reason(e) == "stall"
+                assert is_parent_gone(e)   # watchdog evicts, not retries
+                # The dispatcher reassigns the piece to the healthy holder.
+                d = PieceDispatcher()
+                stalled = d.upsert_parent("stalled", "127.0.0.1", port)
+                healthy = d.upsert_parent("healthy", "127.0.0.1", port + 1)
+                stalled.pieces.add(0)
+                healthy.pieces.add(0)
+                d.total_piece_count = 1
+                a = d.try_get()
+                d.report_failure(a, parent_gone=True)
+                b = d.try_get()
+                assert b is not None and b.parent is healthy
+            finally:
+                await dl.close()
+                await runner.cleanup()
+
+        run_async(body(), timeout=60)
+
+    def test_refuse_is_parent_gone(self, run_async):
+        from dragonfly2_tpu.daemon.peer.piece_downloader import (
+            PieceDownloader,
+            failure_reason,
+            is_parent_gone,
+        )
+
+        async def body():
+            chaos_mod.enable(chaos_mod.parse_spec({"seed": 2, "rules": [
+                {"site": "piece.request", "kind": "refuse", "rate": 1.0}]}))
+            dl = PieceDownloader()
+            try:
+                with pytest.raises(DfError) as ei:
+                    await dl.download_piece("127.0.0.1", 1, "chaos-t", 0,
+                                            expected_size=4)
+                assert failure_reason(ei.value) == "refused"
+                assert is_parent_gone(ei.value)
+            finally:
+                await dl.close()
+
+        run_async(body(), timeout=60)
+
+
+# --------------------------------------------------------------------- #
+# Announce-stream death mid-download: recovery + report flush
+# --------------------------------------------------------------------- #
+
+class FakeAnnounceStream:
+    def __init__(self, script=()):
+        self.sent: list[dict] = []
+        self._q: asyncio.Queue = asyncio.Queue()
+        for m in script:
+            self._q.put_nowait(m)
+        self.closed = False
+
+    async def send(self, body):
+        if self.closed:
+            raise DfError(Code.ClientConnectionError, "stream closed")
+        self.sent.append(body)
+
+    async def recv(self, timeout=None):
+        if self.closed:
+            return None
+        try:
+            return await asyncio.wait_for(self._q.get(), timeout or 5.0)
+        except asyncio.TimeoutError:
+            raise DfError(Code.RequestTimeout, "recv timeout")
+
+    async def close(self):
+        self.closed = True
+
+
+class FakeSchedulerClient:
+    """open_announce_stream pops scripted outcomes: an Exception instance
+    is raised, anything else is returned."""
+
+    def __init__(self, outcomes):
+        self.outcomes = list(outcomes)
+        self.opens = 0
+
+    async def open_announce_stream(self, open_body):
+        self.opens += 1
+        if not self.outcomes:
+            raise DfError(Code.ClientConnectionError, "no scheduler")
+        o = self.outcomes.pop(0)
+        if isinstance(o, Exception):
+            raise o
+        return o
+
+
+def _make_conductor(tmp_path, sched, quarantine=None):
+    from dragonfly2_tpu.daemon.peer.conductor import PeerTaskConductor
+    from dragonfly2_tpu.daemon.peer.piece_manager import PieceManager
+
+    store = make_store(tmp_path, content_length=8)
+    store.write_piece(0, b"aaaa")
+    store.write_piece(1, b"bbbb")
+    c = PeerTaskConductor(
+        task_id="chaos-t", peer_id="peer-1", url="http://x/f", store=store,
+        scheduler_client=sched, piece_manager=PieceManager(),
+        host_info={"id": "h1"}, quarantine=quarantine)
+    c._open_body = {"host": {"id": "h1"}, "peer_id": "peer-1",
+                    "task_id": "chaos-t", "url": "http://x/f"}
+    return c
+
+
+class TestAnnounceRecovery:
+    def test_reports_survive_dead_stream_and_flush_on_reconnect(
+            self, run_async, tmp_path, monkeypatch):
+        from dragonfly2_tpu.daemon.peer.conductor import PeerTaskConductor
+
+        monkeypatch.setattr(PeerTaskConductor, "RECONNECT_BUDGET", 3)
+        monkeypatch.setattr(retrylib, "ANNOUNCE",
+                            retrylib.BackoffPolicy(base=0.01, cap=0.02))
+
+        async def body():
+            fresh = FakeAnnounceStream([{  # register answer
+                "type": "normal_task",
+                "task": {"content_length": 8, "piece_size": 4,
+                         "total_piece_count": 2},
+                "parents": []}])
+            sched = FakeSchedulerClient(
+                [DfError(Code.ClientConnectionError, "down"), fresh])
+            c = _make_conductor(tmp_path, sched)
+            dead = FakeAnnounceStream()
+            dead.closed = True
+            c._stream = dead
+
+            # A report lands while the stream is dead: buffered, NOT lost.
+            rec = c.store.get_pieces()[0]
+            await c._report_piece(rec, parent_id="parent-x")
+            assert await c._flush_reports() is False
+            assert len(c._pending_reports) == 1
+
+            ok = await c._recover_announce_stream()
+            assert ok and c._stream is fresh
+            assert sched.opens == 2          # first open failed, second ok
+            assert fresh.sent[0] == {"type": "register"}
+            # The flush carried BOTH the buffered report and the full
+            # completed-piece re-report (idempotent at the scheduler).
+            reported = []
+            for m in fresh.sent[1:]:
+                if m["type"] == "piece_finished":
+                    reported.append(m["piece"]["piece_num"])
+                elif m["type"] == "pieces_finished":
+                    reported += [p["piece_num"] for p in m["pieces"]]
+            assert set(reported) == {0, 1}
+            assert not c._pending_reports
+            # The register answer was applied.
+            assert c.dispatcher.total_piece_count == 2
+
+        run_async(body(), timeout=30)
+
+    def test_budget_exhausted_degrades_to_back_source(
+            self, run_async, tmp_path, monkeypatch):
+        from dragonfly2_tpu.daemon.peer.conductor import PeerTaskConductor
+
+        monkeypatch.setattr(PeerTaskConductor, "RECONNECT_BUDGET", 2)
+        monkeypatch.setattr(retrylib, "ANNOUNCE",
+                            retrylib.BackoffPolicy(base=0.01, cap=0.02))
+
+        async def body():
+            sched = FakeSchedulerClient([])   # every open refused
+            c = _make_conductor(tmp_path, sched)
+            dead = FakeAnnounceStream()
+            dead.closed = True
+            c._stream = dead
+            c.dispatcher.upsert_parent("p2", "10.0.0.2", 80)
+            assert not await c._recover_announce_stream()
+            assert sched.opens == 2           # the budget, exactly
+            c._degrade_after_scheduler_loss()
+            assert c._need_back_source
+            assert c.dispatcher.parents["p2"].blocked
+
+        run_async(body(), timeout=30)
+
+    def test_schedule_failed_answer_stops_recovery(
+            self, run_async, tmp_path, monkeypatch):
+        monkeypatch.setattr(retrylib, "ANNOUNCE",
+                            retrylib.BackoffPolicy(base=0.01, cap=0.02))
+
+        async def body():
+            answer = FakeAnnounceStream([{"type": "schedule_failed",
+                                          "reason": "nope"}])
+            sched = FakeSchedulerClient([answer])
+            c = _make_conductor(tmp_path, sched)
+            dead = FakeAnnounceStream()
+            dead.closed = True
+            c._stream = dead
+            assert not await c._recover_announce_stream()
+            assert sched.opens == 1   # an ANSWER ends the loop, no retry
+
+        run_async(body(), timeout=30)
+
+    def test_teardown_blocks_recovery(self, run_async, tmp_path):
+        async def body():
+            sched = FakeSchedulerClient([FakeAnnounceStream()])
+            c = _make_conductor(tmp_path, sched)
+            c._announce_done = True
+            assert not await c._recover_announce_stream()
+            assert sched.opens == 0
+
+        run_async(body(), timeout=30)
+
+
+# --------------------------------------------------------------------- #
+# rpc client: reconnect backoff + chaos at the transport
+# --------------------------------------------------------------------- #
+
+class TestRpcBackoffAndChaos:
+    def test_connect_failure_arms_backoff(self, run_async):
+        from dragonfly2_tpu.pkg.types import NetAddr
+        from dragonfly2_tpu.rpc import Client
+
+        async def body():
+            cli = Client(NetAddr.tcp("127.0.0.1", 1), connect_timeout=0.2)
+            with pytest.raises(DfError):
+                await cli.call("X.Y", {}, timeout=1.0)
+            assert cli._connect_failures == 1
+            assert cli._next_connect_at > 0
+            with pytest.raises(DfError):
+                await cli.call("X.Y", {}, timeout=1.0)
+            assert cli._connect_failures == 2
+            await cli.close()
+
+        run_async(body(), timeout=30)
+
+    def test_backoff_delays_grow_and_cap(self):
+        p = retrylib.BackoffPolicy(base=0.05, cap=2.0, jitter=False)
+        delays = [p.raw_delay(i) for i in range(10)]
+        assert delays == sorted(delays)
+        assert delays[0] == pytest.approx(0.05)
+        assert delays[-1] == 2.0
+        # full jitter stays within [0, raw]
+        pj = retrylib.BackoffPolicy(base=0.05, cap=2.0)
+        for i in range(10):
+            d = pj.delay(i, rng=lambda: 0.5)
+            assert 0 <= d <= pj.raw_delay(i)
+            assert d == pytest.approx(pj.raw_delay(i) * 0.5)
+
+    def test_chaos_drop_kills_connection_then_recovers(self, run_async):
+        from dragonfly2_tpu.pkg.types import NetAddr
+        from dragonfly2_tpu.rpc import Client, Server
+
+        async def body():
+            server = Server("t")
+
+            async def ping(body, ctx):
+                return {"pong": True}
+
+            server.register_unary("T.Ping", ping)
+            await server.serve(NetAddr.tcp("127.0.0.1", 0))
+            port = server.port()
+            cli = Client(NetAddr.tcp("127.0.0.1", port))
+            # Drop the FIRST frame read on this connection: the call fails
+            # with a connection error (scheduler-crash simulation)...
+            chaos_mod.enable(chaos_mod.parse_spec({"seed": 1, "rules": [
+                {"site": "rpc.recv", "kind": "drop", "at": [1],
+                 "key_substr": f"127.0.0.1:{port}"}]}))
+            with pytest.raises(DfError) as ei:
+                await cli.call("T.Ping", {}, timeout=2.0)
+            assert ei.value.code == Code.ClientConnectionError
+            # ...and the next use reconnects (paced by backoff) and works.
+            resp = await cli.call("T.Ping", {}, timeout=5.0)
+            assert resp == {"pong": True}
+            await cli.close()
+            await server.close()
+
+        run_async(body(), timeout=30)
+
+    def test_chaos_connect_refusal(self, run_async):
+        from dragonfly2_tpu.pkg.types import NetAddr
+        from dragonfly2_tpu.rpc import Client, Server
+
+        async def body():
+            server = Server("t")
+            server.register_unary("T.Ping", lambda b, c: asyncio.sleep(0))
+            await server.serve(NetAddr.tcp("127.0.0.1", 0))
+            cli = Client(NetAddr.tcp("127.0.0.1", server.port()))
+            chaos_mod.enable(chaos_mod.parse_spec({"seed": 1, "rules": [
+                {"site": "rpc.connect", "kind": "refuse", "max_fires": 1,
+                 "rate": 1.0}]}))
+            with pytest.raises(DfError) as ei:
+                await cli.call("T.Ping", {}, timeout=2.0)
+            assert ei.value.code == Code.ClientConnectionError
+            assert cli._connect_failures == 1   # chaos refusal arms backoff
+            await cli.close()
+            await server.close()
+
+        run_async(body(), timeout=30)
+
+
+# --------------------------------------------------------------------- #
+# Source clients: temporary classification + chaos source sites
+# --------------------------------------------------------------------- #
+
+class TestSourceClassification:
+    def test_permanent_4xx_not_temporary(self):
+        from dragonfly2_tpu.source.clients.http import _status_error
+
+        for status, code in ((403, Code.SourceForbidden),
+                             (404, Code.SourceNotFound),
+                             (416, Code.SourceRangeUnsupported)):
+            e = _status_error(status, "http://o/f")
+            assert e.code == code and not e.temporary, status
+        for status in (408, 429, 500, 502, 503, 504, 599):
+            assert _status_error(status, "http://o/f").temporary, status
+        assert not _status_error(400, "http://o/f").temporary
+
+    def test_client_response_error_maps_status(self):
+        import aiohttp
+
+        from dragonfly2_tpu.source.clients.http import _client_error
+
+        e404 = aiohttp.ClientResponseError(request_info=None, history=(),
+                                           status=404)
+        mapped = _client_error(e404, "http://o/f", "connect")
+        assert mapped.code == Code.SourceNotFound and not mapped.temporary
+        e503 = aiohttp.ClientResponseError(request_info=None, history=(),
+                                           status=503)
+        assert _client_error(e503, "http://o/f", "connect").temporary
+        conn = aiohttp.ClientConnectionError("refused")
+        assert _client_error(conn, "http://o/f", "connect").temporary
+
+    def test_s3_permanent_errors_not_temporary(self):
+        from dragonfly2_tpu.pkg.objectstorage.base import ObjectStorageError
+        from dragonfly2_tpu.source.clients.s3 import S3SourceClient
+
+        cli = S3SourceClient.__new__(S3SourceClient)
+        stat = cli._stat_error(ObjectStorageError("HTTP 403", status=403),
+                               "s3://b/k")
+        assert stat.code == Code.SourceForbidden and not stat.temporary
+        assert cli._stat_error(ObjectStorageError("HTTP 404", status=404),
+                               "s3://b/k").code == Code.SourceNotFound
+        assert cli._stat_error(ObjectStorageError("reset"),
+                               "s3://b/k").temporary
+        assert cli._stat_error(ObjectStorageError("HTTP 503", status=503),
+                               "s3://b/k").temporary
+
+    def test_origin_5xx_burst_retried_then_succeeds(self, run_async,
+                                                    tmp_path, monkeypatch):
+        """source.request http5xx burst (2 fires) + the policy-driven
+        origin retry: the third attempt lands the content."""
+        from aiohttp import web
+
+        from dragonfly2_tpu.daemon.peer.piece_manager import (
+            PieceManager,
+            PieceManagerOption,
+        )
+
+        monkeypatch.setattr(retrylib, "SOURCE",
+                            retrylib.BackoffPolicy(base=0.01, cap=0.02))
+        content = b"x" * 64
+
+        async def body():
+            async def blob(request):
+                return web.Response(body=content)
+
+            app = web.Application()
+            app.router.add_get("/blob", blob)
+            runner = web.AppRunner(app, access_log=None)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            url = f"http://127.0.0.1:{port}/blob"
+
+            chaos_mod.enable(chaos_mod.parse_spec({"seed": 11, "rules": [
+                {"site": "source.request", "kind": "http5xx", "rate": 1.0,
+                 "max_fires": 2}]}))
+            store = make_store(tmp_path, task_id="src-t", piece_size=32,
+                               content_length=-1)
+            pm = PieceManager(PieceManagerOption(origin_attempts=3))
+            try:
+                await pm.download_source(store, url)
+                assert store.is_complete()
+                fabric = chaos_mod.enabled()
+                assert fabric.injected_by_kind().get("http5xx") == 2
+            finally:
+                await runner.cleanup()
+
+        run_async(body(), timeout=60)
+
+    def test_permanent_origin_error_fails_without_retry(self, run_async,
+                                                        tmp_path,
+                                                        monkeypatch):
+        """A 404 origin must fail the back-source on the FIRST attempt —
+        the retry budget is for temporary trouble only."""
+        from aiohttp import web
+
+        from dragonfly2_tpu.daemon.peer.piece_manager import (
+            PieceManager,
+            PieceManagerOption,
+        )
+
+        monkeypatch.setattr(retrylib, "SOURCE",
+                            retrylib.BackoffPolicy(base=0.01, cap=0.02))
+
+        async def body():
+            hits = {"n": 0}
+
+            async def blob(request):
+                hits["n"] += 1
+                return web.Response(status=404)
+
+            app = web.Application()
+            app.router.add_get("/blob", blob)
+            runner = web.AppRunner(app, access_log=None)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            store = make_store(tmp_path, task_id="src-404",
+                               content_length=-1, piece_size=32)
+            pm = PieceManager(PieceManagerOption(origin_attempts=3))
+            try:
+                with pytest.raises(SourceError) as ei:
+                    await pm.download_source(
+                        store, f"http://127.0.0.1:{port}/blob")
+                assert ei.value.code == Code.SourceNotFound
+                # probe + the one download attempt — NOT 3 retries
+                assert hits["n"] <= 2
+            finally:
+                await runner.cleanup()
+
+        run_async(body(), timeout=60)
+
+
+# --------------------------------------------------------------------- #
+# Quarantine semantics
+# --------------------------------------------------------------------- #
+
+class TestQuarantine:
+    def test_corrupt_tips_in_one_strike_and_decays(self):
+        t = {"now": 0.0}
+        q = ParentQuarantine(clock=lambda: t["now"])
+        assert q.penalize("1.2.3.4:80", "corrupt")
+        assert q.is_quarantined("1.2.3.4:80")
+        t["now"] += q.quarantine_s + q.half_life_s * 8
+        assert not q.is_quarantined("1.2.3.4:80")
+        assert q.score("1.2.3.4:80") < 0.05
+
+    def test_transport_needs_repeats_throttle_never(self):
+        t = {"now": 0.0}
+        q = ParentQuarantine(clock=lambda: t["now"])
+        assert not q.penalize("k", "transport")
+        assert not q.penalize("k", "transport")
+        assert q.penalize("k", "transport")       # 3rd strike tips
+        for _ in range(50):
+            assert not q.penalize("throttled", "throttle")
+        assert not q.is_quarantined("throttled")
+
+    def test_decay_between_strikes_forgives(self):
+        t = {"now": 0.0}
+        q = ParentQuarantine(clock=lambda: t["now"])
+        for _ in range(10):
+            assert not q.penalize("slowburn", "transport")
+            t["now"] += q.half_life_s * 6   # fully decayed between strikes
+
+    def test_reenter_reports_edge_once(self):
+        t = {"now": 0.0}
+        q = ParentQuarantine(clock=lambda: t["now"])
+        assert q.penalize("k", "corrupt") is True    # entered
+        assert q.penalize("k", "corrupt") is False   # already in
+        t["now"] += q.quarantine_s + q.half_life_s * 10
+        assert q.penalize("k", "corrupt") is True    # entered again
+
+
+# --------------------------------------------------------------------- #
+# Scheduler-side typed demotion
+# --------------------------------------------------------------------- #
+
+class TestSchedulerDemotion:
+    def _svc(self):
+        from dragonfly2_tpu.scheduler.config import SchedulerConfig
+        from dragonfly2_tpu.scheduler.service import SchedulerService
+
+        cfg = SchedulerConfig()
+        cfg.scheduling.retry_interval = 0.02
+        cfg.seed_peer_enabled = False
+        return SchedulerService(cfg)
+
+    def test_corrupt_report_quarantines_host_for_everyone(self, run_async):
+        from tests.test_stripe import FakeStream, _serve
+
+        async def body():
+            svc = self._svc()
+            # A parent that "completed" the task.
+            parent = FakeStream({
+                "host": {"id": "host-p", "hostname": "host-p",
+                         "ip": "10.0.0.1", "port": 8001,
+                         "upload_port": 9001},
+                "peer_id": "peer-parent", "task_id": "q-task",
+                "url": "http://o/f"})
+            asyncio.ensure_future(_serve(svc, parent))
+            await parent.to_sched.put({"type": "register"})
+            msg = await asyncio.wait_for(parent.to_peer.get(), 10)
+            assert msg["type"] == "need_back_source"
+            await parent.to_sched.put({
+                "type": "download_started", "content_length": 8,
+                "piece_size": 4, "total_piece_count": 2})
+            for n in range(2):
+                await parent.to_sched.put({
+                    "type": "piece_finished",
+                    "piece": {"piece_num": n, "range_start": n * 4,
+                              "range_size": 4, "digest": "",
+                              "download_cost_ms": 1, "dst_peer_id": ""}})
+            await parent.to_sched.put({
+                "type": "download_finished", "content_length": 8,
+                "piece_size": 4, "total_piece_count": 2})
+            await asyncio.sleep(0.05)
+
+            # A child registers, is handed the parent, reports corruption.
+            child = FakeStream({
+                "host": {"id": "host-c", "hostname": "host-c",
+                         "ip": "10.0.0.2", "port": 8002,
+                         "upload_port": 9002},
+                "peer_id": "peer-child", "task_id": "q-task",
+                "url": "http://o/f"})
+            asyncio.ensure_future(_serve(svc, child))
+            await child.to_sched.put({"type": "register"})
+            handed = await asyncio.wait_for(child.to_peer.get(), 10)
+            assert handed["type"] in ("normal_task", "small_task"), handed
+            await child.to_sched.put({
+                "type": "piece_failed", "piece_num": 0,
+                "parent_id": "peer-parent", "temporary": False,
+                "reason": "corrupt"})
+            await asyncio.sleep(0.05)
+
+            parent_peer = svc.peers.load("peer-parent")
+            assert parent_peer.host.quarantined()
+            # Demoted for EVERY peer, not just the reporter: the child's
+            # candidate search no longer returns it.
+            child_peer = svc.peers.load("peer-child")
+            assert all(
+                p.id != "peer-parent"
+                for p in svc.scheduling.find_candidate_parents(child_peer))
+            await parent.to_sched.put(None)
+            await child.to_sched.put(None)
+
+        run_async(body(), timeout=30)
+
+    def test_throttle_report_does_not_quarantine(self, run_async):
+        from dragonfly2_tpu.scheduler.resource.host import Host
+
+        async def body():
+            h = Host("h1")
+            for _ in range(20):
+                assert not h.note_served_bad("throttle")
+            assert not h.quarantined()
+            assert h.note_served_bad("corrupt")
+            assert h.quarantined()
+
+        run_async(body(), timeout=10)
+
+    def test_failed_peer_reregisters_fresh(self, run_async):
+        """Announce-stream recovery: the SAME peer id re-registering after
+        its stream dropped (peer FAILED) gets a fresh record instead of a
+        TransitionError."""
+        from tests.test_stripe import FakeStream, _serve
+
+        async def body():
+            svc = self._svc()
+            body1 = {
+                "host": {"id": "host-r", "hostname": "host-r",
+                         "ip": "10.0.0.3", "port": 8003,
+                         "upload_port": 9003},
+                "peer_id": "peer-re", "task_id": "re-task",
+                "url": "http://o/f"}
+            s1 = FakeStream(body1)
+            t1 = asyncio.ensure_future(_serve(svc, s1))
+            await s1.to_sched.put({"type": "register"})
+            await asyncio.wait_for(s1.to_peer.get(), 10)
+            await s1.to_sched.put(None)     # stream dies mid-task
+            await asyncio.wait_for(t1, 10)
+            from dragonfly2_tpu.scheduler.resource import PeerState
+
+            assert svc.peers.load("peer-re").fsm.current == PeerState.FAILED
+
+            s2 = FakeStream(dict(body1))
+            asyncio.ensure_future(_serve(svc, s2))
+            await s2.to_sched.put({"type": "register"})
+            msg = await asyncio.wait_for(s2.to_peer.get(), 10)
+            assert msg["type"] in ("normal_task", "need_back_source",
+                                   "schedule_failed")
+            fresh = svc.peers.load("peer-re")
+            assert fresh.fsm.current != PeerState.FAILED
+            await s2.to_sched.put(None)
+
+        run_async(body(), timeout=30)
+
+
+class TestWireSchema:
+    def test_piece_failed_reason_field(self):
+        from dragonfly2_tpu.proto import wire
+
+        wire.validate_stream_msg("Scheduler.AnnouncePeer", {
+            "type": "piece_failed", "piece_num": 1, "parent_id": "p",
+            "temporary": False, "reason": "corrupt"})
+        with pytest.raises(wire.SchemaError, match="reason"):
+            wire.validate_stream_msg("Scheduler.AnnouncePeer", {
+                "type": "piece_failed", "piece_num": 1, "reason": 7})
+
+
+# --------------------------------------------------------------------- #
+# Seeded pod e2e: 25% parent death + corruption; all-parents-die →
+# back-to-source convergence
+# --------------------------------------------------------------------- #
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestChaosPodE2E:
+    def test_pod_survives_parent_death_and_corruption(self, run_async,
+                                                      tmp_path):
+        import random
+
+        from tests.test_p2p_e2e import daemon_config, start_scheduler
+        from aiohttp import web
+
+        from dragonfly2_tpu.client import dfget as dfget_lib
+        from dragonfly2_tpu.daemon.daemon import Daemon
+        from dragonfly2_tpu.proto.common import UrlMeta
+
+        content = bytes(random.Random(1234).randbytes(12 * 1024 * 1024))
+        sha = "sha256:" + hashlib.sha256(content).hexdigest()
+
+        async def body():
+            from dragonfly2_tpu.pkg.piece import Range
+
+            async def blob(request):
+                rng = request.headers.get("Range")
+                if rng:
+                    r = Range.parse_http(rng, len(content))
+                    return web.Response(
+                        status=206,
+                        body=content[r.start:r.start + r.length],
+                        headers={"Content-Range":
+                                 f"bytes {r.start}-{r.start + r.length - 1}"
+                                 f"/{len(content)}",
+                                 "Accept-Ranges": "bytes"})
+                return web.Response(body=content,
+                                    headers={"Accept-Ranges": "bytes"})
+
+            app = web.Application()
+            app.router.add_get("/blob", blob)
+            runner = web.AppRunner(app, access_log=None)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            oport = site._server.sockets[0].getsockname()[1]
+            sched = await start_scheduler()
+            url = f"http://127.0.0.1:{oport}/blob"
+            daemons = []
+            try:
+                seed = Daemon(daemon_config(tmp_path, "seed", sched.port(),
+                                            seed=True))
+                await seed.start()
+                daemons.append(seed)
+                peers = []
+                for i in range(4):
+                    d = Daemon(daemon_config(tmp_path, f"peer{i}",
+                                             sched.port()))
+                    await d.start()
+                    daemons.append(d)
+                    peers.append(d)
+
+                # Seeded schedule: peer0's upload endpoint dies (25% of
+                # the 4-host pod's parents) + two corrupt piece bodies
+                # anywhere in the swarm.
+                victim = f"127.0.0.1:{peers[0].upload.port}"
+                fabric = chaos_mod.enable(chaos_mod.parse_spec({
+                    "seed": 77, "rules": [
+                        {"site": "piece.request", "kind": "refuse",
+                         "rate": 1.0, "key_substr": victim},
+                        {"site": "piece.body", "kind": "corrupt",
+                         "at": [1], "max_fires": 2},
+                    ]}))
+
+                async def pull(i):
+                    return await dfget_lib.download(dfget_lib.DfgetConfig(
+                        url=url, output=str(tmp_path / f"out{i}.bin"),
+                        daemon_sock=peers[i].config.unix_sock,
+                        meta=UrlMeta(digest=sha),
+                        allow_source_fallback=False, timeout=180.0))
+
+                results = await asyncio.gather(*[pull(i) for i in range(4)])
+                for i, r in enumerate(results):
+                    assert r["state"] == "done", (i, r)
+                    data = (tmp_path / f"out{i}.bin").read_bytes()
+                    # Byte-identical completion despite the faults.
+                    assert hashlib.sha256(data).hexdigest() == sha[7:], i
+
+                # The schedule actually injected, and the typed reason
+                # metrics saw the recoveries.
+                by_kind = fabric.injected_by_kind()
+                assert by_kind.get("corrupt", 0) == 2, by_kind
+                from dragonfly2_tpu.pkg import metrics as metrics_mod
+
+                text = metrics_mod.render()[0].decode()
+                reasons = metrics_mod.parse_labeled_samples(
+                    text, "dragonfly_tpu_peer_piece_failures_total",
+                    "reason")
+                assert reasons.get("corrupt", 0) >= 2, reasons
+            finally:
+                chaos_mod.disable()
+                for d in daemons:
+                    await d.stop()
+                await sched.stop()
+                await runner.cleanup()
+
+        run_async(body(), timeout=300)
+
+    def test_all_parents_dead_converges_to_back_source(self, run_async,
+                                                       tmp_path):
+        import random
+
+        from tests.test_p2p_e2e import daemon_config, start_scheduler
+        from aiohttp import web
+
+        from dragonfly2_tpu.client import dfget as dfget_lib
+        from dragonfly2_tpu.daemon.daemon import Daemon
+        from dragonfly2_tpu.proto.common import UrlMeta
+
+        content = bytes(random.Random(99).randbytes(4 * 1024 * 1024))
+        sha = "sha256:" + hashlib.sha256(content).hexdigest()
+
+        async def body():
+            streams = {"n": 0}
+
+            async def blob(request):
+                streams["n"] += 1
+                return web.Response(body=content,
+                                    headers={"Accept-Ranges": "bytes"})
+
+            app = web.Application()
+            app.router.add_get("/blob", blob)
+            runner = web.AppRunner(app, access_log=None)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            oport = site._server.sockets[0].getsockname()[1]
+            sched = await start_scheduler()
+            url = f"http://127.0.0.1:{oport}/blob"
+            daemons = []
+            try:
+                seed = Daemon(daemon_config(tmp_path, "seed", sched.port(),
+                                            seed=True))
+                await seed.start()
+                daemons.append(seed)
+                peers = []
+                for i in range(2):
+                    d = Daemon(daemon_config(tmp_path, f"bpeer{i}",
+                                             sched.port()))
+                    await d.start()
+                    daemons.append(d)
+                    peers.append(d)
+
+                # EVERY parent upload endpoint refuses: P2P is dead; the
+                # pod must converge to per-peer back-to-source.
+                chaos_mod.enable(chaos_mod.parse_spec({
+                    "seed": 5, "rules": [
+                        {"site": "piece.request", "kind": "refuse",
+                         "rate": 1.0}]}))
+
+                async def pull(i):
+                    return await dfget_lib.download(dfget_lib.DfgetConfig(
+                        url=url, output=str(tmp_path / f"bout{i}.bin"),
+                        daemon_sock=peers[i].config.unix_sock,
+                        meta=UrlMeta(digest=sha),
+                        allow_source_fallback=False, timeout=180.0))
+
+                results = await asyncio.gather(pull(0), pull(1))
+                for i, r in enumerate(results):
+                    assert r["state"] == "done", (i, r)
+                    data = (tmp_path / f"bout{i}.bin").read_bytes()
+                    assert hashlib.sha256(data).hexdigest() == sha[7:], i
+                # Origin served the peers directly (seed's fetch + the two
+                # demoted peers): more than one full-content stream.
+                assert streams["n"] >= 3, streams
+            finally:
+                chaos_mod.disable()
+                for d in daemons:
+                    await d.stop()
+                await sched.stop()
+                await runner.cleanup()
+
+        run_async(body(), timeout=300)
