@@ -40,6 +40,10 @@ def _add_dfget(sub: argparse._SubParsersAction) -> None:
                    help="register as a striped slice broadcast: each "
                         "same-slice host DCN-pulls 1/S of the pieces and "
                         "the slice completes the copy internally")
+    p.add_argument("--explain", action="store_true",
+                   help="after the download, print the flight recorder's "
+                        "critical-path autopsy (phase breakdown + per-piece "
+                        "waterfall) — where the wall time went")
     p.add_argument("--recursive", action="store_true")
     p.add_argument("--level", type=int, default=5, help="recursion depth")
     p.add_argument("--timeout", type=float, default=0.0)
@@ -78,6 +82,7 @@ def _run_dfget(args: argparse.Namespace) -> int:
         timeout=args.timeout,
         device=args.device,
         pod_broadcast=args.pod_broadcast,
+        explain=args.explain,
     )
     if not args.output and args.device != "tpu":
         sys.stderr.write("dfget: error: -O/--output is required "
@@ -121,6 +126,9 @@ def _run_dfget(args: argparse.Namespace) -> int:
             + (f" device_verified={result.get('device_verified', False)}"
                if cfg.device else "") + "\n"
         )
+        flight_info = result.get("flight") or {}
+        if args.explain and flight_info.get("text"):
+            sys.stderr.write(flight_info["text"] + "\n")
         return 0
 
     try:

@@ -36,6 +36,10 @@ class DfgetConfig:
     # host's TPU slice — each pulls 1/S of the bytes over DCN and the
     # slice completes the copy internally.
     pod_broadcast: bool = False
+    # Flight-recorder autopsy: after the download, fetch the daemon's
+    # phase breakdown + per-piece waterfall (Daemon.FlightReport) and
+    # attach it to the result as ``flight`` ({report, text}).
+    explain: bool = False
 
 
 async def download(cfg: DfgetConfig, on_progress: Callable[[dict], None] | None = None) -> dict:
@@ -90,6 +94,15 @@ async def _daemon_download(cfg: DfgetConfig, on_progress) -> dict:
             raise DfError(Code.UnknownError, "daemon closed stream without a result")
         if final["state"] == "failed":
             raise DfError.from_wire(final.get("error") or {})
+        if cfg.explain and final.get("task_id"):
+            try:
+                final["flight"] = await cli.call(
+                    "Daemon.FlightReport", {"task_id": final["task_id"]},
+                    timeout=10.0)
+            except DfError as e:
+                # The autopsy is advisory: a recorder miss (evicted task,
+                # old daemon) must not fail a completed download.
+                log.warning("flight report unavailable", error=str(e))
         return final
     finally:
         await cli.close()

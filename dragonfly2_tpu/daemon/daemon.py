@@ -289,12 +289,19 @@ class Daemon:
         if self.config.manager_addr:
             await self._resolve_schedulers_from_manager()
         self.task_manager.shaper.serve()
+        # Flight recorder: post-mortem bundles land next to the logs so a
+        # failed task's autopsy survives the process (pkg/flight).
+        from dragonfly2_tpu.pkg import flight as flightlib
+
+        recorder = flightlib.recorder()
+        if not recorder.dump_dir:
+            recorder.dump_dir = self.config.dfpath.log_dir
         if self.config.metrics_port >= 0:
             from dragonfly2_tpu.pkg.metrics_server import MetricsServer
 
             # Loopback by default: /debug exposes live stacks; operators
             # who want network scraping front it deliberately.
-            self.metrics = MetricsServer()
+            self.metrics = MetricsServer(flight=recorder)
             await self.metrics.serve("127.0.0.1", self.config.metrics_port)
         await self.rpc.serve_download(NetAddr.unix(self.config.unix_sock))
         if self.config.download.peer_port >= 0:  # -1 disables the peer service

@@ -31,7 +31,7 @@ from aiohttp import web
 
 from dragonfly2_tpu.daemon.transport import P2PTransport
 from dragonfly2_tpu.daemon.upload import _PieceFileResponse
-from dragonfly2_tpu.pkg import dflog, idgen, metrics
+from dragonfly2_tpu.pkg import dflog, idgen, metrics, tracing
 from dragonfly2_tpu.pkg import digest as pkgdigest
 from dragonfly2_tpu.pkg.errors import DfError
 from dragonfly2_tpu.pkg.objectstorage import ObjectStorage, ObjectStorageError
@@ -312,6 +312,16 @@ class ObjectStorageService:
     async def _get_object(self, request: web.Request) -> web.StreamResponse:
         """GET via the P2P fabric (reference :253 getObject → stream task)."""
         bucket, key = request.match_info["bucket"], request.match_info["key"]
+        # Adopt the caller's trace context (dataset-plane readers and
+        # other gateways inject it): the gateway hop joins the task's
+        # trace instead of starting a disconnected one.
+        tp = request.headers.get(tracing.TRACEPARENT, "")
+        with tracing.extract({tracing.TRACEPARENT: tp} if tp else None,
+                             "gateway.get_object", bucket=bucket):
+            return await self._get_object_inner(request, bucket, key)
+
+    async def _get_object_inner(self, request: web.Request, bucket: str,
+                                key: str) -> web.StreamResponse:
         url = self.backend.object_url(bucket, key)
         headers = {"X-Dragonfly-Tag": bucket}
         rng_header = request.headers.get("Range", "")

@@ -33,6 +33,7 @@ from dragonfly2_tpu.daemon.peer.piece_downloader import (
 from dragonfly2_tpu.daemon.peer.piece_manager import PieceManager
 from dragonfly2_tpu.daemon.peer.synchronizer import PieceTaskSynchronizer
 from dragonfly2_tpu.pkg import dflog, metrics
+from dragonfly2_tpu.pkg import flight as flightlib
 from dragonfly2_tpu.pkg import retry as retrylib
 from dragonfly2_tpu.pkg.errors import Code, DfError
 from dragonfly2_tpu.pkg.piece import PieceInfo, Range, compute_piece_count
@@ -122,7 +123,12 @@ class PeerTaskConductor:
         # Daemon-wide bad-parent quarantine (pkg/quarantine), shared across
         # conductors via the task manager; None = no quarantine filter.
         self.quarantine = quarantine
-        self.dispatcher = PieceDispatcher(quarantine=quarantine)
+        # Flight recorder: this task's bounded event ring (pkg/flight) —
+        # every choke point below stamps it so /debug/flight can autopsy
+        # the download after the fact.
+        self.flight = flightlib.for_task(task_id)
+        self.dispatcher = PieceDispatcher(quarantine=quarantine,
+                                          flight=self.flight)
         self.downloader = PieceDownloader()
         self.synchronizer: PieceTaskSynchronizer | None = None
         # Striped slice broadcast: this host's ICI domain, and the bytes
@@ -186,6 +192,7 @@ class PeerTaskConductor:
         # dispatch below.
         msg = None
         register_error = "scheduler closed stream at register"
+        self.flight.record(flightlib.EV_REGISTER)
         try:
             self._stream = await self.scheduler_client.open_announce_stream(
                 open_body)
@@ -197,6 +204,7 @@ class PeerTaskConductor:
                 raise
             register_error = str(e)
         if msg is None:
+            self.flight.record(flightlib.EV_SCHEDULED, -1, 0.0, "unavailable")
             if not self.disable_back_source:
                 log.warning("scheduler unavailable at register; "
                             "degrading to back-to-source",
@@ -210,6 +218,8 @@ class PeerTaskConductor:
             finally:
                 await self._teardown()
             return
+        self.flight.record(flightlib.EV_SCHEDULED, -1, 0.0,
+                           str(msg.get("type", "")))
         try:
             await self._dispatch_schedule(msg)
         except BaseException:
@@ -282,6 +292,8 @@ class PeerTaskConductor:
                 rec = self.store.write_piece_chunks(
                     piece.piece_num, chunks, received_digest,
                     expected_digest=piece.digest, cost_ms=cost_ms)
+                self.flight.record(flightlib.EV_LANDED, piece.piece_num,
+                                   float(cost_ms))
                 await self._report_piece(rec, parent_id=parent.get("id", ""))
                 if self.on_piece is not None:
                     await self.on_piece(self.store, rec)
@@ -312,6 +324,7 @@ class PeerTaskConductor:
     # -- back-to-source (reference backSource :503) ------------------------
 
     async def _back_source(self) -> None:
+        self.flight.record(flightlib.EV_BACK_SOURCE)
         # Announce-only fast path: content already complete locally (seed
         # re-announce after a scheduler restart) — report pieces, no origin.
         if self.store.metadata.done and self.store.is_complete():
@@ -496,6 +509,8 @@ class PeerTaskConductor:
         marked same_slice, so non-stripe pieces fill intra-slice while the
         conductor DCN-fetches only its own stripe."""
         if stripe and int(stripe.get("slice_size", 0)) >= 2:
+            self.flight.record(flightlib.EV_STRIPE, -1,
+                               float(stripe["slice_size"]), "applied")
             self.dispatcher.set_stripe(int(stripe["slice_size"]),
                                        int(stripe.get("slice_rank", -1)))
             mates = stripe.get("mates") or []
@@ -505,6 +520,8 @@ class PeerTaskConductor:
                      slice_size=stripe["slice_size"],
                      slice_rank=stripe.get("slice_rank"), mates=len(mates))
         else:
+            if self.dispatcher.stripe is not None:
+                self.flight.record(flightlib.EV_STRIPE, -1, 0.0, "cleared")
             self.dispatcher.clear_stripe()
 
     def _note_piece_failure(self, parent, err: DfError) -> str:
@@ -516,6 +533,9 @@ class PeerTaskConductor:
         if self.quarantine is not None:
             if self.quarantine.penalize(parent_key(parent), reason):
                 PARENT_QUARANTINE_COUNT.labels(reason).inc()
+                self.flight.record(
+                    flightlib.EV_QUARANTINE, -1, 0.0,
+                    f"{parent_key(parent)}|{reason}")
                 log.warning("parent quarantined",
                             parent=parent.peer_id[:24],
                             endpoint=parent_key(parent), reason=reason,
@@ -523,15 +543,17 @@ class PeerTaskConductor:
                 self.dispatcher._wakeup.set()
         return reason
 
+    def _parent_locality(self, parent) -> str:
+        if not self.own_slice or not parent.tpu_slice:
+            return "unlabeled"
+        if parent.same_slice or parent.tpu_slice == self.own_slice:
+            return "intra"
+        return "cross"
+
     def _note_piece_bytes(self, parent, size: int) -> None:
         if size <= 0:
             return
-        if not self.own_slice or not parent.tpu_slice:
-            key = "unlabeled"
-        elif parent.same_slice or parent.tpu_slice == self.own_slice:
-            key = "intra"
-        else:
-            key = "cross"
+        key = self._parent_locality(parent)
         self.locality_bytes[key] += size
         PIECE_BYTES.labels(key).inc(size)
 
@@ -584,6 +606,8 @@ class PeerTaskConductor:
                     self._degrade_after_scheduler_loss()
                     return
                 kind = msg.get("type")
+                self.flight.record(flightlib.EV_SCHED_PUSH, -1, 0.0,
+                                   str(kind))
                 if kind == "normal_task":
                     self._apply_task_meta(msg.get("task") or {})
                     if self.synchronizer is not None:
@@ -651,6 +675,8 @@ class PeerTaskConductor:
                     msg = await stream.recv(timeout=30.0)
                 except DfError as e:
                     ANNOUNCE_RECONNECT_COUNT.labels("retry").inc()
+                    self.flight.record(flightlib.EV_RECONNECT, -1, 0.0,
+                                       "retry")
                     log.warning("announce reconnect failed",
                                 task=self.task_id[:16], attempt=attempt,
                                 error=str(e))
@@ -697,11 +723,13 @@ class PeerTaskConductor:
                     })
                 await self._flush_reports()
                 ANNOUNCE_RECONNECT_COUNT.labels("ok").inc()
+                self.flight.record(flightlib.EV_RECONNECT, -1, 0.0, "ok")
                 log.info("announce stream recovered",
                          task=self.task_id[:16], attempt=attempt,
                          reconnects=self._stream_reconnects)
                 return True
             ANNOUNCE_RECONNECT_COUNT.labels("exhausted").inc()
+            self.flight.record(flightlib.EV_RECONNECT, -1, 0.0, "exhausted")
             return False
 
     # Coalescing bound: one ranged GET covers up to this many contiguous
@@ -748,6 +776,9 @@ class PeerTaskConductor:
                 self.dispatcher.report_success(a, rec.cost_ms)
                 PIECE_DOWNLOAD_COUNT.labels("ok").inc()
                 self._note_piece_bytes(p, rec.size)
+                self.flight.record(flightlib.EV_LANDED, a.piece_num,
+                                   float(rec.cost_ms),
+                                   self._parent_locality(p))
                 await self._report_piece(rec, parent_id=p.peer_id)
                 if self.on_piece is not None:
                     await self.on_piece(self.store, rec)
@@ -767,6 +798,8 @@ class PeerTaskConductor:
                     penalized.append(err)
                     self.dispatcher.report_failure(a, parent_gone=gone)
                     reason = self._note_piece_failure(p, err)
+                self.flight.record(flightlib.EV_FAILED, a.piece_num, 0.0,
+                                   reason)
                 await self._safe_send({
                     "type": "piece_failed",
                     "piece_num": a.piece_num,
@@ -794,6 +827,8 @@ class PeerTaskConductor:
             self.dispatcher.report_success(assignment, rec.cost_ms)
             PIECE_DOWNLOAD_COUNT.labels("ok").inc()
             self._note_piece_bytes(p, rec.size)
+            self.flight.record(flightlib.EV_LANDED, assignment.piece_num,
+                               float(rec.cost_ms), self._parent_locality(p))
             await self._report_piece(rec, parent_id=p.peer_id)
             if self.on_piece is not None:
                 await self.on_piece(self.store, rec)
@@ -802,6 +837,8 @@ class PeerTaskConductor:
             gone = is_parent_gone(e)
             self.dispatcher.report_failure(assignment, parent_gone=gone)
             reason = self._note_piece_failure(p, e)
+            self.flight.record(flightlib.EV_FAILED, assignment.piece_num,
+                               0.0, reason)
             await self._safe_send({
                 "type": "piece_failed",
                 "piece_num": assignment.piece_num,
@@ -830,6 +867,8 @@ class PeerTaskConductor:
                               f"starved after {MAX_RESCHEDULES} reschedules")
             blocklist = self.dispatcher.unusable_parent_ids()
             self._sched_update.clear()
+            self.flight.record(flightlib.EV_RESCHEDULE, -1, 0.0,
+                               "starvation")
             await self._safe_send({"type": "reschedule", "blocklist": blocklist,
                                    "description": "piece starvation"})
             try:
@@ -839,6 +878,7 @@ class PeerTaskConductor:
                 await asyncio.wait_for(self._sched_update.wait(), timeout=60.0)
             except asyncio.TimeoutError:
                 raise DfError(Code.SchedError, "scheduler silent during reschedule")
+            self.flight.record(flightlib.EV_SCHED_ANSWER)
             return not self._need_back_source
 
     # -- reporting ---------------------------------------------------------
@@ -846,14 +886,20 @@ class PeerTaskConductor:
     _REPORT_FLUSH_S = 0.05
 
     async def _report_piece(self, rec, parent_id: str) -> None:
-        self._pending_reports.append({
+        report = {
             "piece_num": rec.num,
             "range_start": rec.offset,
             "range_size": rec.size,
             "digest": rec.digest,
             "download_cost_ms": rec.cost_ms,
             "dst_peer_id": parent_id,
-        })
+        }
+        # Per-phase timings ride the report so the scheduler can attribute
+        # stragglers per host (flight.PodAggregator, /debug/pod/<task>).
+        timings = self.flight.piece_report_timings(rec.num)
+        if timings:
+            report["timings"] = timings
+        self._pending_reports.append(report)
         if self._flush_task is None or self._flush_task.done():
             self._flush_task = asyncio.ensure_future(self._flush_soon())
 

@@ -13,6 +13,7 @@ import random
 from dataclasses import dataclass, field
 
 from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg import flight as flightlib
 
 log = dflog.get("peer.piece_dispatcher")
 
@@ -58,10 +59,14 @@ def parent_key(p: ParentInfo) -> str:
 
 
 class PieceDispatcher:
-    def __init__(self, *, max_parent_failures: int = 3, quarantine=None):
+    def __init__(self, *, max_parent_failures: int = 3, quarantine=None,
+                 flight: "flightlib.TaskFlight | None" = None):
         # Daemon-wide decaying-penalty blocklist (pkg/quarantine
         # ParentQuarantine), shared across conductors; None = no filter.
         self.quarantine = quarantine
+        # Optional flight-recorder handle (the owning conductor's): parent
+        # topology changes are part of the task's black-box timeline.
+        self.flight = flight
         self.parents: dict[str, ParentInfo] = {}
         self._total_piece_count = -1
         self.piece_size = 0
@@ -167,6 +172,9 @@ class PieceDispatcher:
         p = self.parents.get(peer_id)
         if p is not None:
             p.blocked = True
+            if self.flight is not None:
+                self.flight.record(flightlib.EV_PARENT_DROP, -1, 0.0,
+                                   peer_id)
         self._wakeup.set()
         self.certified_event.set()
 

@@ -23,7 +23,9 @@ import aiohttp
 
 from dragonfly2_tpu.pkg import dflog
 from dragonfly2_tpu.pkg import digest as pkgdigest
+from dragonfly2_tpu.pkg import flight as flightlib
 from dragonfly2_tpu.pkg import retry as retrylib
+from dragonfly2_tpu.pkg import tracing
 from dragonfly2_tpu.pkg.errors import Code, DfError
 from dragonfly2_tpu.storage.local_store import _native
 
@@ -107,6 +109,18 @@ async def assemble_piece(chunks, expected_size: int,
                    "truncated")
     digest_str = f"{algorithm}:{hasher.hexdigest()}" if hasher else ""
     return out, got, digest_str
+
+
+async def _first_byte_tap(chunks, ft, piece_num: int):
+    """Flight-recorder tap: mark the first body chunk's arrival so the
+    critical-path analyzer can split time-to-first-byte (a silent but
+    connected parent = stall) from transfer time."""
+    first = True
+    async for chunk in chunks:
+        if first:
+            first = False
+            ft.record(flightlib.EV_FIRST_BYTE, piece_num)
+        yield chunk
 
 _NATIVE_EXECUTOR: concurrent.futures.ThreadPoolExecutor | None = None
 
@@ -241,6 +255,13 @@ def _unsafe_request_ids(task_id: str, src_peer_id: str) -> bool:
                for c in f"{task_id}{src_peer_id}")
 
 
+def _traceparent_line() -> str:
+    """Raw-head traceparent header for the native request builders (hex
+    ASCII only — safe to splice). Empty when not tracing."""
+    ctx = tracing.current()
+    return f"{tracing.TRACEPARENT}: {ctx.to_traceparent()}\r\n" if ctx else ""
+
+
 def _upload_status_error(status: int, parent: str, what: str) -> DfError | None:
     """Map a parent upload-server status to the coded per-piece error the
     aiohttp path produces, or None for payload statuses (200/206). Shared
@@ -291,6 +312,8 @@ class PieceDownloader:
         url = (f"http://{parent_ip}:{parent_upload_port}"
                f"/download/{task_id[:3]}/{task_id}")
         parent = f"{parent_ip}:{parent_upload_port}"
+        ft = flightlib.for_task(task_id)
+        ft.record(flightlib.EV_REQUEST, piece_num, 0.0, parent)
         chaos_key = f"{parent}|{task_id}|{piece_num}"
         if _chaos is not None:
             fault = _chaos.on_request("piece.request", chaos_key)
@@ -308,8 +331,13 @@ class PieceDownloader:
         start = time.monotonic()
         sess = await self._sess()
         try:
+            # The piece HTTP hop carries the caller's trace context so the
+            # serving daemon's span joins the SAME trace (upload.py
+            # extracts) — without it every pod download is N disconnected
+            # traces, one per daemon.
             async with sess.get(url, params={"peerId": src_peer_id,
-                                             "pieceNum": str(piece_num)}) as resp:
+                                             "pieceNum": str(piece_num)},
+                                headers=tracing.inject()) as resp:
                 status_err = _upload_status_error(
                     resp.status, parent, f"piece {piece_num}")
                 if status_err is not None:
@@ -318,8 +346,11 @@ class PieceDownloader:
                 if _chaos is not None:
                     body = _chaos.wrap_body("piece.body", chaos_key, body)
                 chunks, size, digest_str = await assemble_piece(
-                    retrylib.watch_idle(body, self._idle_timeout,
-                                        what=f"piece {piece_num} from {parent}"),
+                    _first_byte_tap(
+                        retrylib.watch_idle(
+                            body, self._idle_timeout,
+                            what=f"piece {piece_num} from {parent}"),
+                        ft, piece_num),
                     expected_size, expected_digest)
         except retrylib.ProgressTimeout as e:
             # The stall watchdog tripped: the parent is connected but not
@@ -380,8 +411,12 @@ class PieceDownloader:
             f"GET /download/{task_id[:3]}/{task_id}"
             f"?peerId={src_peer_id}&pieceNum={piece_num} HTTP/1.1\r\n"
             f"Host: {parent_ip}:{parent_upload_port}\r\n"
+            f"{_traceparent_line()}"
             "Accept-Encoding: identity\r\nConnection: keep-alive\r\n\r\n"
         ).encode("latin-1")
+        flightlib.for_task(task_id).record(
+            flightlib.EV_REQUEST, piece_num, 0.0,
+            f"{parent_ip}:{parent_upload_port}")
         start = time.monotonic()
         while True:
             try:
@@ -496,8 +531,10 @@ class PieceDownloader:
             f"?peerId={src_peer_id} HTTP/1.1\r\n"
             f"Host: {parent_ip}:{parent_upload_port}\r\n"
             f"Range: bytes={start}-{start + total - 1}\r\n"
+            f"{_traceparent_line()}"
             "Accept-Encoding: identity\r\nConnection: keep-alive\r\n\r\n"
         ).encode("latin-1")
+        ft = flightlib.for_task(task_id)
 
         async def fail_all(err: DfError) -> bool:
             for a in run:
@@ -569,6 +606,8 @@ class PieceDownloader:
                     continue
                 if limiter is not None:
                     await limiter.wait(a.expected_size)
+                ft.record(flightlib.EV_REQUEST, a.piece_num, 0.0,
+                          f"{parent_ip}:{parent_upload_port}")
                 t0 = time.monotonic()
                 try:
                     crc = await ncall(nb.http_read_to_file,
@@ -655,6 +694,10 @@ async def pull_one_piece(downloader: PieceDownloader, store, dispatcher,
     # The chunks land via one pwritev (crc fused into the write, or
     # verified against the digest streamed during receive) — single pass,
     # no assembly copy, no store re-read.
-    return await asyncio.to_thread(
+    ft = flightlib.for_task(task_id)
+    ft.record(flightlib.EV_STORE_START, assignment.piece_num)
+    rec = await asyncio.to_thread(
         store.write_piece_chunks, assignment.piece_num, chunks,
         received_digest, expected_digest=assignment.digest, cost_ms=cost_ms)
+    ft.record(flightlib.EV_STORED, assignment.piece_num)
+    return rec

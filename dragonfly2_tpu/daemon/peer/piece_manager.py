@@ -22,6 +22,7 @@ from dragonfly2_tpu.daemon.peer.piece_downloader import (
 )
 from dragonfly2_tpu.pkg import dflog
 from dragonfly2_tpu.pkg import digest as pkgdigest
+from dragonfly2_tpu.pkg import flight as flightlib
 from dragonfly2_tpu.pkg import retry as retrylib
 from dragonfly2_tpu.pkg.errors import Code, SourceError
 from dragonfly2_tpu.pkg.piece import Range, compute_piece_count, compute_piece_size
@@ -238,9 +239,14 @@ class PieceManager:
                                   num * m.piece_size, take)
                 # Off-loop: record_piece's batched metadata save serializes
                 # the whole piece map — a loop stall if run inline.
+                cost_ms = int((time.monotonic() - t0) * 1000)
                 rec = await asyncio.to_thread(
-                    store.record_piece, num, take, crc,
-                    int((time.monotonic() - t0) * 1000))
+                    store.record_piece, num, take, crc, cost_ms)
+                # Float ms for the recorder: sub-ms loopback pieces must
+                # not collapse to a zero-length origin interval.
+                flightlib.for_task(m.task_id).record(
+                    flightlib.EV_SOURCE_LANDED, num,
+                    (time.monotonic() - t0) * 1000.0)
                 if on_piece is not None:
                     await on_piece(store, rec)
             return True
@@ -480,6 +486,11 @@ class PieceManager:
             return   # resume overlap: bytes already verified on disk
         rec = await asyncio.to_thread(
             store.write_piece_chunks, num, views, cost_ms=cost_ms)
+        # Float ms (receive + write): sub-ms loopback pieces must not
+        # collapse to a zero-length origin interval in the analyzer.
+        flightlib.for_task(store.metadata.task_id).record(
+            flightlib.EV_SOURCE_LANDED, num,
+            (time.monotonic() - started_at) * 1000.0)
         if on_piece is not None:
             await on_piece(store, rec)
 

@@ -15,6 +15,7 @@ from typing import AsyncIterator
 from dragonfly2_tpu.daemon.peer.broker import PieceBroker, PieceEvent
 from dragonfly2_tpu.daemon.peer.piece_manager import PieceManager
 from dragonfly2_tpu.pkg import aio, dflog, idgen, metrics
+from dragonfly2_tpu.pkg import flight as flightlib
 from dragonfly2_tpu.pkg.errors import Code, DfError, StorageError, describe
 from dragonfly2_tpu.pkg.piece import (
     Range,
@@ -192,6 +193,10 @@ class TaskManager:
         # Shared bucket (plain algorithm / non-task transfers).
         self.limiter = self.shaper._shared
         self.broker = PieceBroker()
+        # Flight recorder (pkg/flight): the process-wide bounded task
+        # index; download paths stamp events, terminal paths finish the
+        # flight (histograms + post-mortem dump on failure).
+        self.flight = flightlib.recorder()
         self._running: dict[str, _RunningTask] = {}
         # Last completed P2P pull's bytes per parent locality
         # (conductor.locality_bytes), keyed by task id — the striped
@@ -213,6 +218,7 @@ class TaskManager:
         # pulls ride — each host lands only its own tensors' byte ranges
         # (client/device.py download_sharded).
         sink_wanted = (req.device == "tpu" and self.device_sinks is not None)
+        tf = self.flight.task(task_id)
 
         async def on_piece(st, rec) -> None:
             m = st.metadata
@@ -222,7 +228,9 @@ class TaskManager:
             if sink_wanted:
                 # Land into HBM as the piece verifies — by completion the
                 # device buffer only awaits the final on-device check.
+                tf.record(flightlib.EV_HBM_START, rec.num)
                 await self.device_sinks.on_piece(task_id, st, rec)
+                tf.record(flightlib.EV_HBM_LANDED, rec.num)
             if progress_q is not None:
                 await progress_q.on_piece(st, rec)
 
@@ -575,6 +583,7 @@ class TaskManager:
             # Verify + land output inside the same failure envelope.
             await self._finalize_content_digest(req, store)
             store.mark_done()
+            self.flight.finish_task(task_id, "done")
             self._pex_announce(task_id)
             if req.output:
                 await asyncio.to_thread(store.store_to, req.output)
@@ -582,6 +591,7 @@ class TaskManager:
             self._discard_sink(req, task_id)
             store.mark_invalid()
             run.error = e
+            self.flight.finish_task(task_id, "failed", note=str(e))
             self.broker.publish(task_id, PieceEvent([], failed=True))
             yield FileTaskProgress(state="failed", task_id=task_id, peer_id=peer_id,
                                    error=e.to_wire())
@@ -591,6 +601,7 @@ class TaskManager:
             self._discard_sink(req, task_id)
             store.mark_invalid()
             run.error = DfError(Code.UnknownError, describe(e))
+            self.flight.finish_task(task_id, "failed", note=describe(e))
             self.broker.publish(task_id, PieceEvent([], failed=True))
             yield FileTaskProgress(state="failed", task_id=task_id, peer_id=peer_id,
                                    error=run.error.to_wire())
@@ -609,6 +620,8 @@ class TaskManager:
                                         "download aborted by client")
                 self._discard_sink(req, task_id)
                 store.mark_invalid()
+                self.flight.finish_task(task_id, "failed",
+                                        note=str(run.error))
                 self.broker.publish(task_id, PieceEvent([], failed=True))
             store.unpin()
             run.done.set()
@@ -702,6 +715,7 @@ class TaskManager:
             # faithfully match the corruption.
             await self._finalize_content_digest(req, store)
             store.mark_done()
+            self.flight.finish_task(task_id, "done")
             # Disk result is final: announce and publish FIRST (peers and
             # dedup waiters must not stall behind the HBM backfill — the
             # device copy cannot affect the disk result either way).
@@ -719,6 +733,7 @@ class TaskManager:
             log.error("seed task failed", error=describe(e))
             store.mark_invalid()
             run.error = e if isinstance(e, DfError) else DfError(Code.UnknownError, describe(e))
+            self.flight.finish_task(task_id, "failed", note=describe(e))
             self.broker.publish(task_id, PieceEvent([], failed=True))
         finally:
             store.unpin()
@@ -878,6 +893,7 @@ class TaskManager:
             await self._run_download(task_id, peer_id, req, store, None)
             await self._finalize_content_digest(req, store)
             store.mark_done()
+            self.flight.finish_task(task_id, "done")
             self._pex_announce(task_id)
             self.broker.publish(task_id, PieceEvent(
                 [], store.metadata.total_piece_count,
@@ -886,11 +902,13 @@ class TaskManager:
         except DfError as e:
             store.mark_invalid()
             run.error = e
+            self.flight.finish_task(task_id, "failed", note=str(e))
             self.broker.publish(task_id, PieceEvent([], failed=True))
         except Exception as e:  # pragma: no cover - defensive
             log.error("stream download crashed", exc_info=True)
             store.mark_invalid()
             run.error = DfError(Code.UnknownError, describe(e))
+            self.flight.finish_task(task_id, "failed", note=describe(e))
             self.broker.publish(task_id, PieceEvent([], failed=True))
         finally:
             store.unpin()
@@ -1120,7 +1138,10 @@ class TaskManager:
             COMPLETION_REHASH.labels("skipped").inc()
         else:
             COMPLETION_REHASH.labels("hashed").inc()
+            tf = self.flight.task(store.metadata.task_id)
+            tf.record(flightlib.EV_VERIFY_START)
             await asyncio.to_thread(store.validate_digest, req.meta.digest)
+            tf.record(flightlib.EV_VERIFIED)
         store.metadata.digest = req.meta.digest
 
     async def _finalize_device_for_seed(self, req: "FileTaskRequest",

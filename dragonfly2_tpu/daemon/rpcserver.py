@@ -12,6 +12,7 @@ import asyncio
 
 from dragonfly2_tpu.daemon.peer.task_manager import FileTaskRequest, TaskManager
 from dragonfly2_tpu.pkg import aio, dflog
+from dragonfly2_tpu.pkg import flight as flightlib
 from dragonfly2_tpu.pkg.errors import Code, DfError
 from dragonfly2_tpu.pkg.piece import Range
 from dragonfly2_tpu.pkg.types import NetAddr
@@ -35,6 +36,8 @@ class DaemonRpcServer:
         self.download_server.register_stream("Daemon.ExportTask", self._export_task)
         self.download_server.register_unary("Daemon.DeleteTask", self._delete_task)
         self.download_server.register_unary("Daemon.Health", self._health)
+        self.download_server.register_unary("Daemon.FlightReport",
+                                            self._flight_report)
         # Peer-facing service (reference rpcserver.go peer server): piece
         # availability sync for children + seed triggering by the scheduler.
         self.peer_server.register_stream("Peer.SyncPieceTasks", self._sync_piece_tasks)
@@ -164,6 +167,19 @@ class DaemonRpcServer:
 
     async def _health(self, body, ctx: RpcContext):
         return {"ok": True, "version": "0.1.0"}
+
+    async def _flight_report(self, body, ctx: RpcContext):
+        """Flight-recorder autopsy for a task this daemon ran: the phase
+        breakdown + per-piece waterfall, JSON plus the rendered text
+        (dfget --explain prints the latter — identical to the
+        /debug/flight/<task_id>?format=text rendering)."""
+        task_id = (body or {}).get("task_id", "")
+        tf = flightlib.recorder().get(task_id)
+        if tf is None:
+            raise DfError(Code.PeerTaskNotFound,
+                          f"no flight data for task {task_id}")
+        report = flightlib.analyze(tf)
+        return {"report": report, "text": flightlib.render_waterfall(report)}
 
     # -- peer service ------------------------------------------------------
 

@@ -25,7 +25,8 @@ import threading
 
 from aiohttp import web
 
-from dragonfly2_tpu.pkg import dflog, metrics
+from dragonfly2_tpu.pkg import dflog, metrics, tracing
+from dragonfly2_tpu.pkg import flight as flightlib
 from dragonfly2_tpu.pkg.piece import Range
 from dragonfly2_tpu.pkg.ratelimit import Limiter
 from dragonfly2_tpu.storage import StorageManager
@@ -164,7 +165,18 @@ class UploadManager:
     # -- handlers ----------------------------------------------------------
 
     async def _download(self, request: web.Request) -> web.StreamResponse:
+        # Adopt the requester's trace context from the piece HTTP hop
+        # (piece_downloader injects it): the serving span joins the SAME
+        # trace, so a pod download is one trace, not N disconnected ones.
+        tp = request.headers.get(tracing.TRACEPARENT, "")
+        with tracing.extract({tracing.TRACEPARENT: tp} if tp else None,
+                             "upload.serve") as sp:
+            return await self._download_traced(request, sp)
+
+    async def _download_traced(self, request: web.Request,
+                               sp) -> web.StreamResponse:
         task_id = request.match_info["task_id"]
+        sp.set_attr("task", task_id[:16])
         store = self.storage.try_get(task_id)
         if store is None:
             UPLOAD_REQUESTS.labels("not_found").inc()
@@ -216,6 +228,14 @@ class UploadManager:
             await self.limiter.wait(length)
             UPLOAD_BYTES.inc(length)
             UPLOAD_REQUESTS.labels("ok").inc()
+            sp.set_attr("bytes", length)
+            # Serving-side flight event: the parent's own timeline records
+            # which pieces it handed out (pod autopsies correlate a child's
+            # stall against the parent's serve log).
+            flightlib.for_task(task_id).record(
+                flightlib.EV_UPLOAD_SERVE,
+                int(piece_num) if piece_num is not None else -1,
+                float(length))
             # sendfile the byte range straight from the page cache — the
             # hot single-core cost in profiles was pread into Python bytes
             # plus the user→kernel copy in sendmsg (benchmarks/fanout_bench

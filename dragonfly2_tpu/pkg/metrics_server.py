@@ -9,7 +9,12 @@ Routes: GET /metrics (Prometheus text), GET /healthy,
         GET /debug/stacks (all thread stacks), GET /debug/tasks (asyncio),
         GET /debug/profile?seconds=N (cProfile sample, pprof's CPU
         profile analog), GET /debug/heap?topn=N (tracemalloc snapshot,
-        pprof's heap profile analog; first call arms tracing).
+        pprof's heap profile analog; first call arms tracing),
+        GET /debug/flight (flight-recorder task index),
+        GET /debug/flight/{task_id}[?format=text] (critical-path autopsy:
+        phase breakdown + per-piece waterfall, JSON or rendered text),
+        GET /debug/pod/{task_id} (scheduler-side per-host straggler
+        attribution from piece-report timings).
 """
 
 from __future__ import annotations
@@ -21,7 +26,7 @@ import traceback
 
 from aiohttp import web
 
-from dragonfly2_tpu.pkg import dflog, metrics
+from dragonfly2_tpu.pkg import dflog, flight as flightlib, metrics
 
 log = dflog.get("metrics_server")
 
@@ -50,7 +55,12 @@ def _task_dump() -> str:
 
 
 class MetricsServer:
-    def __init__(self):
+    def __init__(self, *, flight: "flightlib.FlightRecorder | None" = None,
+                 pod_flight: "flightlib.PodAggregator | None" = None):
+        # Optional providers: the daemon passes its flight recorder, the
+        # scheduler its pod aggregator; endpoints 404 without one.
+        self._flight = flight
+        self._pod_flight = pod_flight
         self._runner: web.AppRunner | None = None
         self._port = 0
         self._profiling = False
@@ -63,6 +73,9 @@ class MetricsServer:
         app.router.add_get("/debug/tasks", self._tasks)
         app.router.add_get("/debug/profile", self._profile)
         app.router.add_get("/debug/heap", self._heap)
+        app.router.add_get("/debug/flight", self._flight_index)
+        app.router.add_get("/debug/flight/{task_id}", self._flight_task)
+        app.router.add_get("/debug/pod/{task_id}", self._pod_task)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, host, port)
@@ -126,6 +139,37 @@ class MetricsServer:
         stats = pstats.Stats(prof, stream=out)
         stats.sort_stats("cumulative").print_stats(60)
         return web.Response(text=out.getvalue())
+
+    async def _flight_index(self, request: web.Request) -> web.Response:
+        if self._flight is None:
+            raise web.HTTPNotFound(text="no flight recorder on this binary\n")
+        return web.json_response({"tasks": self._flight.summary()})
+
+    async def _flight_task(self, request: web.Request) -> web.Response:
+        """The black-box autopsy: phase breakdown folding the task's event
+        ring (sums to wall time) + the per-piece waterfall. ``?format=text``
+        renders the same waterfall ``dfget --explain`` prints."""
+        if self._flight is None:
+            raise web.HTTPNotFound(text="no flight recorder on this binary\n")
+        task_id = request.match_info["task_id"]
+        tf = self._flight.get(task_id)
+        if tf is None:
+            raise web.HTTPNotFound(text=f"no flight data for {task_id}\n")
+        report = flightlib.analyze(tf)
+        if request.query.get("format") == "text":
+            return web.Response(text=flightlib.render_waterfall(report) + "\n")
+        return web.json_response(report)
+
+    async def _pod_task(self, request: web.Request) -> web.Response:
+        """Pod-level straggler attribution (scheduler binary): slowest
+        host, dominant phase, quarantine correlation."""
+        if self._pod_flight is None:
+            raise web.HTTPNotFound(text="no pod aggregator on this binary\n")
+        task_id = request.match_info["task_id"]
+        report = self._pod_flight.report(task_id)
+        if report is None:
+            raise web.HTTPNotFound(text=f"no pod data for {task_id}\n")
+        return web.json_response(report)
 
     async def _heap(self, request: web.Request) -> web.Response:
         """Heap allocation snapshot via tracemalloc (armed on first call;

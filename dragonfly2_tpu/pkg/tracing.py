@@ -25,6 +25,17 @@ import urllib.request
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
+from dragonfly2_tpu.pkg import metrics as _metrics
+
+# Exporter health on the standard scrape surface: silent span loss
+# (queue-full, unreachable collector) must be visible in /metrics, not
+# only on the exporter object.
+OTLP_SPANS = _metrics.counter(
+    "tracing_otlp_spans_total",
+    "OTLP span export outcomes (sent = landed in the collector, "
+    "dropped = queue overflow / unreachable collector / closed exporter)",
+    ("result",))
+
 _current: contextvars.ContextVar["SpanContext | None"] = contextvars.ContextVar(
     "df_trace_ctx", default=None)
 
@@ -56,12 +67,20 @@ class Span:
     end: float = 0.0
     attrs: dict = field(default_factory=dict)
     status: str = "ok"
+    # Monotonic anchor: duration derives from perf_counter, never from two
+    # wall-clock reads — an NTP step mid-span must not produce negative or
+    # garbage durations. ``start`` stays wall clock for export anchoring;
+    # ``end`` is reconstructed as start + monotonic duration so exported
+    # timestamps and duration_ms can never disagree.
+    start_pc: float = field(default_factory=time.perf_counter)
+    duration_s: float = 0.0
 
     def set_attr(self, key: str, value) -> None:
         self.attrs[key] = value
 
     def finish(self, status: str = "") -> None:
-        self.end = time.time()
+        self.duration_s = max(0.0, time.perf_counter() - self.start_pc)
+        self.end = self.start + self.duration_s
         if status:
             self.status = status
         _EXPORTER.export(self)
@@ -70,7 +89,7 @@ class Span:
         return {"name": self.name, "trace_id": self.context.trace_id,
                 "span_id": self.context.span_id, "parent_id": self.parent_id,
                 "start": self.start, "end": self.end,
-                "duration_ms": round((self.end - self.start) * 1000, 3),
+                "duration_ms": round(self.duration_s * 1000, 3),
                 "attrs": self.attrs, "status": self.status}
 
 
@@ -149,7 +168,7 @@ class OTLPExporter:
 
     def enqueue(self, span: "Span") -> None:
         if self._stop.is_set():
-            self.dropped_spans += 1   # closed: no worker will ever post it
+            self._drop(1)   # closed: no worker will ever post it
             return
         # Count BEFORE the put: the worker may pop and task_done between a
         # put and a later increment, driving the counter negative and
@@ -160,13 +179,17 @@ class OTLPExporter:
         try:
             self._q.put_nowait(span)
         except _queue.Full:
-            self.dropped_spans += 1
+            self._drop(1)
             self._task_done(1)
 
     def _task_done(self, n: int) -> None:
         with self._done_cv:
             self._unfinished -= n
             self._done_cv.notify_all()
+
+    def _drop(self, n: int) -> None:
+        self.dropped_spans += n
+        OTLP_SPANS.labels("dropped").inc(n)
 
     def _drain_batch(self) -> "list[Span]":
         batch: list[Span] = []
@@ -187,8 +210,9 @@ class OTLPExporter:
         try:
             with urllib.request.urlopen(req, timeout=self.timeout):
                 self.sent_spans += len(batch)
+                OTLP_SPANS.labels("sent").inc(len(batch))
         except OSError:
-            self.dropped_spans += len(batch)
+            self._drop(len(batch))
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -200,7 +224,7 @@ class OTLPExporter:
                     # The contract is "drop on the floor", never die: a
                     # malformed endpoint (ValueError from urllib) must not
                     # kill the worker and silently wedge export forever.
-                    self.dropped_spans += len(batch)
+                    self._drop(len(batch))
                 finally:
                     self._task_done(len(batch))
         # Stop raced a final enqueue: whatever is still queued will never
@@ -213,7 +237,7 @@ class OTLPExporter:
             except _queue.Empty:
                 break
         if tail:
-            self.dropped_spans += tail
+            self._drop(tail)
             self._task_done(tail)
 
     def flush(self, timeout: float = 5.0) -> None:

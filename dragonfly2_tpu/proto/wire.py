@@ -114,6 +114,11 @@ PIECE = Msg(
     "Piece",
     piece_num=F(int, required=True), range_start=F(int), range_size=F(int),
     digest=F(str), download_cost_ms=F(int), dst_peer_id=F(str),
+    # Flight-recorder per-phase split of download_cost_ms ({dcn_ms,
+    # stall_ms, store_ms}): the scheduler's PodAggregator folds these into
+    # per-host straggler attribution (/debug/pod/<task_id>). Optional —
+    # origin/imported pieces report without it.
+    timings=F(dict),
 )
 
 _PERSISTENT_COMMON = dict(
@@ -170,6 +175,10 @@ UNARY: dict[str, Msg] = {
         persistent=F(bool), replica_count=F(int), ttl=F(float)),
     "Daemon.DeleteTask": Msg("DeleteTask", task_id=F(str, required=True)),
     "Daemon.Health": Msg("Health"),
+    # Flight-recorder autopsy: the phase breakdown + waterfall for a task
+    # this daemon ran (dfget --explain, tooling).
+    "Daemon.FlightReport": Msg("FlightReport",
+                               task_id=F(str, required=True)),
 
     # Peer service (TCP — other daemons + scheduler triggers)
     "Peer.GetPieceTasks": Msg(

@@ -74,8 +74,9 @@ class SchedulerServer:
         if self.config.metrics_port >= 0:
             from dragonfly2_tpu.pkg.metrics_server import MetricsServer
 
-            # Loopback by default — /debug exposes live stacks.
-            self.metrics = MetricsServer()
+            # Loopback by default — /debug exposes live stacks; the pod
+            # aggregator adds /debug/pod/<task_id> straggler attribution.
+            self.metrics = MetricsServer(pod_flight=self.service.pod_flight)
             await self.metrics.serve("127.0.0.1", self.config.metrics_port)
         self.gc.serve()
         if self.config.manager_addr:

@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 
 from dragonfly2_tpu.pkg import aio, dflog
+from dragonfly2_tpu.pkg import flight as flightlib
 from dragonfly2_tpu.pkg.errors import Code, DfError
 from dragonfly2_tpu.pkg.fsm import TransitionError
 from dragonfly2_tpu.pkg.piece import PieceInfo, SizeScope
@@ -87,6 +88,11 @@ class SchedulerService:
         )
 
         self.persistent = PersistentCacheResource(self.config.persistent_cache_db)
+        # Pod-level flight aggregation: per-host phase attribution from
+        # piece-report timings + quarantine correlation, served at
+        # /debug/pod/<task_id> (scheduler/server wires it into the
+        # MetricsServer).
+        self.pod_flight = flightlib.PodAggregator()
 
     # ------------------------------------------------------------------ #
     # resource resolution (reference handleResource :1457)
@@ -523,6 +529,9 @@ class SchedulerService:
             return
         first_piece = not peer.finished_pieces
         peer.add_finished_piece(num, p.get("download_cost_ms", 0))
+        self.pod_flight.note_piece(task.id, peer.host.id,
+                                   p.get("timings"),
+                                   p.get("download_cost_ms", 0))
         if num not in task.pieces:
             # Construct piece metadata only for the first reporter; every
             # later peer re-reporting the same piece skips the allocation.
@@ -555,6 +564,9 @@ class SchedulerService:
             if num in peer.finished_pieces:
                 continue   # idempotent re-delivery (see _apply_piece_finished)
             peer.add_finished_piece(num, p.get("download_cost_ms", 0))
+            self.pod_flight.note_piece(task.id, peer.host.id,
+                                       p.get("timings"),
+                                       p.get("download_cost_ms", 0))
             if num not in task.pieces:
                 task.store_piece(PieceInfo.from_wire(p))
             parent_id = p.get("dst_peer_id", "")
@@ -586,8 +598,15 @@ class SchedulerService:
                 # filtering it from every peer's candidate set — not just
                 # this reporter's blocklist.
                 reason = msg.get("reason", "")
+                if reason:
+                    # Straggler attribution: the failure counts against
+                    # the PARENT host that served (or failed to serve).
+                    self.pod_flight.note_failure(task.id, parent.host.id,
+                                                 reason)
                 if reason and parent.host.note_served_bad(reason):
                     PARENT_DEMOTION_COUNT.labels(reason).inc()
+                    self.pod_flight.note_quarantine(task.id, parent.host.id,
+                                                    reason)
                     log.warning("parent host quarantined",
                                 host=parent.host.id, reason=reason,
                                 reporter=peer.id[:24])

@@ -27,6 +27,7 @@ REQUIRED_CONFIGS = (
     "config2_fanout_striped",
     "config6_stripe_sim",
     "config7_chaos",
+    "config8_flight",
 )
 
 
@@ -99,6 +100,22 @@ def test_chaos_entry_paired_shape():
     assert 0 < entry["dead_parent_fraction"] < 1
     assert entry["ratio"] == pytest.approx(
         degraded["wall_s"] / clean["wall_s"], rel=1e-2)
+
+
+def test_flight_entry_paired_shape():
+    """config8_flight is a PAIRED overhead run: recorder-on and
+    recorder-off ingest from the same geometry, and the recorded overhead
+    stays inside the always-on budget (<3%)."""
+    entry = _load()["published"]["config8_flight"]
+    on, off = entry["recorder_on"], entry["recorder_off"]
+    for run in (on, off):
+        assert run["mb_s"] > 0
+        assert run["pieces"] > 0 and run["piece_kb"] > 0
+    assert on["pieces"] == off["pieces"]
+    assert on["piece_kb"] == off["piece_kb"]
+    assert entry["overhead_frac"] < 0.03, entry["overhead_frac"]
+    assert entry["overhead_frac"] == pytest.approx(
+        1.0 - on["mb_s"] / off["mb_s"], abs=1e-3)
 
 
 def test_stripe_sim_meets_acceptance_bounds():

@@ -75,6 +75,82 @@ def test_rpc_propagation(run_async):
     run_async(run())
 
 
+def test_duration_survives_wall_clock_step(monkeypatch):
+    """An NTP step mid-span must not produce negative/garbage durations:
+    duration derives from perf_counter, and the exported end timestamp is
+    reconstructed from it (end = start + duration, always >= start)."""
+    import time as time_mod
+
+    tracing.exporter().clear()
+    real_time = time_mod.time
+    with tracing.span("stepped") as sp:
+        # The wall clock jumps BACK 1 hour mid-span.
+        monkeypatch.setattr(time_mod, "time", lambda: real_time() - 3600.0)
+    monkeypatch.setattr(time_mod, "time", real_time)
+    row = sp.to_json()
+    assert 0 <= row["duration_ms"] < 5000, row
+    assert sp.end >= sp.start
+    # And a forward step is equally harmless.
+    with tracing.span("stepped-fwd") as sp2:
+        monkeypatch.setattr(time_mod, "time", lambda: real_time() + 3600.0)
+    monkeypatch.setattr(time_mod, "time", real_time)
+    assert 0 <= sp2.to_json()["duration_ms"] < 5000
+
+
+def test_otlp_health_metric_counts_sent_and_dropped(run_async):
+    """Exporter health is scrapeable: tracing_otlp_spans_total{result}
+    moves with sent and dropped spans, so silent span loss is visible on
+    /metrics instead of only on the exporter object."""
+    from aiohttp import web
+
+    from dragonfly2_tpu.pkg import metrics as metrics_mod
+
+    def scrape():
+        text = metrics_mod.render()[0].decode()
+        return metrics_mod.parse_labeled_samples(
+            text, "dragonfly_tpu_tracing_otlp_spans_total", "result")
+
+    async def run():
+        import asyncio
+
+        async def v1_traces(request: web.Request) -> web.Response:
+            await request.json()
+            return web.json_response({"partialSuccess": {}})
+
+        app = web.Application()
+        app.router.add_post("/v1/traces", v1_traces)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+
+        before = scrape()
+        exp = tracing.exporter()
+        otlp = exp.set_otlp(f"http://127.0.0.1:{port}",
+                            service_name="df-health", flush_interval=0.05)
+        try:
+            with tracing.span("counted"):
+                pass
+            for _ in range(100):
+                if otlp.sent_spans >= 1:
+                    break
+                await asyncio.sleep(0.05)
+            assert otlp.sent_spans >= 1
+            after = scrape()
+            assert after.get("sent", 0) >= before.get("sent", 0) + 1
+            # Post-close enqueues count as dropped — on the metric too.
+            await asyncio.to_thread(otlp.close)
+            otlp.enqueue(tracing.Span(
+                "late", tracing.SpanContext("a" * 32, "b" * 16), end=1.0))
+            assert scrape().get("dropped", 0) >= before.get("dropped", 0) + 1
+        finally:
+            exp.set_otlp("")
+            await runner.cleanup()
+
+    run_async(run())
+
+
 def test_jsonl_export(tmp_path):
     path = str(tmp_path / "trace.jsonl")
     tracing.exporter().set_file(path)
