@@ -6,7 +6,7 @@ too noisy to attribute a data-plane change. This bench isolates the one
 path BASELINE.json names as the ceiling: bytes entering the daemon, being
 digest-verified, and landing in a LocalTaskStore, all in one process.
 
-Two phases, mirroring the two ingest shapes:
+Four phases, mirroring the daemon's ingest AND serve shapes:
 
   origin   back-to-source: a mem:// source client streams chunks through
            PieceManager.download_source (piece assembly, per-piece digest
@@ -17,6 +17,16 @@ Two phases, mirroring the two ingest shapes:
            advertised crc32c digest, are verified and landed the way the
            aiohttp fallback path does (piece_downloader receive →
            write_piece), with the certified completion skip.
+  serve    parent side: a landed store's bytes pushed to a draining local
+           socket three ways, PAIRED on the same store/pieces —
+           ``bytes`` (the pre-unification per-piece read_piece+send),
+           ``pooled`` (coalesced pooled preadv spans, the in-progress
+           stream path), ``sendfile`` (kernel windows, the upload-server/
+           gateway fast path, now also covering landed windows of
+           in-progress tasks).
+  hash     the CPU crc32c verify fallback: the selected non-native
+           backend (pkg/digest order: google-crc32c > python) vs the old
+           pure-Python table composition, same piece geometry.
 
 Usage: python benchmarks/ingest_micro.py [--mb 256] [--runs 3] [--publish]
 Writes a JSON line to stdout; --publish records it under
@@ -168,6 +178,128 @@ async def bench_p2p(workdir: str, content: bytes, run_id: int) -> float:
     return len(content) / wall / 1e6
 
 
+def _landed_store(workdir: str, content: bytes, name: str) -> LocalTaskStore:
+    """A completed store holding ``content`` — the serve rounds' subject."""
+    piece_size = compute_piece_size(len(content))
+    total = compute_piece_count(len(content), piece_size)
+    store = _new_store(workdir, name, piece_size=piece_size)
+    store.update_task(content_length=len(content), total_piece_count=total)
+    view = memoryview(content)
+    for n in range(total):
+        store.write_piece(n, view[n * piece_size:(n + 1) * piece_size])
+    return store
+
+
+def bench_serve(store: LocalTaskStore, size: int, mode: str) -> float:
+    """Serve the store's whole content to a draining AF_UNIX peer; returns
+    MB/s of the serving side. ``mode``:
+      bytes     pre-unification shape: read_piece → fresh bytes → send
+                (what _stream_ordered + resp.write cost per piece).
+      pooled    unified read path: coalesced spans preadv'd into ONE
+                recycled pooled buffer, sent from the view.
+      sendfile  kernel windows straight from the page cache (upload
+                server / gateway / landed-prefix-of-in-progress path).
+    """
+    import socket
+    import threading
+
+    from dragonfly2_tpu.storage.local_store import (
+        acquire_read_buffer,
+        release_read_buffer,
+    )
+
+    s_out, s_in = socket.socketpair()
+    s_out.setblocking(True)
+    done = threading.Event()
+
+    def drain() -> None:
+        sink = bytearray(1 << 20)
+        got = 0
+        while got < size:
+            n = s_in.recv_into(sink)
+            if n <= 0:
+                break
+            got += n
+        done.set()
+
+    t = threading.Thread(target=drain, daemon=True)
+    t.start()
+    total = store.metadata.total_piece_count
+    span = 8 << 20
+    t0 = time.perf_counter()
+    if mode == "bytes":
+        for n in range(total):
+            s_out.sendall(store.read_piece(n))
+    elif mode == "pooled":
+        buf = acquire_read_buffer(span)
+        try:
+            off = 0
+            while off < size:
+                take = min(span, size - off)
+                store.read_into(off, take, buf)
+                s_out.sendall(buf[:take])
+                off += take
+        finally:
+            release_read_buffer(buf)
+    elif mode == "sendfile":
+        fd = store.data_fd()
+        off = 0
+        while off < size:
+            sent = os.sendfile(s_out.fileno(), fd, off,
+                               min(span, size - off))
+            if sent <= 0:
+                raise RuntimeError(f"sendfile returned {sent}")
+            off += sent
+    else:
+        raise ValueError(mode)
+    done.wait(timeout=60)
+    wall = time.perf_counter() - t0
+    s_out.close()
+    s_in.close()
+    t.join(timeout=5)
+    return size / wall / 1e6
+
+
+def bench_hash_fallback(content: bytes) -> dict:
+    """CPU crc32c verify: the selected non-native fallback backend vs the
+    old pure-Python table composition, per-piece like piece verify does.
+    The python side runs on a small prefix (it is ~3 orders of magnitude
+    slower) and extrapolates per-byte."""
+    from dragonfly2_tpu.pkg import digest as pkgdigest
+
+    piece = 4 << 20
+    fallback = pkgdigest._google_crc32c()
+    backend = "google-crc32c"
+    if fallback is None:
+        fallback = pkgdigest._crc32c_py
+        backend = "python"
+
+    def run(impl, data: bytes) -> float:
+        view = memoryview(data)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for off in range(0, len(data), piece):
+                impl(view[off:off + piece], 0)
+            best = min(best, time.perf_counter() - t0)
+        return len(data) / best / 1e6
+
+    py_sample = content[:4 << 20]
+    t0 = time.perf_counter()
+    pkgdigest._crc32c_py(py_sample)
+    py_mbps = len(py_sample) / (time.perf_counter() - t0) / 1e6
+    # A pure-python "fallback" (no C backend at all) can't chew the whole
+    # content in bench time; sample it like the python side.
+    fb_mbps = run(fallback,
+                  content if backend != "python" else content[:8 << 20])
+    return {
+        "backend": backend,
+        "python_mbps": round(py_mbps, 1),
+        "fallback_mbps": round(fb_mbps, 1),
+        "speedup": round(fb_mbps / py_mbps, 1) if py_mbps else 0.0,
+    }
+
+
 async def run_bench(total_mb: int, runs: int, workdir: str) -> dict:
     rng = random.Random(7)
     content = b"".join(rng.randbytes(16 << 20)
@@ -176,9 +308,23 @@ async def run_bench(total_mb: int, runs: int, workdir: str) -> dict:
     register_client("mem", MemClient(content))
 
     origin, p2p = [], []
+    serve: dict[str, list[float]] = {"bytes": [], "pooled": [], "sendfile": []}
     for i in range(runs):
         origin.append(await bench_origin(workdir, content, sha, i))
         p2p.append(await bench_p2p(workdir, content, i))
+        # Paired serve round: same landed store, alternating mode order
+        # inside the run so ambient drift can't favor one mode.
+        store = _landed_store(workdir, content, f"serve{i}")
+        order = ["bytes", "pooled", "sendfile"]
+        if i % 2:
+            order.reverse()
+        for mode in order:
+            serve[mode].append(await asyncio.to_thread(
+                bench_serve, store, len(content), mode))
+        store.destroy()
+    serve_bytes = statistics.median(serve["bytes"])
+    serve_sendfile = statistics.median(serve["sendfile"])
+    hash_fallback = bench_hash_fallback(content)
     return {
         "config": "ingest-micro",
         "content_mb": total_mb,
@@ -187,6 +333,16 @@ async def run_bench(total_mb: int, runs: int, workdir: str) -> dict:
         "p2p_mbps": round(statistics.median(p2p), 1),
         "origin_runs_mbps": [round(x, 1) for x in origin],
         "p2p_runs_mbps": [round(x, 1) for x in p2p],
+        "serve": {
+            "bytes_mbps": round(serve_bytes, 1),
+            "pooled_mbps": round(statistics.median(serve["pooled"]), 1),
+            "sendfile_mbps": round(serve_sendfile, 1),
+            "runs_mbps": {k: [round(x, 1) for x in v]
+                          for k, v in serve.items()},
+            "gain_frac": round(serve_sendfile / serve_bytes - 1.0, 3)
+            if serve_bytes else 0.0,
+        },
+        "hash_fallback": hash_fallback,
         "piece_size_mb": compute_piece_size(total_mb << 20) >> 20,
         "host_cores": os.cpu_count(),
     }

@@ -383,8 +383,20 @@ def latency_budget_ms(result: dict, idle_budget_ms: float) -> float:
                20.0 * result.get("schedule_p50_ms", 0.0))
 
 
-def check(result: dict) -> None:
-    """Assertions shared by the bench and the pytest wrapper."""
+def timing_assertable(result: dict, max_slowdown: float = 3.0) -> bool:
+    """Were timing bounds meaningful for this run? Under suite-level CPU
+    contention (ambient heartbeat lag pushing the slowdown factor past
+    ~3x) even budgeted bounds measure the NEIGHBORS, not the scheduler —
+    the round-5 verdict's load-flake: the test wrapper records instead of
+    asserting there, while behavioral invariants always assert and the
+    dedicated bench (which runs alone) always asserts both."""
+    return slowdown_factor(result) <= max_slowdown
+
+
+def check_behavior(result: dict) -> None:
+    """Load-independent invariants — these must ALWAYS hold, full-suite
+    contention or not (verdict r05: split them from timing so a busy CI
+    host can't convert real regressions into retry noise)."""
     assert result["finished"] == result["expected_finishers"], result
     # Origin economy at pod scale: ~one copy.
     assert result["origin_fetches"] <= 3, result
@@ -392,15 +404,6 @@ def check(result: dict) -> None:
     # an intra-slice pick is ~6%; the slice affinity term must pull the
     # scheduled fraction far above it.
     assert result["intra_slice_frac"] >= 0.3, result
-    # The scheduler's loop survived the storm without multi-second stalls.
-    # Budget from observation, not wall-clock luck: ambient contention
-    # (slowdown_factor) widens it, and so does the run's own median
-    # schedule cost — when the register storm takes ~p50 ms per answer on
-    # a slow host, a worst stall of a few p50s is the storm draining, not
-    # a pathology; a deadlock or O(n^2) stall still dwarfs both terms.
-    assert result["max_loop_lag_ms"] < max(
-        500 * slowdown_factor(result),
-        3 * result.get("schedule_p50_ms", 0.0)), result
     # TTL GC drains the whole run's registry state (reference
     # scheduler/config/constants.go:77-88 pins the same guarantees).
     assert result["peers_after_gc"] == 0, result
@@ -408,9 +411,28 @@ def check(result: dict) -> None:
     assert result["hosts_after_gc"] == 0, result
 
 
-def check_churn(result: dict) -> None:
-    """Extra invariants for the slice-kill + straggler variant."""
-    check(result)
+def check_timing(result: dict) -> None:
+    """The scheduler's loop survived the storm without multi-second stalls.
+    Budget from observation, not wall-clock luck: ambient contention
+    (slowdown_factor) widens it, and so does the run's own median
+    schedule cost — when the register storm takes ~p50 ms per answer on
+    a slow host, a worst stall of a few p50s is the storm draining, not
+    a pathology; a deadlock or O(n^2) stall still dwarfs both terms."""
+    assert result["max_loop_lag_ms"] < max(
+        500 * slowdown_factor(result),
+        3 * result.get("schedule_p50_ms", 0.0)), result
+
+
+def check(result: dict) -> None:
+    """Assertions shared by the bench and the pytest wrapper."""
+    check_behavior(result)
+    check_timing(result)
+
+
+def check_churn_behavior(result: dict) -> None:
+    """Extra load-independent invariants for the slice-kill + straggler
+    variant."""
+    check_behavior(result)
     assert result["killed_peers"] == result["churn_waves"] * HOSTS_PER_SLICE, result
     # Stragglers must be scheduled (not demoted to fresh origin fetches)…
     assert result["straggler_parent_picks"] > 0, result
@@ -419,6 +441,11 @@ def check_churn(result: dict) -> None:
     # Locality on the surviving slices must not degrade below the
     # no-churn bar.
     assert result["healthy_intra_slice_frac"] >= 0.3, result
+
+
+def check_churn(result: dict) -> None:
+    check_churn_behavior(result)
+    check_timing(result)
 
 
 def main() -> int:

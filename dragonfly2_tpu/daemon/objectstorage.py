@@ -227,12 +227,13 @@ class ObjectStorageService:
 
     @staticmethod
     def _try_sendfile(attrs: dict, rng, total: int):
-        """Warm-path fast exit: a COMPLETED local store whose data file is
-        exactly the content serves via sendfile (zero Python byte handling)
-        instead of the piece iterator; eligibility is the shared
-        P2PTransport.sendfile_window predicate (also used by the proxy).
-        Returns (response, byte_count) or (None, 0). The response owns a
-        store pin until the send finishes (upload-server discipline)."""
+        """Fast exit: serve via sendfile (zero Python byte handling)
+        instead of the piece iterator whenever the shared
+        P2PTransport.sendfile_window predicate (also used by the proxy)
+        allows it — a completed store for any window, or an in-progress
+        store whose requested range has fully landed. Returns
+        (response, byte_count) or (None, 0). The response owns a store pin
+        until the send finishes (upload-server discipline)."""
         window = P2PTransport.sendfile_window(attrs, rng, total)
         if window is None:
             return None, 0
@@ -251,7 +252,8 @@ class ObjectStorageService:
         range_header = None
         if rng is not None:
             range_header = f"bytes={offset}-{offset + count - 1}"
-        return (_PieceFileResponse(store.data_path, range_header, release),
+        return (_PieceFileResponse(store.data_path, range_header, release,
+                                   content_total=total),
                 count)
 
     async def _get_object_ranged_task(self, request: web.Request,

@@ -524,15 +524,27 @@ class PieceManager:
                           on_piece: PieceCallback | None = None) -> None:
         import os
 
+        from dragonfly2_tpu.storage.local_store import (
+            acquire_read_buffer,
+            release_read_buffer,
+        )
+
         size = os.path.getsize(path)
         piece_size = store.metadata.piece_size or compute_piece_size(size)
         total = compute_piece_count(size, piece_size)
         store.update_task(content_length=size, piece_size=piece_size, total_piece_count=total)
-        with open(path, "rb") as f:
-            for num in range(total):
-                data = f.read(piece_size)
-                t0 = time.monotonic()
-                await self._write_piece(store, num, data, on_piece, self._limiter, t0)
+        # One pooled buffer for the whole import (pieces land sequentially,
+        # the write digests+lands from the view before the next readinto).
+        buf = acquire_read_buffer(piece_size)
+        try:
+            with open(path, "rb") as f:
+                for num in range(total):
+                    n = f.readinto(buf)
+                    t0 = time.monotonic()
+                    await self._write_piece(store, num, buf[:n], on_piece,
+                                            self._limiter, t0)
+        finally:
+            release_read_buffer(buf)
 
     # -- whole-content digest ----------------------------------------------
 

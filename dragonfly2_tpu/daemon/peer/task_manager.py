@@ -29,6 +29,10 @@ from dragonfly2_tpu.storage import (
     StorageManager,
     TaskStoreMetadata,
 )
+from dragonfly2_tpu.storage.local_store import (
+    acquire_read_buffer,
+    release_read_buffer,
+)
 
 log = dflog.get("peer.task_manager")
 
@@ -800,6 +804,11 @@ class TaskManager:
                     attrs = self._stream_attrs(partial, task_id, peer_id,
                                                from_reuse=True)
                     attrs["range"] = rng
+                    # Landed window of an in-progress task: expose the
+                    # store so HTTP gateways sendfile the covered range
+                    # (sendfile_window re-checks coverage) instead of
+                    # iterating bytes through Python.
+                    attrs["local_store"] = partial
                     return attrs, self._stream_from_store(partial, rng)
 
         q = self.broker.subscribe(task_id)
@@ -848,6 +857,11 @@ class TaskManager:
         attrs = self._stream_attrs(store, task_id, peer_id)
         rng = self._resolve_range(req.range, attrs["content_length"])
         attrs["range"] = rng
+        # In-progress store exposed: if the requested window's pieces have
+        # already landed by the time the gateway/proxy picks a serving
+        # strategy, sendfile_window lets it skip the Python iterator
+        # entirely; otherwise it falls back to the ordered stream below.
+        attrs["local_store"] = store
         return attrs, self._StreamBody(
             self.broker, task_id, self._stream_ordered(task_id, store, run, q, rng), q)
 
@@ -927,23 +941,16 @@ class TaskManager:
             "from_reuse": from_reuse,
         }
 
-    @staticmethod
-    def _slice_piece(data: bytes, piece_offset: int, rng: Range | None) -> bytes:
-        if rng is None:
-            return data
-        lo = max(rng.start, piece_offset)
-        hi = (piece_offset + len(data) if rng.length < 0    # open end: to EOF
-              else min(rng.start + rng.length, piece_offset + len(data)))
-        if hi <= lo:
-            return b""
-        return data[lo - piece_offset:hi - piece_offset]
+    # Bound on one coalesced span read/yield: two fleet-default (4 MiB)
+    # pieces per submission; small-piece tasks batch many more.
+    _STREAM_SPAN = 8 << 20
 
     async def _stream_from_store(self, store, rng: Range | None) -> AsyncIterator[bytes]:
         """Completed task: emit the requested window straight off disk in
-        bounded spans (read_range — contiguous on a complete store),
-        touching only the bytes that intersect the range. The old per-piece
-        read + slice re-copied every partially-overlapping piece; span
-        reads walk the window's memory once."""
+        bounded spans (pooled preadv — contiguous on a complete store),
+        touching only the bytes that intersect the range. Yielded chunks
+        are BORROWED pooled views, valid until the consumer asks for the
+        next chunk (docs/ZERO_COPY.md rule 6); retainers must copy."""
         store.pin()
         try:
             m = store.metadata
@@ -959,7 +966,13 @@ class TaskManager:
             while off < end:
                 take = min(span, end - off)
                 chunk = await asyncio.to_thread(store.read_range, off, take)
-                yield chunk
+                try:
+                    yield chunk
+                finally:
+                    # Runs when the consumer resumes us (it is done with
+                    # the view) or closes the generator: either way the
+                    # buffer recycles for the next span.
+                    release_read_buffer(chunk)
                 off += take
         finally:
             store.unpin()
@@ -967,7 +980,11 @@ class TaskManager:
     async def _stream_ordered(self, task_id: str, store, run: _RunningTask,
                               q: asyncio.Queue, rng: Range | None) -> AsyncIterator[bytes]:
         """Running task: emit pieces in order as they land; pieces ahead of
-        the contiguous frontier wait in the store until the gap fills."""
+        the contiguous frontier wait in the store until the gap fills.
+        Adjacent landed pieces coalesce into ONE bounded pooled preadv
+        (batched submission) instead of a bytes() allocation per piece;
+        yielded chunks are borrowed pooled views (docs/ZERO_COPY.md
+        rule 6), valid until the next chunk is requested."""
         next_num = 0
         store.pin()
         try:
@@ -980,11 +997,32 @@ class TaskManager:
                             and (next_num + 1) * m.piece_size <= rng.start):
                         next_num += 1
                         continue
-                    data = store.read_piece(next_num)
-                    chunk = self._slice_piece(data, next_num * m.piece_size, rng)
-                    if chunk:
-                        yield chunk
-                    next_num += 1
+                    # Coalesce the landed run starting at next_num into one
+                    # span, bounded by _STREAM_SPAN and the range end.
+                    first = m.pieces[next_num]
+                    lo, hi = first.offset, first.offset + first.size
+                    last = next_num
+                    while hi - lo < self._STREAM_SPAN:
+                        nxt = m.pieces.get(last + 1)
+                        if nxt is None:
+                            break
+                        if rng is not None and rng.length >= 0 and \
+                                hi >= rng.start + rng.length:
+                            break
+                        hi = nxt.offset + nxt.size
+                        last = nxt.num
+                    if rng is not None:
+                        lo = max(lo, rng.start)
+                        if rng.length >= 0:
+                            hi = min(hi, rng.start + rng.length)
+                    if hi > lo:
+                        chunk = await asyncio.to_thread(
+                            store.read_range, lo, hi - lo)
+                        try:
+                            yield chunk
+                        finally:
+                            release_read_buffer(chunk)
+                    next_num = last + 1
                     # Past the requested range: nothing further to emit
                     # (open-ended ranges run to EOF).
                     if rng is not None and rng.length >= 0 and m.piece_size > 0 and \
@@ -1094,26 +1132,24 @@ class TaskManager:
                  start=rng.start, length=rng.length)
         try:
             with parent:  # pin: GC must not reclaim the parent mid-import
-                from dragonfly2_tpu.storage.local_store import (
-                    release_read_buffer,
-                )
-
-                for n in range(store.metadata.total_piece_count):
-                    if n in store.metadata.pieces:
-                        continue   # resume semantics match back-source
-                    off = n * piece_size
-                    size = min(piece_size, rng.length - off)
-                    data = await asyncio.to_thread(
-                        parent.read_range, rng.start + off, size)
-                    # Pooled view: written (and digested) in one pass,
-                    # then recycled for the next piece's read.
-                    try:
+                # ONE pooled buffer reused for every piece of the import:
+                # read_into fills it in place (unified read path), the
+                # write lands (and digests) straight from it.
+                buf = acquire_read_buffer(piece_size)
+                try:
+                    for n in range(store.metadata.total_piece_count):
+                        if n in store.metadata.pieces:
+                            continue   # resume semantics match back-source
+                        off = n * piece_size
+                        size = min(piece_size, rng.length - off)
+                        await asyncio.to_thread(
+                            parent.read_into, rng.start + off, size, buf)
                         rec = await asyncio.to_thread(
-                            store.write_piece, n, data)
-                    finally:
-                        release_read_buffer(data)
-                    if on_piece is not None:
-                        await on_piece(store, rec)
+                            store.write_piece, n, buf[:size])
+                        if on_piece is not None:
+                            await on_piece(store, rec)
+                finally:
+                    release_read_buffer(buf)
         except (StorageError, OSError) as e:
             log.warning("local range import failed; falling back to origin",
                         task=store.metadata.task_id[:16], error=str(e)[:200])

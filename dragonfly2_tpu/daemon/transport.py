@@ -92,27 +92,41 @@ class P2PTransport:
     @staticmethod
     def sendfile_window(attrs: dict, rng, total: int):
         """(store, offset, count) when a fetch's response can be served by
-        sendfile off a COMPLETED local store — the warm fast path shared by
-        the proxy and the object gateway. None when the bytes must stream
-        through the piece iterator: no store exposed, unknown total, a
-        partial store whose file size differs from the content total
-        (Content-Range math would corrupt), or an empty window
+        sendfile straight off the local store's data file — the fast path
+        shared by the proxy and the object gateway. Two eligible shapes:
+
+          - COMPLETED store (all pieces landed, file exactly the content):
+            whole-object or any in-bounds range.
+          - IN-PROGRESS store + a range whose bytes have all LANDED
+            (``covers_range``): pieces sit at their final offsets and
+            landed bytes are immutable, so the window rides sendfile while
+            the rest of the task is still downloading — a parent
+            mid-download never iterates served bytes through Python.
+
+        None when the bytes must stream through the piece iterator: no
+        store exposed, unknown total, an uncovered window, or an empty one
         (loop.sendfile rejects count=0, and a 0-byte body needs no fast
         path). Callers own pin/unpin around the actual send."""
         store = attrs.get("local_store")
         if store is None or total < 0:
             return None
-        try:
-            if os.path.getsize(store.data_path) != total:
+        m = store.metadata
+        complete = False
+        if m.done or store.is_complete():
+            # File size must equal the content exactly: a sparse tail or a
+            # stale truncation would corrupt whole-object Content-Length.
+            try:
+                complete = os.path.getsize(store.data_path) == total
+            except OSError:
                 return None
-        except OSError:
-            return None
         if rng is None:
-            return (store, 0, total) if total > 0 else None
+            return (store, 0, total) if complete and total > 0 else None
         count = min(rng.length, max(total - rng.start, 0))
         if count <= 0:
             return None
-        return store, rng.start, count
+        if complete or store.covers_range(rng.start, count):
+            return store, rng.start, count
+        return None
 
     async def fetch(self, url: str, headers: dict[str, str] | None = None):
         """Fetch through the P2P fabric. Returns (attrs, body_iterator).

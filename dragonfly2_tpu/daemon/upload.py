@@ -51,16 +51,32 @@ class _PieceFileResponse(web.FileResponse):
     releasing in the handler would let GC rmtree the data file mid-
     sendfile."""
 
-    def __init__(self, path, range_header: str | None, release):
+    def __init__(self, path, range_header: str | None, release,
+                 content_total: int | None = None):
         super().__init__(path)
         self._df_range = range_header  # None → whole file, plain 200
         self._df_prepared = False
         self._df_release = release
+        self._df_total = content_total
 
     def _df_done(self) -> None:
         release, self._df_release = self._df_release, None
         if release is not None:
             release()
+
+    async def _start(self, request):
+        # FileResponse derives Content-Range denominators from the FILE
+        # size. While a task is in progress the data file is shorter than
+        # the content (only a landed prefix/window exists), so the serve-
+        # from-in-progress fast path would advertise a lying complete-
+        # length; rewrite the denominator to the task's true content
+        # length just before the headers go out.
+        total = self._df_total
+        cr = self.headers.get("Content-Range")
+        if total is not None and total >= 0 and cr and "/" in cr:
+            span, _, _ = cr.rpartition("/")
+            self.headers["Content-Range"] = f"{span}/{total}"
+        return await super()._start(request)
 
     async def prepare(self, request):
         if self._df_prepared:
@@ -241,9 +257,11 @@ class UploadManager:
             # plus the user→kernel copy in sendmsg (benchmarks/fanout_bench
             # --profile showed the serving side dominated by exactly that).
             # Pin + slot transfer to the response (released after the send).
+            # content_total keeps Content-Range honest while the store is
+            # still mid-download (in-progress pieces serve the same way).
             return _PieceFileResponse(
                 store.data_path, f"bytes={start}-{start + length - 1}",
-                release)
+                release, content_total=store.metadata.content_length)
         except BaseException:
             release()
             raise

@@ -155,7 +155,8 @@ class PodShardedLoader:
             lambda key: GatewayRangeFetcher(store, bucket, key))
         self._coalesce_gap = coalesce_gap
         self._index_concurrency = max(1, index_concurrency)
-        self.pool = pool if pool is not None else BufferPool()
+        self.pool = pool if pool is not None else BufferPool(
+            name="dataset_span")
         self.indexes: list[tar_index.ShardIndex] | None = None
         self.readers: list[ShardReader] | None = None
 

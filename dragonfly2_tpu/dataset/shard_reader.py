@@ -85,15 +85,9 @@ class DaemonRangeFetcher:
                 f"ranged task returned {store.metadata.content_length}B "
                 f"for a {n}B span of {self.url}")
         with store:   # pin across the off-loop read
-            data = await asyncio.to_thread(store.read_range, 0, n)
-            try:
-                buf[:n] = data[:n]
-            finally:
-                from dragonfly2_tpu.storage.local_store import (
-                    release_read_buffer,
-                )
-
-                release_read_buffer(data)
+            # Unified read path: preadv straight into the caller's pooled
+            # span buffer — no intermediate store buffer, no copy.
+            await asyncio.to_thread(store.read_into, 0, n, buf)
         self.stats["reuse" if final.from_reuse else "cold"] += 1
         RANGE_READS.labels("reuse" if final.from_reuse else "cold").inc()
 
@@ -134,7 +128,8 @@ class ShardReader:
         # include_headers widens spans to the members' header blocks —
         # useful when re-emitting valid tar bytes rather than payloads.
         self.include_headers = include_headers
-        self.pool = pool if pool is not None else BufferPool()
+        self.pool = pool if pool is not None else BufferPool(
+            name="dataset_span")
 
     def sample_spans(self, sample: Sample) -> list[tuple[int, int]]:
         """Coalesced absolute byte spans covering the sample's members."""

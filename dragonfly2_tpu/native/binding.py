@@ -168,14 +168,26 @@ def write_chunk_crc(fd: int, offset: int, data, crc: int = 0) -> int:
     return out.value
 
 
-def read_piece_crc(fd: int, offset: int, size: int) -> tuple[bytes, int]:
-    """Fused pread+checksum; returns (data, crc32c)."""
-    buf = ctypes.create_string_buffer(size)
+def read_piece_crc_into(fd: int, offset: int, buf) -> tuple[int, int]:
+    """Fused pread+checksum into a caller-owned (usually pooled) writable
+    buffer — the native half of the unified read path: no per-piece
+    allocation, bytes land straight in the recycled view. Returns
+    (bytes_read, crc32c)."""
+    mv = buf if isinstance(buf, memoryview) else memoryview(buf)
+    arr = (ctypes.c_char * mv.nbytes).from_buffer(mv)
     out = ctypes.c_uint32(0)
-    n = _lib.df_read_piece_crc(fd, offset, buf, size, ctypes.byref(out))
+    n = _lib.df_read_piece_crc(fd, offset, arr, mv.nbytes, ctypes.byref(out))
     if n < 0:
         raise OSError(-n, os.strerror(-n))
-    return buf.raw[:n], out.value
+    return n, out.value
+
+
+def read_piece_crc(fd: int, offset: int, size: int) -> tuple[bytes, int]:
+    """Fused pread+checksum; returns (data, crc32c). Compatibility shape —
+    hot paths use read_piece_crc_into with a pooled buffer."""
+    buf = bytearray(size)
+    n, crc = read_piece_crc_into(fd, offset, buf)
+    return bytes(buf[:n]), crc
 
 
 def hash_pieces_crc(fd: int, offsets: list[int], sizes: list[int],

@@ -25,16 +25,26 @@ from dragonfly2_tpu.pkg.piece import compute_piece_count
 DATA_FILE = "data"
 METADATA_FILE = "metadata.json"
 
-# Pooled read buffers for range reads (ownership: docs/ZERO_COPY.md).
-# read_range hands out views over these; callers that recycle (the ranged
-# local-parent import) release via release_read_buffer, everyone else just
-# lets theirs be garbage-collected — the pool only ever retains returned
-# buffers, so forgetting to release costs reuse, never correctness.
-_READ_BUFFERS = BufferPool()
+# Pooled read buffers for the unified read path (ownership:
+# docs/ZERO_COPY.md). read_range/read_piece hand out views over these;
+# callers on recycling hot paths (span streaming, the ranged local-parent
+# import) release via release_read_buffer, everyone else just lets theirs
+# be garbage-collected — the pool only ever retains returned buffers, so
+# forgetting to release costs reuse, never correctness. The pool is
+# scrapeable as bufpool_*{pool="storage_read"}.
+_READ_BUFFERS = BufferPool(name="storage_read")
+
+
+def acquire_read_buffer(size: int) -> memoryview:
+    return _READ_BUFFERS.acquire(size)
 
 
 def release_read_buffer(view) -> None:
     _READ_BUFFERS.release(view)
+
+
+def read_buffer_stats() -> dict:
+    return _READ_BUFFERS.stats()
 
 _NATIVE = None
 _NATIVE_PROBED = False
@@ -256,13 +266,18 @@ class _PrefixHasher:
                 try:
                     remaining, off = rec.size, rec.offset
                     self.disk_reads += 1
-                    while remaining > 0:
-                        chunk = os.pread(fd, min(remaining, 4 << 20), off)
-                        if not chunk:
-                            raise OSError(f"short read at piece {rec.num}")
-                        self._h.update(chunk)  # GIL released for >2 KiB
-                        off += len(chunk)
-                        remaining -= len(chunk)
+                    mv = _READ_BUFFERS.acquire(min(remaining, 4 << 20))
+                    try:
+                        while remaining > 0:
+                            take = min(len(mv), remaining)
+                            n = os.preadv(fd, [mv[:take]], off)
+                            if n <= 0:
+                                raise OSError(f"short read at piece {rec.num}")
+                            self._h.update(mv[:n])  # GIL released for >2 KiB
+                            off += n
+                            remaining -= n
+                    finally:
+                        _READ_BUFFERS.release(mv)
                 except BaseException:
                     with self._cv:
                         self._busy = False
@@ -743,16 +758,74 @@ class LocalTaskStore:
             obs.piece_recorded(self.metadata.task_id, rec)
         return rec
 
-    def read_piece(self, num: int) -> bytes:
+    # -- unified read primitives (serve-side zero-copy, docs/ZERO_COPY.md) --
+    #
+    # ONE preadv engine under every read surface: read_into fills a caller
+    # (usually pooled) buffer, read_spans_into packs disjoint spans, and
+    # read_piece/read_range/export_range/validate/reverify are thin shapes
+    # over them — the aiohttp serve path, the gateway, the ranged
+    # local-parent import, and the dataset shard reader all read through
+    # here instead of carrying private pread+bytes loops.
+
+    def read_into(self, offset: int, length: int, buf, at: int = 0) -> None:
+        """Fill ``buf[at:at+length]`` with file bytes [offset, offset+length)
+        via preadv — no intermediate allocation. Raises StorageError on a
+        short read (EOF inside the span: the caller asked for bytes the
+        store never landed, or the file was truncated under us)."""
+        if length <= 0:
+            return
+        mv = buf if isinstance(buf, memoryview) else memoryview(buf)
+        if at + length > len(mv):
+            raise StorageError(
+                f"read buffer too small: need {at + length}, have {len(mv)}")
+        fd = self._ensure_fd()
+        got = 0
+        while got < length:
+            n = os.preadv(fd, [mv[at + got:at + length]], offset + got)
+            if n <= 0:
+                raise StorageError(
+                    f"short read at offset {offset + got}: "
+                    f"{got}/{length} bytes (EOF)")
+            got += n
+
+    def read_spans_into(self, spans, buf) -> int:
+        """Pack the byte spans ``[(offset, length), ...]`` back to back into
+        ``buf``; returns the total byte count. Spans may be disjoint (each
+        is one preadv run); a short read anywhere raises StorageError with
+        nothing partial hidden. This is the batched-submission primitive:
+        adjacent landed pieces coalesce into one span before submission
+        instead of one pread per piece."""
+        total = sum(length for _, length in spans)
+        mv = buf if isinstance(buf, memoryview) else memoryview(buf)
+        if total > len(mv):
+            raise StorageError(
+                f"read buffer too small: need {total}, have {len(mv)}")
+        at = 0
+        for offset, length in spans:
+            self.read_into(offset, length, mv, at=at)
+            at += length
+        self.touch()
+        return total
+
+    def read_piece_into(self, num: int, buf) -> PieceRecord:
+        """Read piece ``num``'s bytes into ``buf`` (pooled or caller-owned);
+        returns the piece record (size says how much of ``buf`` is valid)."""
         rec = self.metadata.pieces.get(num)
         if rec is None:
             raise StorageError(f"piece {num} not found", Code.StoragePieceNotFound)
-        fd = self._ensure_fd()
-        out = os.pread(fd, rec.size, rec.offset)
-        if len(out) != rec.size:
-            raise StorageError(f"piece {num} short read {len(out)} != {rec.size}")
-        self.touch()
-        return out
+        self.read_spans_into(((rec.offset, rec.size),), buf)
+        return rec
+
+    def read_piece(self, num: int) -> bytes:
+        """Piece bytes as a fresh ``bytes`` — the compatibility/oracle shape
+        (tests compare serve paths against it). Hot paths use
+        read_piece_into with a pooled buffer instead."""
+        rec = self.metadata.pieces.get(num)
+        if rec is None:
+            raise StorageError(f"piece {num} not found", Code.StoragePieceNotFound)
+        out = bytearray(rec.size)
+        self.read_spans_into(((rec.offset, rec.size),), out)
+        return bytes(out)
 
     def get_pieces(self, start_num: int = 0, limit: int = 0) -> list[PieceRecord]:
         """Contiguous-known pieces from start_num (upload-server listing —
@@ -841,10 +914,19 @@ class LocalTaskStore:
             # keep pread'ing in parallel with the re-hash below.
             ph.stop()
         h = pkgdigest.new_hasher(algorithm)
-        fd = self._ensure_fd()
-        for n in sorted(self.metadata.pieces):
-            rec = self.metadata.pieces[n]
-            h.update(os.pread(fd, rec.size, rec.offset))
+        mv = _READ_BUFFERS.acquire(4 << 20)
+        try:
+            for n in sorted(self.metadata.pieces):
+                rec = self.metadata.pieces[n]
+                remaining, off = rec.size, rec.offset
+                while remaining > 0:
+                    take = min(len(mv), remaining)
+                    self.read_into(off, take, mv)
+                    h.update(mv[:take])
+                    off += take
+                    remaining -= take
+        finally:
+            _READ_BUFFERS.release(mv)
         actual = f"{algorithm}:{h.hexdigest()}"
         if want and actual != want:
             raise StorageError(f"content digest mismatch: want {want}, got {actual}",
@@ -878,18 +960,22 @@ class LocalTaskStore:
                     if f"{pkgdigest.ALGORITHM_CRC32C}:{crc:08x}" != r.digest:
                         bad.append(r.num)
                 checked = {r.num for r in crc_recs}
-        for r in recs:
-            if r.num in checked or not r.digest:
-                continue
-            d = pkgdigest.parse(r.digest)
+        py_recs = [r for r in recs if r.num not in checked and r.digest]
+        if py_recs:
+            mv = _READ_BUFFERS.acquire(max(r.size for r in py_recs))
             try:
-                data = self.read_piece(r.num)
-            except (StorageError, OSError):
-                bad.append(r.num)  # short read / unreadable = bad piece
-                continue
-            actual = pkgdigest.hash_bytes(d.algorithm, data)
-            if actual.encoded != d.encoded:
-                bad.append(r.num)
+                for r in py_recs:
+                    d = pkgdigest.parse(r.digest)
+                    try:
+                        self.read_into(r.offset, r.size, mv)
+                    except (StorageError, OSError):
+                        bad.append(r.num)  # short read/unreadable = bad piece
+                        continue
+                    actual = pkgdigest.hash_bytes(d.algorithm, mv[:r.size])
+                    if actual.encoded != d.encoded:
+                        bad.append(r.num)
+            finally:
+                _READ_BUFFERS.release(mv)
         return sorted(bad)
 
     def covers_range(self, start: int, length: int) -> bool:
@@ -911,17 +997,14 @@ class LocalTaskStore:
         """Bytes ``[start, start+length)`` — caller must have checked
         ``covers_range`` first (pieces sit at ``num * piece_size``, so
         covered bytes are literally contiguous in the data file). Returns
-        a memoryview over one freshly-filled buffer: the old chunked
-        pread + ``b"".join`` walked the range's memory twice; preadv into
-        a single allocation walks it once."""
-        fd = self._ensure_fd()
+        a pooled memoryview filled by one preadv span (release via
+        ``release_read_buffer`` on recycling paths)."""
         mv = _READ_BUFFERS.acquire(length)
-        got = 0
-        while got < length:
-            n = os.preadv(fd, [mv[got:]], start + got)
-            if n <= 0:
-                raise StorageError(f"short read at offset {start + got}")
-            got += n
+        try:
+            self.read_spans_into(((start, length),), mv)
+        except BaseException:
+            _READ_BUFFERS.release(mv)
+            raise
         return mv
 
     def export_range(self, dest: str, start: int, length: int) -> None:
@@ -929,20 +1012,13 @@ class LocalTaskStore:
         off the data file in bounded spans (caller checks covers_range
         first — covered bytes are contiguous, so no per-piece slicing)."""
         os.makedirs(os.path.dirname(os.path.abspath(dest)), exist_ok=True)
-        fd = self._ensure_fd()
         mv = _READ_BUFFERS.acquire(min(4 << 20, length))
         try:
             remaining, off = length, start
             with open(dest, "wb") as out:
                 while remaining > 0:
                     take = min(len(mv), remaining)
-                    got = 0
-                    while got < take:
-                        n = os.preadv(fd, [mv[got:take]], off + got)
-                        if n <= 0:
-                            raise StorageError(
-                                f"short read at offset {off + got}")
-                        got += n
+                    self.read_into(off, take, mv)
                     out.write(mv[:take])
                     off += take
                     remaining -= take

@@ -108,6 +108,23 @@ class StorageManager:
     def tasks(self) -> list[LocalTaskStore]:
         return list(self._stores.values())
 
+    # -- unified read path (serve-side zero-copy) --------------------------
+    # Task-id-addressed shapes over LocalTaskStore's preadv primitives for
+    # serving layers that hold only an id (upload server, gateway). Both
+    # pin the store for the duration of the read so GC cannot rmtree the
+    # data file mid-preadv.
+
+    def read_piece_into(self, task_id: str, num: int, buf):
+        """Read one piece into ``buf``; returns its PieceRecord."""
+        with self.get(task_id) as store:
+            return store.read_piece_into(num, buf)
+
+    def read_spans_into(self, task_id: str, spans, buf) -> int:
+        """Pack byte spans of ``task_id``'s data file into ``buf``;
+        returns the total byte count."""
+        with self.get(task_id) as store:
+            return store.read_spans_into(spans, buf)
+
     # -- reuse lookups (reference storage_manager.go:529-698) --------------
 
     def find_completed_task(self, task_id: str) -> LocalTaskStore | None:

@@ -28,6 +28,7 @@ REQUIRED_CONFIGS = (
     "config6_stripe_sim",
     "config7_chaos",
     "config8_flight",
+    "ingest_micro",
 )
 
 
@@ -116,6 +117,36 @@ def test_flight_entry_paired_shape():
     assert entry["overhead_frac"] < 0.03, entry["overhead_frac"]
     assert entry["overhead_frac"] == pytest.approx(
         1.0 - on["mb_s"] / off["mb_s"], abs=1e-3)
+
+
+def test_ingest_micro_serve_round_paired_shape():
+    """The serve-side round is a PAIRED run on the same landed store:
+    the old per-piece bytes path and the unified zero-copy paths, with
+    the headline gain derived from the pair and holding the >=15%
+    acceptance bound (pooled preadv + sendfile vs read_piece bytes)."""
+    entry = _load()["published"]["ingest_micro"]
+    serve = entry["serve"]
+    for key in ("bytes_mbps", "pooled_mbps", "sendfile_mbps"):
+        assert serve[key] > 0, key
+    runs = serve["runs_mbps"]
+    assert set(runs) == {"bytes", "pooled", "sendfile"}
+    lens = {len(v) for v in runs.values()}
+    assert len(lens) == 1 and lens.pop() >= 2, "unpaired serve runs"
+    assert serve["gain_frac"] == pytest.approx(
+        serve["sendfile_mbps"] / serve["bytes_mbps"] - 1.0, abs=1e-2)
+    assert serve["gain_frac"] >= 0.15, serve
+
+
+def test_ingest_micro_hash_fallback_round():
+    """The CPU crc32c fallback is itself competitive: the selected
+    non-native backend must beat the old pure-Python table composition by
+    >=3x (acceptance bound; measured ~800x with google-crc32c)."""
+    entry = _load()["published"]["ingest_micro"]
+    hf = entry["hash_fallback"]
+    assert hf["backend"] in ("google-crc32c", "python")
+    assert hf["python_mbps"] > 0 and hf["fallback_mbps"] > 0
+    if hf["backend"] != "python":
+        assert hf["speedup"] >= 3.0, hf
 
 
 def test_stripe_sim_meets_acceptance_bounds():

@@ -155,7 +155,7 @@ def test_ranged_stream_served_from_partial_store(run_async, tmp_path):
             before = stats["gets"]
             attrs, body = await tm.start_stream_task(StreamTaskRequest(
                 url=url, range=Range(100, 2 * piece_size)))
-            got = b"".join([c async for c in body])
+            got = b"".join([bytes(c) async for c in body])
             assert got == CONTENT[100:100 + 2 * piece_size]
             assert attrs["from_reuse"]
             assert stats["gets"] == before  # nothing fetched
@@ -163,7 +163,7 @@ def test_ranged_stream_served_from_partial_store(run_async, tmp_path):
             # A range crossing missing pieces falls through to download.
             attrs2, body2 = await tm.start_stream_task(StreamTaskRequest(
                 url=url, range=Range(2 * piece_size, 2 * piece_size)))
-            got2 = b"".join([c async for c in body2])
+            got2 = b"".join([bytes(c) async for c in body2])
             assert got2 == CONTENT[2 * piece_size:4 * piece_size]
             assert not attrs2["from_reuse"]
             assert stats["gets"] > before

@@ -1,0 +1,372 @@
+"""The unified serve-side read path (ISSUE 6 tentpole).
+
+One preadv engine (LocalTaskStore.read_into / read_spans_into /
+read_piece_into over pooled buffers) now sits under every serve surface;
+these tests pin:
+
+  * primitive contracts — span packing, short-read/EOF edges, buffer
+    sizing, StorageManager's pinned task-id wrappers;
+  * byte-identical serving across the aiohttp upload server, the native
+    fused read, and the coalesced span stream, each against the
+    ``read_piece`` oracle;
+  * the in-progress sendfile window: landed windows of a still-
+    downloading task serve via sendfile with honest Content-Range
+    denominators, exact at piece boundaries;
+  * the leak guard: acquire/release balance across the new read path,
+    including the fault paths (truncated file mid-stream, closed
+    consumer), under chaos-style corruption;
+  * pool observability: bufpool_* metrics scrapeable via the shared
+    registry that pkg/metrics_server serves.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+
+import aiohttp
+import pytest
+
+from dragonfly2_tpu.daemon.transport import P2PTransport
+from dragonfly2_tpu.daemon.upload import UploadManager
+from dragonfly2_tpu.pkg import metrics
+from dragonfly2_tpu.pkg.bufpool import BufferPool
+from dragonfly2_tpu.pkg.errors import StorageError
+from dragonfly2_tpu.storage.local_store import (
+    LocalTaskStore,
+    TaskStoreMetadata,
+    read_buffer_stats,
+)
+from dragonfly2_tpu.storage.manager import StorageManager, StorageOption
+
+PIECE = 128 * 1024
+
+
+def _store_with_content(tmp_path, name="rp-task", pieces=4, tail=1000,
+                        done=True):
+    content = random.Random(5).randbytes((pieces - 1) * PIECE + tail)
+    total = pieces
+    store = LocalTaskStore.create(
+        str(tmp_path / name),
+        TaskStoreMetadata(task_id=name, content_length=len(content),
+                          piece_size=PIECE, total_piece_count=total))
+    for n in range(total):
+        store.write_piece(n, content[n * PIECE:(n + 1) * PIECE])
+    if done:
+        store.mark_done()
+    return store, content
+
+
+# -- primitives --------------------------------------------------------------
+
+def test_read_spans_into_packs_disjoint_spans(tmp_path):
+    store, content = _store_with_content(tmp_path)
+    buf = bytearray(PIECE)
+    spans = [(0, 100), (2 * PIECE + 7, 50), (PIECE, 200)]
+    n = store.read_spans_into(spans, buf)
+    assert n == 350
+    want = content[:100] + content[2 * PIECE + 7:2 * PIECE + 57] \
+        + content[PIECE:PIECE + 200]
+    assert bytes(buf[:n]) == want
+
+
+def test_read_spans_into_short_read_eof(tmp_path):
+    """A span reaching past EOF must raise, never hand back partial bytes
+    silently — the serve path's integrity depends on it."""
+    store, content = _store_with_content(tmp_path)
+    buf = bytearray(4096)
+    with pytest.raises(StorageError, match="short read|EOF"):
+        store.read_spans_into([(len(content) - 10, 4096)], buf)
+    # Zero-length spans are a no-op, not an error.
+    assert store.read_spans_into([(0, 0)], buf) == 0
+
+
+def test_read_spans_into_buffer_too_small(tmp_path):
+    store, _ = _store_with_content(tmp_path)
+    with pytest.raises(StorageError, match="too small"):
+        store.read_spans_into([(0, 100)], bytearray(50))
+    with pytest.raises(StorageError, match="too small"):
+        store.read_into(0, 100, bytearray(120), at=40)
+
+
+def test_read_piece_into_matches_oracle(tmp_path):
+    store, content = _store_with_content(tmp_path)
+    buf = bytearray(PIECE)
+    for n in range(4):
+        rec = store.read_piece_into(n, buf)
+        assert bytes(buf[:rec.size]) == store.read_piece(n)
+    with pytest.raises(StorageError, match="not found"):
+        store.read_piece_into(99, buf)
+
+
+def test_storage_manager_read_wrappers(tmp_path):
+    storage = StorageManager(StorageOption(data_dir=str(tmp_path / "d")))
+    content = random.Random(6).randbytes(2 * PIECE)
+    store = storage.register_task(TaskStoreMetadata(
+        task_id="mgr-task", content_length=len(content), piece_size=PIECE,
+        total_piece_count=2))
+    for n in range(2):
+        store.write_piece(n, content[n * PIECE:(n + 1) * PIECE])
+    buf = bytearray(2 * PIECE)
+    rec = storage.read_piece_into("mgr-task", 1, buf)
+    assert bytes(buf[:rec.size]) == content[PIECE:]
+    n = storage.read_spans_into("mgr-task", [(100, 300), (PIECE, 64)], buf)
+    assert bytes(buf[:n]) == content[100:400] + content[PIECE:PIECE + 64]
+    with pytest.raises(StorageError):
+        storage.read_piece_into("ghost", 0, buf)
+
+
+# -- byte-identical serve across paths vs the read_piece oracle --------------
+
+def test_native_read_into_matches_oracle(tmp_path):
+    from dragonfly2_tpu.storage.local_store import _native
+
+    nb = _native()
+    if nb is None:
+        pytest.skip("native library unavailable")
+    store, content = _store_with_content(tmp_path)
+    from dragonfly2_tpu.pkg import digest as pkgdigest
+
+    buf = bytearray(PIECE)
+    fd = store.data_fd()
+    for n in range(4):
+        rec = store.metadata.pieces[n]
+        got, crc = nb.read_piece_crc_into(fd, rec.offset, buf)
+        # read_piece_crc_into reads to the buffer's capacity or EOF;
+        # compare the piece window against the oracle.
+        assert got >= rec.size or rec.offset + got == len(content)
+        assert bytes(buf[:rec.size]) == store.read_piece(n)
+        if got == rec.size:
+            assert crc == pkgdigest.crc32c(store.read_piece(n))
+
+
+def test_aiohttp_upload_serve_matches_oracle(run_async, tmp_path):
+    """The aiohttp upload server (forced off the native fast path via a
+    rate limit) serves every piece and arbitrary ranges byte-identical to
+    the oracle, for a completed AND an in-progress store."""
+
+    async def body():
+        storage = StorageManager(StorageOption(data_dir=str(tmp_path / "d")))
+        content = random.Random(7).randbytes(3 * PIECE + 999)
+        store = storage.register_task(TaskStoreMetadata(
+            task_id="up-task", content_length=len(content), piece_size=PIECE,
+            total_piece_count=4))
+        for n in range(3):   # tail piece NOT landed: in-progress store
+            store.write_piece(n, content[n * PIECE:(n + 1) * PIECE])
+        upload = UploadManager(storage, rate_limit=1 << 40)
+        port = await upload.serve("127.0.0.1", 0)
+        assert upload._native_srv is None, "aiohttp path expected"
+        base = f"http://127.0.0.1:{port}/download/up/up-task"
+        try:
+            async with aiohttp.ClientSession() as http:
+                for n in range(3):
+                    async with http.get(base, params={"pieceNum": str(n)}) as r:
+                        assert r.status == 200 or r.status == 206
+                        assert await r.read() == store.read_piece(n)
+                # A landed window of the IN-PROGRESS store, spanning two
+                # pieces, with the Content-Range denominator naming the
+                # full content length (not the partial file size).
+                lo, hi = PIECE - 37, 2 * PIECE + 36
+                async with http.get(
+                        base, headers={"Range": f"bytes={lo}-{hi}"}) as r:
+                    assert r.status == 206
+                    assert await r.read() == content[lo:hi + 1]
+                    assert r.headers["Content-Range"].endswith(
+                        f"/{len(content)}")
+                # A window crossing the unlanded tail → 416.
+                async with http.get(
+                        base,
+                        headers={"Range":
+                                 f"bytes={3 * PIECE - 10}-{3 * PIECE + 10}"}) as r:
+                    assert r.status == 416
+        finally:
+            await upload.close()
+
+    run_async(body(), timeout=60)
+
+
+def _make_tm(storage):
+    from dragonfly2_tpu.daemon.peer.piece_manager import (
+        PieceManager,
+        PieceManagerOption,
+    )
+    from dragonfly2_tpu.daemon.peer.task_manager import TaskManager
+
+    return TaskManager(storage, PieceManager(PieceManagerOption()))
+
+
+def test_stream_span_path_matches_oracle(run_async, tmp_path):
+    """The coalesced pooled span stream (completed-store reuse) emits the
+    exact oracle bytes — whole object and ranges cut mid-piece on both
+    ends — and every pooled view it borrowed goes back to the pool
+    (acquire/release balance; rule 6 of docs/ZERO_COPY.md)."""
+
+    async def body():
+        from dragonfly2_tpu.daemon.peer.task_manager import StreamTaskRequest
+        from dragonfly2_tpu.pkg.piece import Range
+
+        storage = StorageManager(StorageOption(data_dir=str(tmp_path / "d")))
+        req = StreamTaskRequest(url="mem://span-oracle")
+        content = random.Random(8).randbytes(7 * PIECE + 123)
+        store = storage.register_task(TaskStoreMetadata(
+            task_id=req.task_id(), url=req.url,
+            content_length=len(content), piece_size=PIECE,
+            total_piece_count=8))
+        for n in range(8):
+            store.write_piece(n, content[n * PIECE:(n + 1) * PIECE])
+        store.mark_done()
+        tm = _make_tm(storage)
+        oracle = b"".join(store.read_piece(n) for n in range(8))
+        assert oracle == content
+        before = read_buffer_stats()
+        attrs, body_iter = await tm.start_stream_task(req)
+        assert attrs["from_reuse"] and attrs["local_store"] is store
+        got = b"".join([bytes(c) async for c in body_iter])
+        assert got == oracle
+        for rng in (Range(PIECE // 2, 3 * PIECE),       # mid-piece cuts
+                    Range(0, PIECE),                    # exact piece
+                    Range(6 * PIECE, 2 * PIECE)):       # tail overshoot
+            attrs, body_iter = await tm.start_stream_task(
+                StreamTaskRequest(url=req.url, range=rng))
+            got = b"".join([bytes(c) async for c in body_iter])
+            end = min(rng.start + rng.length, len(content))
+            assert got == content[rng.start:end], rng
+        after = read_buffer_stats()
+        assert after["outstanding"] == before["outstanding"], (before, after)
+
+    run_async(body(), timeout=60)
+
+
+# -- in-progress sendfile windows --------------------------------------------
+
+def test_sendfile_window_in_progress_piece_boundaries(tmp_path):
+    """sendfile_window on a mid-download store: landed windows (including
+    exact piece-boundary edges) are served; anything touching an unlanded
+    piece streams instead; whole-object still requires completion."""
+    from dragonfly2_tpu.pkg.piece import Range
+
+    content = random.Random(9).randbytes(4 * PIECE)
+    store = LocalTaskStore.create(
+        str(tmp_path / "ip-task"),
+        TaskStoreMetadata(task_id="ip-task", content_length=len(content),
+                          piece_size=PIECE, total_piece_count=4))
+    for n in (0, 1, 3):   # piece 2 missing: landed prefix is [0, 2*PIECE)
+        store.write_piece(n, content[n * PIECE:(n + 1) * PIECE])
+    attrs = {"local_store": store}
+    total = len(content)
+
+    def window(rng):
+        return P2PTransport.sendfile_window(attrs, rng, total)
+
+    # Landed prefix, exact piece boundary.
+    assert window(Range(0, 2 * PIECE)) == (store, 0, 2 * PIECE)
+    # One byte over the boundary into the missing piece → stream.
+    assert window(Range(0, 2 * PIECE + 1)) is None
+    # Window fully inside the landed tail piece.
+    assert window(Range(3 * PIECE, PIECE)) == (store, 3 * PIECE, PIECE)
+    # Window starting at the last landed byte of the prefix.
+    assert window(Range(2 * PIECE - 1, 1)) == (store, 2 * PIECE - 1, 1)
+    # Whole object on an incomplete store → stream.
+    assert window(None) is None
+    # Landing the gap piece makes the store complete → whole object ok.
+    store.write_piece(2, content[2 * PIECE:3 * PIECE])
+    assert window(None) == (store, 0, total)
+    assert window(Range(0, 2 * PIECE + 1)) == (store, 0, 2 * PIECE + 1)
+
+
+def test_sendfile_window_completed_semantics_unchanged(tmp_path):
+    """The pre-existing completed-store contract: file size must equal the
+    content total for whole-object windows; EOF-overshooting ranges clamp."""
+    from dragonfly2_tpu.pkg.piece import Range
+
+    store, content = _store_with_content(tmp_path, name="cw-task")
+    attrs = {"local_store": store}
+    total = len(content)
+    assert P2PTransport.sendfile_window(attrs, None, total) == (store, 0, total)
+    w = P2PTransport.sendfile_window(attrs, Range(total - 10, 100), total)
+    assert w == (store, total - 10, 10)
+    assert P2PTransport.sendfile_window(attrs, Range(total, 10), total) is None
+    assert P2PTransport.sendfile_window({}, None, total) is None
+    assert P2PTransport.sendfile_window(attrs, None, -1) is None
+
+
+# -- leak guard under faults -------------------------------------------------
+
+def test_read_path_leak_guard_under_faults(run_async, tmp_path):
+    """Acquire/release balance across the unified read path when reads
+    FAIL mid-serve: a data file truncated under the store (the chaos
+    truncate fault's storage-visible shape) and a consumer that abandons
+    the stream early must both return every borrowed pooled view."""
+
+    async def body():
+        from dragonfly2_tpu.daemon.peer.task_manager import StreamTaskRequest
+
+        storage = StorageManager(StorageOption(data_dir=str(tmp_path / "d")))
+        req = StreamTaskRequest(url="mem://leak-guard")
+        content = random.Random(10).randbytes(6 * PIECE)
+        store = storage.register_task(TaskStoreMetadata(
+            task_id=req.task_id(), url=req.url,
+            content_length=len(content), piece_size=PIECE,
+            total_piece_count=6))
+        for n in range(6):
+            store.write_piece(n, content[n * PIECE:(n + 1) * PIECE])
+        store.mark_done()
+        tm = _make_tm(storage)
+        before = read_buffer_stats()
+
+        # Early-abandoning consumer: one chunk, then aclose.
+        attrs, body_iter = await tm.start_stream_task(req)
+        async for c in body_iter:
+            assert bytes(c) == content[:len(c)]
+            break
+        await body_iter.aclose()
+
+        # Truncated-under-us data file: the stream raises, views return.
+        attrs, body_iter = await tm.start_stream_task(req)
+        store.close()
+        with open(store.data_path, "r+b") as f:
+            f.truncate(PIECE // 2)
+        with pytest.raises(Exception):
+            async for c in body_iter:
+                pass
+
+        # Direct primitive fault paths.
+        with pytest.raises(StorageError):
+            store.read_range(0, 2 * PIECE)
+        with pytest.raises((StorageError, OSError)):
+            store.export_range(str(tmp_path / "out.bin"), 0, 2 * PIECE)
+        after = read_buffer_stats()
+        assert after["outstanding"] == before["outstanding"], (before, after)
+
+    run_async(body(), timeout=60)
+
+
+# -- pool observability ------------------------------------------------------
+
+def test_bufpool_metrics_scrapeable():
+    """bufpool_* metrics land in the shared registry (what
+    pkg/metrics_server serves at /metrics), and stats() balances."""
+    pool = BufferPool(name="rp_test_pool")
+    v1 = pool.acquire(1024)
+    v2 = pool.acquire(2048)
+    pool.release(v1)
+    pool.release(v2)
+    v3 = pool.acquire(512)   # pooled hit
+    pool.release(v3)
+    s = pool.stats()
+    assert s["acquires"] == 3 and s["releases"] == 3
+    assert s["outstanding"] == 0
+    assert s["retained_bytes"] >= 1024 + 2048
+    body, _ = metrics.render()
+    text = body.decode()
+    acq = metrics.parse_labeled_samples(
+        text, "dragonfly_tpu_bufpool_acquires_total", "pool")
+    assert acq.get("rp_test_pool", 0) == 3
+    retained = [ln for ln in text.splitlines()
+                if ln.startswith("dragonfly_tpu_bufpool_retained_bytes")
+                and 'pool="rp_test_pool"' in ln]
+    assert retained and float(retained[0].rsplit(" ", 1)[1]) >= 3072
+    # The storage read pool is registered under its well-known name.
+    assert isinstance(read_buffer_stats()["retained_bytes"], int)
+

@@ -77,14 +77,14 @@ async def _stream_ordered(tmp_path):
     try:
         attrs, body = await tm.start_stream_task(StreamTaskRequest(url=url))
         assert attrs["content_length"] == len(BLOB)
-        got = b"".join([chunk async for chunk in body])
+        got = b"".join([bytes(chunk) async for chunk in body])
         assert got == BLOB
         assert not attrs["from_reuse"]
 
         # Second stream: reuse off the completed local store, zero origin hits.
         before = stats["blob_gets"]
         attrs2, body2 = await tm.start_stream_task(StreamTaskRequest(url=url))
-        got2 = b"".join([chunk async for chunk in body2])
+        got2 = b"".join([bytes(chunk) async for chunk in body2])
         assert got2 == BLOB and attrs2["from_reuse"]
         assert stats["blob_gets"] == before
     finally:
@@ -104,7 +104,7 @@ async def _stream_range(tmp_path):
     try:
         req = StreamTaskRequest(url=url, range=rng)
         attrs, body = await tm.start_stream_task(req)
-        got = b"".join([chunk async for chunk in body])
+        got = b"".join([bytes(chunk) async for chunk in body])
         assert got == BLOB[1_000_000:4_000_000]
         # The ranged reader returns early; the shared whole-task download
         # keeps going. Once it lands, ranged requests reuse the local store.
@@ -114,7 +114,7 @@ async def _stream_range(tmp_path):
             await asyncio.sleep(0.05)
         attrs2, body2 = await tm.start_stream_task(
             StreamTaskRequest(url=url, range=Range(0, 100)))
-        assert b"".join([c async for c in body2]) == BLOB[:100]
+        assert b"".join([bytes(c) async for c in body2]) == BLOB[:100]
         assert attrs2["from_reuse"]
     finally:
         tm.storage.close()
@@ -132,7 +132,7 @@ async def _stream_concurrent(tmp_path):
 
     async def read_all():
         attrs, body = await tm.start_stream_task(StreamTaskRequest(url=url))
-        return b"".join([chunk async for chunk in body])
+        return b"".join([bytes(chunk) async for chunk in body])
 
     try:
         results = await asyncio.gather(*[read_all() for _ in range(4)])
