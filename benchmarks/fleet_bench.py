@@ -1,0 +1,267 @@
+"""BASELINE config #9: fleet observatory overhead + resident-bytes bounds.
+
+The observatory (pkg/fleet) is ALWAYS ON in production schedulers, so its
+cost must be provably negligible and its memory provably bounded. Three
+paired rounds:
+
+  1. ``ingest`` — the scheduler's hottest ingest path
+     (``_handle_pieces_finished``) driven with a fixed report storm,
+     observatory on vs off, order-alternating rounds, per-side medians:
+     the honest per-event price in ns.
+  2. ``churn_sim`` — the REAL yardstick: the 1024-host DES churn sim
+     (benchmarks/pod_sim_bench.run_sim, the config5 machinery) paired
+     on/off, CPU-time medians over order-alternating rounds. The
+     acceptance budget (<= 3% observatory overhead in the DES sim) is
+     guarded on this number by tests/test_baseline_json.py.
+  3. ``resident`` — observatory resident bytes after a 1024-host and a
+     4096-host sim: the bound must be flat in host count (preallocated
+     time-series + decision ring; scorecards LRU-capped).
+
+Usage:
+  python benchmarks/fleet_bench.py [--hosts 1024] [--rounds 3]
+                                   [--quick] [--publish]
+
+Publishes BASELINE.json["published"]["config9_fleet"].
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import resource as _resource
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from dragonfly2_tpu.scheduler.config import SchedulerConfig  # noqa: E402
+from dragonfly2_tpu.scheduler.service import SchedulerService  # noqa: E402
+
+from benchmarks.pod_sim_bench import (  # noqa: E402
+    check_churn_behavior,
+    run_sim,
+)
+
+
+# --------------------------------------------------------------------- #
+# Round 1: report-ingest micro (per-event ns, on vs off)
+# --------------------------------------------------------------------- #
+
+def _ingest_pass(fleet_on: bool, hosts: int, pieces_per_host: int,
+                 batch: int) -> float:
+    """One report storm through the real service ingest path; returns
+    seconds of CPU time for the report loop."""
+    cfg = SchedulerConfig()
+    cfg.fleet.enabled = fleet_on
+    svc = SchedulerService(cfg)
+    mk = lambda i: {  # noqa: E731
+        "host": {"id": f"h{i}", "hostname": f"h{i}", "ip": "10.0.0.1",
+                 "port": 1, "upload_port": 2,
+                 "tpu_slice": f"s{i // 16}", "tpu_worker_index": i % 16},
+        "peer_id": f"p{i}", "task_id": "bench-task", "url": "http://o/f"}
+    peers = []
+    task = None
+    for i in range(hosts):
+        _h, task, peer = svc._resolve(mk(i))
+        peers.append(peer)
+    # Every peer reports every piece (a broadcast), served by its ring
+    # neighbor — dst_peer_id exercises the serve-side scorecard path.
+    batches = []
+    for i, peer in enumerate(peers):
+        parent_id = f"p{(i + 1) % hosts}"
+        for start in range(0, pieces_per_host, batch):
+            batches.append((peer, {"pieces": [
+                {"piece_num": n, "range_start": n * 65536,
+                 "range_size": 65536, "download_cost_ms": 5,
+                 "dst_peer_id": parent_id,
+                 "timings": {"dcn_ms": 4, "stall_ms": 0, "store_ms": 1}}
+                for n in range(start, min(start + batch,
+                                          pieces_per_host))]}))
+    t0 = time.process_time()
+    for peer, msg in batches:
+        svc._handle_pieces_finished(msg, task, peer)
+    return time.process_time() - t0
+
+
+def run_ingest(rounds: int, hosts: int = 64, pieces_per_host: int = 1024,
+               batch: int = 16) -> dict:
+    events = hosts * pieces_per_host
+    on, off, ratios = [], [], []
+    _ingest_pass(False, hosts, pieces_per_host, batch)   # warm-up
+    for i in range(rounds):
+        first = bool(i % 2)
+        a = _ingest_pass(first, hosts, pieces_per_host, batch)
+        b = _ingest_pass(not first, hosts, pieces_per_host, batch)
+        t_on, t_off = (a, b) if first else (b, a)
+        on.append(t_on)
+        off.append(t_off)
+        ratios.append(t_on / t_off)
+    on_min, off_min = min(on), min(off)
+    return {
+        "events": events,
+        "hosts": hosts,
+        "batch": batch,
+        "rounds": rounds,
+        "on_ns_per_event": round(on_min / events * 1e9, 1),
+        "off_ns_per_event": round(off_min / events * 1e9, 1),
+        # Median of adjacent paired ratios with alternating leads — see
+        # run_churn_paired for why per-side aggregates are biased here.
+        "overhead_frac": round(_median(ratios) - 1.0, 4),
+    }
+
+
+# --------------------------------------------------------------------- #
+# Round 2/3: paired DES churn sim + resident bounds
+# --------------------------------------------------------------------- #
+
+def _sim_pass(hosts: int, fleet_on: bool, churn: bool = True) -> dict:
+    # report_batch=8: the wire real daemons speak — the conductor flushes
+    # coalesced report batches (that is why _handle_pieces_finished
+    # exists). The observatory's per-batch amortization is part of its
+    # design, so the overhead is measured on the batch path.
+    result = asyncio.run(run_sim(
+        hosts, churn=churn, churn_waves=3 if churn else 1,
+        fleet=fleet_on, report_batch=8))
+    if churn:
+        check_churn_behavior(result)
+    return {
+        "wall_s": result["wall_s"],
+        "cpu_s": result["cpu_s"],
+        "rss_peak_mb": result["rss_peak_mb"],
+        "max_loop_lag_ms": result["max_loop_lag_ms"],
+        "fleet": result["fleet"],
+    }
+
+
+def _median(vals: list) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2.0
+
+
+def run_churn_paired(hosts: int, rounds: int) -> tuple[dict, dict]:
+    """MEDIAN of adjacent paired ratios over order-alternating rounds.
+    This box's CPU-time readings drift monotonically several percent
+    across a batch (shared small VM), which biases per-side aggregates:
+    the side holding the globally-first slot always looks faster. Each
+    round runs the two sides back-to-back (drift within a pair is a
+    fraction of a percent) and alternates which side leads, so the
+    per-pair ratio cancels drift to first order; the median across
+    rounds drops interference outliers."""
+    on, off, ratios = [], [], []
+    _sim_pass(hosts, True)        # warm-up discarded (allocator, imports)
+    if rounds % 2:
+        rounds += 1               # even rounds: each side leads equally
+    for i in range(rounds):
+        first = bool(i % 2)
+        a = _sim_pass(hosts, first)
+        b = _sim_pass(hosts, not first)
+        r_on, r_off = (a, b) if first else (b, a)
+        on.append(r_on)
+        off.append(r_off)
+        ratios.append(r_on["cpu_s"] / r_off["cpu_s"])
+    on.sort(key=lambda r: r["cpu_s"])
+    off.sort(key=lambda r: r["cpu_s"])
+    on_min, off_min = on[0], off[0]
+    churn = {
+        "hosts": hosts,
+        "rounds": rounds,
+        "on": {k: v for k, v in on_min.items() if k != "fleet"},
+        "off": {k: v for k, v in off_min.items() if k != "fleet"},
+        "runs_cpu_s": {"on": [r["cpu_s"] for r in on],
+                       "off": [r["cpu_s"] for r in off]},
+        "pair_ratios": [round(r, 4) for r in ratios],
+        "cpu_overhead_frac": round(_median(ratios) - 1.0, 4),
+    }
+    resident_small = on_min["fleet"]["resident_bytes"]
+    return churn, {"bytes_small": resident_small,
+                   "hosts_small": hosts,
+                   "decisions_small": on_min["fleet"]["decisions_total"],
+                   "scorecard_hosts_small":
+                       on_min["fleet"]["scorecard_hosts"]}
+
+
+def run_resident_large(hosts: int) -> dict:
+    """The 4x-host run proving the bound is flat in host count. No churn
+    (the flatness claim is about resident structures, not fault paths)
+    and a faster piece clock to keep the bench's wall time sane."""
+    r = _sim_pass(hosts, True, churn=False)
+    return {"bytes_large": r["fleet"]["resident_bytes"],
+            "hosts_large": hosts,
+            "decisions_large": r["fleet"]["decisions_total"],
+            "scorecard_hosts_large": r["fleet"]["scorecard_hosts"]}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hosts", type=int, default=1024)
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--quick", action="store_true",
+                    help="256/1024 hosts instead of 1024/4096")
+    ap.add_argument("--publish", action="store_true")
+    args = ap.parse_args()
+
+    hosts = 256 if args.quick else args.hosts
+    large = hosts * 4
+
+    ingest = run_ingest(args.rounds)
+    print(json.dumps({"ingest": ingest}), flush=True)
+    churn, resident = run_churn_paired(hosts, args.rounds)
+    print(json.dumps({"churn_sim": churn}), flush=True)
+    resident.update(run_resident_large(large))
+    resident["ratio"] = round(
+        resident["bytes_large"] / resident["bytes_small"], 3)
+    cfg = SchedulerConfig().fleet
+    resident["bounds"] = {
+        "timeseries_buckets": cfg.buckets,
+        "decision_cap": cfg.decision_cap,
+        "scorecard_max_hosts": cfg.scorecard_hosts,
+    }
+
+    result = {
+        "ingest": ingest,
+        "churn_sim": churn,
+        "resident": resident,
+        "note": ("paired observatory on/off: ingest = the real "
+                 "_handle_pieces_finished storm (per-event ns); churn_sim "
+                 "= the 1024-host DES churn sim (config5 machinery) with "
+                 "the <=3% acceptance budget on CPU time; both estimate "
+                 "overhead as the MEDIAN of adjacent paired ratios over "
+                 "order-alternating rounds (this box's cpu-time readings "
+                 "drift monotonically several % across a batch, biasing "
+                 "any per-side aggregate; back-to-back pairs cancel the "
+                 "drift to first order); resident = observatory bytes "
+                 "after small vs 4x-host sims (preallocated rings + "
+                 "LRU-capped scorecards + saturated decision ring => "
+                 "flat)"),
+    }
+    print(json.dumps(result))
+
+    if churn["cpu_overhead_frac"] > 0.03:
+        print(f"FAIL: observatory DES-sim overhead "
+              f"{churn['cpu_overhead_frac']:.2%} exceeds the 3% budget",
+              file=sys.stderr)
+        return 1
+    if resident["ratio"] > 1.5:
+        print(f"FAIL: resident bytes grew {resident['ratio']}x between "
+              f"{hosts} and {large} hosts — the bound is not flat",
+              file=sys.stderr)
+        return 1
+
+    if args.publish:
+        path = os.path.join(REPO, "BASELINE.json")
+        doc = json.load(open(path))
+        doc.setdefault("published", {})["config9_fleet"] = result
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

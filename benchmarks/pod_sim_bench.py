@@ -93,7 +93,8 @@ def _open_body(i: int) -> dict:
 async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
                   arrival_window_s: float = 1.0,
                   churn: bool = False, churn_waves: int = 1,
-                  gc_ttl_s: float = 1.0) -> dict:
+                  gc_ttl_s: float = 1.0, fleet: bool = True,
+                  report_batch: int = 1) -> dict:
     """``churn=True`` kills whole slices mid-fan-out (their peers' streams
     drop after a few pieces, no finish) and sends straggler waves into the
     SAME slices late — ``churn_waves`` slices die at staggered times, so
@@ -112,6 +113,9 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
     # well above any single peer's in-run idle gap.
     cfg.gc.peer_ttl = cfg.gc.task_ttl = cfg.gc.host_ttl = max(
         gc_ttl_s, arrival_window_s + 60 * piece_latency_s)
+    # ``fleet=False`` is the paired control for fleet_bench's observatory
+    # overhead measurement (config9_fleet).
+    cfg.fleet.enabled = fleet
     svc = SchedulerService(cfg)
 
     n_slices = max(1, n_hosts // HOSTS_PER_SLICE)
@@ -222,6 +226,7 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
                 "content_length": N_PIECES * PIECE_SIZE,
                 "piece_size": PIECE_SIZE,
                 "total_piece_count": N_PIECES})
+            pending: list = []
             for n in range(N_PIECES):
                 if n == die_after:
                     # Slice kill: the stream drops mid-download, no
@@ -232,13 +237,26 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
                         dead_by_slice.get(i // HOSTS_PER_SLICE, 0) + 1
                     return
                 await asyncio.sleep(piece_latency_s * rng.uniform(0.5, 1.5))
-                await stream.to_sched.put({
-                    "type": "piece_finished",
-                    "piece": {"piece_num": n,
+                wire_piece = {"piece_num": n,
                               "range_start": n * PIECE_SIZE,
                               "range_size": PIECE_SIZE,
                               "digest": "", "download_cost_ms": 2,
-                              "dst_peer_id": ""}})
+                              "dst_peer_id": ""}
+                if report_batch <= 1:
+                    # Classic config5 wire: one report per piece.
+                    await stream.to_sched.put({"type": "piece_finished",
+                                               "piece": wire_piece})
+                    continue
+                # Coalesced wire (what real daemons send — conductor
+                # flushes report batches; fleet_bench measures this path).
+                pending.append(wire_piece)
+                if len(pending) >= report_batch:
+                    await stream.to_sched.put({"type": "pieces_finished",
+                                               "pieces": pending})
+                    pending = []
+            if pending:
+                await stream.to_sched.put({"type": "pieces_finished",
+                                           "pieces": pending})
             await stream.to_sched.put({
                 "type": "download_finished",
                 "content_length": N_PIECES * PIECE_SIZE,
@@ -260,6 +278,7 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
     gc.freeze()
     hb = asyncio.ensure_future(heartbeat())
     t0 = time.perf_counter()
+    cpu0 = time.process_time()
     try:
         async def delayed(i):
             # Host 0 leads (the preheat/seed analog — config #5 preheats
@@ -294,6 +313,11 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
         hb.cancel()
         gc.unfreeze()
     wall = time.perf_counter() - t0
+    # Scheduler CPU for the storm itself — read BEFORE the TTL sweep and
+    # the fleet-stats export below (resident_bytes is a deliberate deep
+    # walk; booking it into cpu_s would poison fleet_bench's paired
+    # per-event overhead comparison).
+    cpu_s = time.process_time() - cpu0
     rss_peak = _resource.getrusage(_resource.RUSAGE_SELF).ru_maxrss / 1024
 
     # TTL sweep: a pod-scale run must not leave registry residue. All
@@ -318,6 +342,16 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
     # With churn: each killed slice (HOSTS_PER_SLICE peers) is replaced by
     # an equal straggler wave — the target count is n_hosts either way.
     expected_finishers = n_hosts
+    fleet_stats = None
+    if svc.fleet is not None:
+        win = svc.fleet.series.window(3600)
+        fleet_stats = {
+            "resident_bytes": svc.fleet.resident_bytes(),
+            "decisions_total": svc.fleet.decisions.recorded_total,
+            "pieces_landed": win["totals"]["pieces_landed"],
+            "registers": win["totals"]["registers"],
+            "scorecard_hosts": len(svc.fleet.scorecards._hosts),
+        }
     return {
         "config": "pod-fanout-sim" + ("-churn" if churn else ""),
         "hosts": n_hosts,
@@ -356,11 +390,14 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
             (statistics.median(lag_samples) if lag_samples else 0.0) * 1000,
             2),
         "wall_s": round(wall, 2),
+        "cpu_s": round(cpu_s, 3),
         "rss_start_mb": round(rss_start, 1),
         "rss_peak_mb": round(rss_peak, 1),
         "registry_peak": registry_sizes,
         **after_gc,
         "host_cores": os.cpu_count(),
+        "fleet_enabled": fleet,
+        "fleet": fleet_stats,
     }
 
 

@@ -154,6 +154,9 @@ class DaemonConfig:
     metrics_port: int = 0
     manager_addr: str = ""          # manager drpc for dynconfig (stage 4)
     seed_peer: bool = False
+    # Flight-recorder post-mortem bundles kept on disk (newest-N rotation
+    # in pkg/flight; a crash-looping task must not fill the log volume).
+    flight_keep_bundles: int = 32
 
     def __post_init__(self):
         if not self.work_home:

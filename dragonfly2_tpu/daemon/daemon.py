@@ -296,6 +296,7 @@ class Daemon:
         recorder = flightlib.recorder()
         if not recorder.dump_dir:
             recorder.dump_dir = self.config.dfpath.log_dir
+        recorder.keep_bundles = self.config.flight_keep_bundles
         if self.config.metrics_port >= 0:
             from dragonfly2_tpu.pkg.metrics_server import MetricsServer
 

@@ -438,7 +438,7 @@ class FlightRecorder:
     tuples regardless of how many tasks a daemon serves)."""
 
     def __init__(self, *, capacity: int = 2048, max_tasks: int = 128,
-                 dump_dir: str = "", keep_bundles: int = 16):
+                 dump_dir: str = "", keep_bundles: int = 32):
         self.capacity = capacity
         self.max_tasks = max_tasks
         self.dump_dir = dump_dir
@@ -520,13 +520,26 @@ class FlightRecorder:
             pass
 
     def _prune(self) -> None:
+        """Newest-``keep_bundles`` rotation: a crash-looping task dumping
+        a bundle per attempt must not grow the log volume forever. mtime
+        orders; the filename's ms stamp breaks same-second ties."""
+
+        def stamp(path: str) -> int:
+            tail = path.rsplit("-", 1)[-1]
+            try:
+                return int(tail[:-len(".json")])
+            except ValueError:
+                return 0
+
         try:
             bundles = sorted(
                 (os.path.join(self.dump_dir, name)
                  for name in os.listdir(self.dump_dir)
                  if name.startswith("flight-") and name.endswith(".json")),
-                key=os.path.getmtime)
-            for path in bundles[:-self.keep_bundles]:
+                key=lambda p: (os.path.getmtime(p), stamp(p)))
+            drop = bundles[:-self.keep_bundles] if self.keep_bundles > 0 \
+                else bundles
+            for path in drop:
                 os.unlink(path)
         except OSError:
             pass

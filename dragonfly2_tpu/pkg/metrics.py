@@ -85,6 +85,26 @@ def parse_labeled_samples(text: str, full_name: str,
     return out
 
 
+def families() -> list[dict]:
+    """Every registered metric family: ``{name, kind, doc, labels}``
+    (name WITHOUT the namespace prefix — what callers registered). The
+    metrics-name lint test walks this to enforce the
+    ``{component}_{noun}[_{unit}][_total]`` convention and the
+    docs/OBSERVABILITY.md documentation requirement."""
+    kind_names = {Counter: "counter", Gauge: "gauge",
+                  Histogram: "histogram"}
+    with _lock:
+        return [
+            {
+                "name": name,
+                "kind": kind_names.get(type(m), type(m).__name__.lower()),
+                "doc": m._documentation,
+                "labels": tuple(m._labelnames),
+            }
+            for name, m in sorted(_metrics.items())
+        ]
+
+
 def render() -> tuple[bytes, str]:
     """Render the registry for an HTTP /metrics endpoint."""
     return generate_latest(_registry), CONTENT_TYPE_LATEST

@@ -14,7 +14,13 @@ Routes: GET /metrics (Prometheus text), GET /healthy,
         GET /debug/flight/{task_id}[?format=text] (critical-path autopsy:
         phase breakdown + per-piece waterfall, JSON or rendered text),
         GET /debug/pod/{task_id} (scheduler-side per-host straggler
-        attribution from piece-report timings).
+        attribution from piece-report timings),
+        GET /debug/fleet[?window=seconds] (cluster health time-series),
+        GET /debug/fleet/hosts (cross-task host scorecards + straggler
+        flags), GET /debug/fleet/decisions?host=|task=|kind=|n= (the
+        scheduling decision audit log), GET /debug/fleet/info (scheduler
+        uptime / build / config snapshot). All fleet routes are backed by
+        the bounded pkg/fleet observatory the scheduler passes in.
 """
 
 from __future__ import annotations
@@ -56,11 +62,14 @@ def _task_dump() -> str:
 
 class MetricsServer:
     def __init__(self, *, flight: "flightlib.FlightRecorder | None" = None,
-                 pod_flight: "flightlib.PodAggregator | None" = None):
+                 pod_flight: "flightlib.PodAggregator | None" = None,
+                 fleet=None):
         # Optional providers: the daemon passes its flight recorder, the
-        # scheduler its pod aggregator; endpoints 404 without one.
+        # scheduler its pod aggregator + fleet observatory; endpoints 404
+        # without one.
         self._flight = flight
         self._pod_flight = pod_flight
+        self._fleet = fleet
         self._runner: web.AppRunner | None = None
         self._port = 0
         self._profiling = False
@@ -76,6 +85,10 @@ class MetricsServer:
         app.router.add_get("/debug/flight", self._flight_index)
         app.router.add_get("/debug/flight/{task_id}", self._flight_task)
         app.router.add_get("/debug/pod/{task_id}", self._pod_task)
+        app.router.add_get("/debug/fleet", self._fleet_snapshot)
+        app.router.add_get("/debug/fleet/hosts", self._fleet_hosts)
+        app.router.add_get("/debug/fleet/decisions", self._fleet_decisions)
+        app.router.add_get("/debug/fleet/info", self._fleet_info)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, host, port)
@@ -170,6 +183,53 @@ class MetricsServer:
         if report is None:
             raise web.HTTPNotFound(text=f"no pod data for {task_id}\n")
         return web.json_response(report)
+
+    def _need_fleet(self):
+        if self._fleet is None:
+            raise web.HTTPNotFound(text="no fleet observatory on this "
+                                        "binary (scheduler-only)\n")
+        return self._fleet
+
+    async def _fleet_snapshot(self, request: web.Request) -> web.Response:
+        """Cluster health time-series: counters/gauges over the trailing
+        ``?window=`` seconds (default 600, clamped to the ring)."""
+        fleet = self._need_fleet()
+        try:
+            window = max(1.0, float(request.query.get("window", "600")))
+        except ValueError:
+            return web.Response(text="bad window value\n", status=400)
+        return web.json_response(fleet.snapshot(window))
+
+    async def _fleet_hosts(self, request: web.Request) -> web.Response:
+        """Cross-task host scorecards: serve/download EWMAs, decayed
+        failure counts, upload load, straggler flags with robust z."""
+        fleet = self._need_fleet()
+        try:
+            limit = min(max(int(request.query.get("n", "256")), 1), 4096)
+        except ValueError:
+            return web.Response(text="bad n value\n", status=400)
+        return web.json_response(fleet.hosts_report(limit))
+
+    async def _fleet_decisions(self, request: web.Request) -> web.Response:
+        """The scheduling decision audit log, newest first, filterable by
+        ?host= / ?task= / ?kind= (handout, quarantine, back_source,
+        stripe_handout, stripe_reshuffle, straggler_filter,
+        schedule_failed), ?n= caps the page."""
+        fleet = self._need_fleet()
+        try:
+            limit = min(max(int(request.query.get("n", "256")), 1), 4096)
+        except ValueError:
+            return web.Response(text="bad n value\n", status=400)
+        return web.json_response(fleet.decisions.query(
+            host=request.query.get("host", ""),
+            task=request.query.get("task", ""),
+            kind=request.query.get("kind", ""),
+            limit=limit))
+
+    async def _fleet_info(self, request: web.Request) -> web.Response:
+        """Scheduler identity card: uptime, build, config snapshot, and
+        the observatory's own bounds + resident bytes."""
+        return web.json_response(self._need_fleet().info())
 
     async def _heap(self, request: web.Request) -> web.Response:
         """Heap allocation snapshot via tracemalloc (armed on first call;

@@ -66,6 +66,26 @@ class SchedulingConfig:
 
 
 @dataclass
+class FleetConfig:
+    """Fleet observatory bounds (pkg/fleet): the continuous scheduler-side
+    cluster view. All structures are preallocated/bounded — these knobs
+    size them; ``enabled=False`` removes the per-event hooks entirely
+    (fleet_bench publishes the paired on/off overhead)."""
+
+    enabled: bool = True
+    bucket_s: float = 5.0          # time-series bucket width
+    buckets: int = 720             # ring length (5s x 720 = 1h)
+    decision_cap: int = 1024       # audit-log ring length
+    scorecard_hosts: int = 1024    # per-host scorecards kept (LRU past it)
+    straggler_z: float = 3.0       # robust z-score flag threshold
+    min_serve_samples: int = 8     # serve EWMA samples before scoring
+    min_population: int = 8        # scored hosts before anyone is flagged
+    # Advisory candidate filter: flagged stragglers are dropped from
+    # parent candidate sets (each drop is recorded in the decision log).
+    straggler_filter: bool = True
+
+
+@dataclass
 class GCConfig:
     peer_ttl: float = PEER_TTL
     host_ttl: float = HOST_TTL
@@ -78,6 +98,7 @@ class SchedulerConfig:
     server: SchedulerServerConfig = field(default_factory=SchedulerServerConfig)
     scheduling: SchedulingConfig = field(default_factory=SchedulingConfig)
     gc: GCConfig = field(default_factory=GCConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
     manager_addr: str = ""                 # manager drpc for registration
     cluster_id: int = 1
     # Durable persistent-cache state (reference: Redis-backed

@@ -149,6 +149,18 @@ class HostManager:
     def all(self) -> list[Host]:
         return list(self._hosts.values())
 
+    def counts(self) -> dict:
+        """Fleet-gauge summary (pkg/fleet sampler): hosts by state. One
+        O(hosts) scan, called at bucket-rotation cadence, not per event."""
+        total = seed = quarantined = 0
+        for h in self._hosts.values():
+            total += 1
+            if h.is_seed():
+                seed += 1
+            if h.quarantined():
+                quarantined += 1
+        return {"total": total, "seed": seed, "quarantined": quarantined}
+
     def gc(self) -> list[str]:
         now = time.time()
         dead = [h.id for h in self._hosts.values()

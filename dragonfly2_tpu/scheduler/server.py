@@ -75,8 +75,10 @@ class SchedulerServer:
             from dragonfly2_tpu.pkg.metrics_server import MetricsServer
 
             # Loopback by default — /debug exposes live stacks; the pod
-            # aggregator adds /debug/pod/<task_id> straggler attribution.
-            self.metrics = MetricsServer(pod_flight=self.service.pod_flight)
+            # aggregator adds /debug/pod/<task_id> straggler attribution
+            # and the fleet observatory the /debug/fleet* family.
+            self.metrics = MetricsServer(pod_flight=self.service.pod_flight,
+                                         fleet=self.service.fleet)
             await self.metrics.serve("127.0.0.1", self.config.metrics_port)
         self.gc.serve()
         if self.config.manager_addr:

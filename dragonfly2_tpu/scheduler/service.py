@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 
 from dragonfly2_tpu.pkg import aio, dflog
+from dragonfly2_tpu.pkg import fleet as fleetlib
 from dragonfly2_tpu.pkg import flight as flightlib
 from dragonfly2_tpu.pkg.errors import Code, DfError
 from dragonfly2_tpu.pkg.fsm import TransitionError
@@ -93,6 +94,55 @@ class SchedulerService:
         # /debug/pod/<task_id> (scheduler/server wires it into the
         # MetricsServer).
         self.pod_flight = flightlib.PodAggregator()
+        # Fleet observatory (pkg/fleet): bounded cluster time-series +
+        # cross-task host scorecards + scheduling decision audit log, fed
+        # from the report paths below and served at /debug/fleet* by the
+        # scheduler's MetricsServer. The scorecard straggler flag feeds
+        # an advisory filter into scheduling._is_candidate.
+        fc = self.config.fleet
+        self.fleet: "fleetlib.FleetObservatory | None" = None
+        if fc.enabled:
+            self.fleet = fleetlib.FleetObservatory(
+                bucket_s=fc.bucket_s, buckets=fc.buckets,
+                decision_cap=fc.decision_cap, max_hosts=fc.scorecard_hosts,
+                straggler_z=fc.straggler_z,
+                min_serve_samples=fc.min_serve_samples,
+                min_population=fc.min_population,
+                sampler=self._fleet_gauges,
+                config_snapshot={
+                    "seed_peer_enabled": self.config.seed_peer_enabled,
+                    "cluster_id": self.config.cluster_id,
+                    "scheduling": {
+                        "algorithm": self.config.scheduling.algorithm,
+                        "candidate_parent_limit":
+                            self.config.scheduling.candidate_parent_limit,
+                        "retry_interval":
+                            self.config.scheduling.retry_interval,
+                        "stripe_min_slice_peers":
+                            self.config.scheduling.stripe_min_slice_peers,
+                    },
+                    "gc": {"peer_ttl": gc.peer_ttl, "task_ttl": gc.task_ttl,
+                           "host_ttl": gc.host_ttl},
+                })
+            if fc.straggler_filter:
+                self.scheduling.wire_fleet(self.fleet)
+
+    def _fleet_gauges(self) -> dict:
+        """Gauge sample for the fleet time-series. O(hosts+peers+tasks)
+        scans — called at bucket rotation (amortized once per bucket_s)
+        and on /debug/fleet snapshots, never per event."""
+        hc = self.hosts.counts()
+        return {
+            "hosts_total": hc["total"],
+            "hosts_seed": hc["seed"],
+            "hosts_quarantined": hc["quarantined"],
+            "peers_running": sum(1 for p in self.peers.all()
+                                 if not p.is_done()),
+            "tasks_active": sum(1 for t in self.tasks.all()
+                                if t.fsm.current == TaskState.RUNNING),
+            "straggler_hosts": len(
+                self.fleet.scorecards._stragglers) if self.fleet else 0,
+        }
 
     # ------------------------------------------------------------------ #
     # resource resolution (reference handleResource :1457)
@@ -149,6 +199,8 @@ class SchedulerService:
             # (idempotent application) so it becomes a usable parent again.
             self.peers.delete(stale.id)
             PEER_REREGISTER_COUNT.inc()
+            if self.fleet is not None:
+                self.fleet.note_register(reconnect=True)
             log.info("terminal peer re-registered", peer=stale.id[:24],
                      prior_state=stale.fsm.current)
         peer = self.peers.load_or_store(
@@ -178,6 +230,8 @@ class SchedulerService:
             raise DfError(Code.BadRequest, "task_id and peer_id required")
         host, task, peer = self._resolve(open_body)
         peer.announce_stream = stream
+        if self.fleet is not None:
+            self.fleet.note_register()
         log.info("announce peer", peer=peer.id[:24], task=task.id[:16],
                  host=host.id, seed=peer.is_seed)
         try:
@@ -359,6 +413,9 @@ class SchedulerService:
             if stripe is not None:
                 msg["stripe"] = stripe
                 STRIPE_HANDOUT_COUNT.labels("striped").inc()
+                if self.fleet is not None:
+                    self.fleet.note_stripe(task.id, peer.id, peer.host.id,
+                                           reshuffle=False)
             await stream.send(msg)
             if peer.host.tpu_slice:
                 # Membership may have just changed (this peer joined or
@@ -373,6 +430,9 @@ class SchedulerService:
                                "task": task.to_wire()})
         else:
             self._fail_peer(peer)
+            if self.fleet is not None:
+                self.fleet.note_schedule_failed(task.id, peer.id,
+                                                peer.host.id, result.reason)
             await stream.send({"type": "schedule_failed", "reason": result.reason})
 
     # -- striped slice broadcast (scheduling/stripe.py) --------------------
@@ -447,6 +507,9 @@ class SchedulerService:
             try:
                 await q.announce_stream.send(msg)
                 STRIPE_HANDOUT_COUNT.labels("reshuffle").inc()
+                if self.fleet is not None:
+                    self.fleet.note_stripe(task.id, q.id, q.host.id,
+                                           reshuffle=True)
             except Exception:
                 # A dying stream reaps through _on_stream_gone; the push
                 # is best-effort by design.
@@ -460,6 +523,9 @@ class SchedulerService:
         if peer.fsm.can("download_back_to_source"):
             peer.fsm.event("download_back_to_source")
             task.back_to_source_peers.add(peer.id)
+            if self.fleet is not None:
+                self.fleet.note_back_source(task.id, peer.id, peer.host.id,
+                                            reason)
             # A back-sourcing peer is a valid candidate parent from this
             # instant (the sync stream pushes pieces as they land) — wake
             # blocked schedule loops now, not at its first piece report.
@@ -542,11 +608,23 @@ class SchedulerService:
             # instead of letting them poll out their retry interval.
             task.notify_parents_changed()
         parent_id = p.get("dst_peer_id", "")
-        if parent_id:
-            parent = self.peers.load(parent_id)
+        parent = self.peers.load(parent_id) if parent_id else None
+        if parent is not None:
+            parent.host.upload_count += 1
+            parent.touch()
+        if self.fleet is not None:
+            cost = p.get("download_cost_ms", 0)
+            col = fleetlib.C_BYTES_UNLABELED
+            parent_host = None
             if parent is not None:
-                parent.host.upload_count += 1
-                parent.touch()
+                parent_host = parent.host.id
+                if peer.host.tpu_slice and parent.host.tpu_slice:
+                    col = (fleetlib.C_BYTES_INTRA
+                           if parent.host.tpu_slice == peer.host.tpu_slice
+                           else fleetlib.C_BYTES_CROSS)
+            self.fleet.note_piece(peer.host.id, col,
+                                  p.get("range_size", 0), cost,
+                                  parent_host, p.get("timings"))
 
     def _handle_pieces_finished(self, msg: dict, task: Task, peer: Peer) -> None:
         """Coalesced batch (clients flush reports on a short window);
@@ -558,29 +636,59 @@ class SchedulerService:
         ~hosts x pieces of these."""
         pieces = msg.get("pieces") or []
         was_empty = not peer.finished_pieces
-        parent_uploads: dict[str, int] = {}
+        # Per-parent aggregation: one registry lookup, one upload-count
+        # update, and ONE fleet serve-EWMA step per DISTINCT parent per
+        # batch (not per piece) — this is the scheduler's hottest ingest
+        # path and the observatory must ride it at batch granularity.
+        parent_aggs: dict[str, list] = {}   # pid -> [count, cost_sum, bytes]
+        landed = 0
+        cost_total = 0
         for p in pieces:
             num = p["piece_num"]
             if num in peer.finished_pieces:
                 continue   # idempotent re-delivery (see _apply_piece_finished)
-            peer.add_finished_piece(num, p.get("download_cost_ms", 0))
+            cost = p.get("download_cost_ms", 0)
+            peer.add_finished_piece(num, cost)
             self.pod_flight.note_piece(task.id, peer.host.id,
-                                       p.get("timings"),
-                                       p.get("download_cost_ms", 0))
+                                       p.get("timings"), cost)
             if num not in task.pieces:
                 task.store_piece(PieceInfo.from_wire(p))
-            parent_id = p.get("dst_peer_id", "")
-            if parent_id:
-                parent_uploads[parent_id] = parent_uploads.get(parent_id, 0) + 1
+            landed += 1
+            cost_total += cost
+            agg = parent_aggs.get(p.get("dst_peer_id", ""))
+            if agg is None:
+                agg = parent_aggs[p.get("dst_peer_id", "")] = [0, 0, 0]
+            agg[0] += 1
+            agg[1] += cost
+            agg[2] += p.get("range_size", 0)
         peer.touch()
         task.touch()
         if was_empty and peer.finished_pieces:
             task.notify_parents_changed()
-        for parent_id, n in parent_uploads.items():
-            parent = self.peers.load(parent_id)
+        by_parent_host: dict[str, list] = {}
+        my_slice = peer.host.tpu_slice
+        for parent_id, (k, cost_sum, nbytes) in parent_aggs.items():
+            parent = self.peers.load(parent_id) if parent_id else None
+            host_key = ""
+            col = fleetlib.C_BYTES_UNLABELED
             if parent is not None:
-                parent.host.upload_count += n
+                parent.host.upload_count += k
                 parent.touch()
+                host_key = parent.host.id
+                if my_slice and parent.host.tpu_slice:
+                    col = (fleetlib.C_BYTES_INTRA
+                           if parent.host.tpu_slice == my_slice
+                           else fleetlib.C_BYTES_CROSS)
+            entry = by_parent_host.get(host_key)
+            if entry is None:
+                by_parent_host[host_key] = [k, cost_sum, nbytes, col]
+            else:
+                entry[0] += k
+                entry[1] += cost_sum
+                entry[2] += nbytes
+        if self.fleet is not None and landed:
+            self.fleet.note_pieces(peer.host.id, landed, cost_total,
+                                   by_parent=by_parent_host)
 
     def _handle_piece_failed(self, msg: dict, task: Task, peer: Peer) -> None:
         parent_id = msg.get("parent_id", "")
@@ -603,10 +711,16 @@ class SchedulerService:
                     # the PARENT host that served (or failed to serve).
                     self.pod_flight.note_failure(task.id, parent.host.id,
                                                  reason)
+                    if self.fleet is not None:
+                        self.fleet.note_piece_failed(parent.host.id, reason)
                 if reason and parent.host.note_served_bad(reason):
                     PARENT_DEMOTION_COUNT.labels(reason).inc()
                     self.pod_flight.note_quarantine(task.id, parent.host.id,
                                                     reason)
+                    if self.fleet is not None:
+                        self.fleet.note_quarantine(task.id, parent.host.id,
+                                                   reason,
+                                                   reporter=peer.id)
                     log.warning("parent host quarantined",
                                 host=parent.host.id, reason=reason,
                                 reporter=peer.id[:24])
@@ -722,6 +836,8 @@ class SchedulerService:
         )
         host.port = h.get("port", host.port)
         host.upload_port = h.get("upload_port", host.upload_port)
+        if self.fleet is not None:
+            self.fleet.note_announce()
         tel = h.get("telemetry") or {}
         for k, v in tel.items():
             if hasattr(host.telemetry, k):

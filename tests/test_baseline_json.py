@@ -28,6 +28,7 @@ REQUIRED_CONFIGS = (
     "config6_stripe_sim",
     "config7_chaos",
     "config8_flight",
+    "config9_fleet",
     "ingest_micro",
 )
 
@@ -117,6 +118,37 @@ def test_flight_entry_paired_shape():
     assert entry["overhead_frac"] < 0.03, entry["overhead_frac"]
     assert entry["overhead_frac"] == pytest.approx(
         1.0 - on["mb_s"] / off["mb_s"], abs=1e-3)
+
+
+def test_fleet_entry_paired_shape():
+    """config9_fleet is a PAIRED overhead run: the fleet observatory on
+    vs off over the same DES churn sim geometry, CPU-time medians, with
+    the acceptance budget (observatory overhead <= 3% in the DES sim)
+    and the resident-bytes bound flat in host count."""
+    entry = _load()["published"]["config9_fleet"]
+    churn = entry["churn_sim"]
+    on, off = churn["on"], churn["off"]
+    for run in (on, off):
+        assert run["cpu_s"] > 0 and run["wall_s"] > 0
+    assert churn["hosts"] >= 1024
+    # The estimator is the median of adjacent paired on/off ratios
+    # (order-alternating rounds — see fleet_bench.run_churn_paired);
+    # recompute it from the published per-pair ratios.
+    ratios = sorted(churn["pair_ratios"])
+    assert len(ratios) == churn["rounds"] and len(ratios) % 2 == 0
+    median = (ratios[len(ratios) // 2 - 1] + ratios[len(ratios) // 2]) / 2
+    assert churn["cpu_overhead_frac"] == pytest.approx(
+        median - 1.0, abs=1e-3)
+    assert churn["cpu_overhead_frac"] <= 0.03, churn["cpu_overhead_frac"]
+    ingest = entry["ingest"]
+    assert ingest["events"] > 0
+    assert ingest["on_ns_per_event"] > 0 and ingest["off_ns_per_event"] > 0
+    resident = entry["resident"]
+    assert resident["hosts_large"] == 4 * resident["hosts_small"]
+    assert resident["bytes_small"] > 0 and resident["bytes_large"] > 0
+    # The bound: 4x the hosts must not mean 4x the memory — preallocated
+    # rings + LRU-capped scorecards keep it flat.
+    assert resident["ratio"] <= 1.5, resident
 
 
 def test_ingest_micro_serve_round_paired_shape():

@@ -1,0 +1,522 @@
+"""Fleet observatory: time-series bounds, scorecard straggler math,
+decision audit log, service wiring, the seeded consistently-slow-host
+acceptance e2e, and scrape-under-load responsiveness.
+
+The acceptance case: a host that serves slowly across MANY tasks (seeded
+deterministic costs) must be flagged fleet-wide at /debug/fleet/hosts,
+dropped from later candidate handouts, and the drops must be explained
+at /debug/fleet/decisions?host=<slow> — the per-task PodAggregator can
+never see this; only the cross-task scorecards can.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+
+from dragonfly2_tpu.pkg import fleet
+from dragonfly2_tpu.scheduler.config import SchedulerConfig
+from dragonfly2_tpu.scheduler.service import SchedulerService
+
+
+def mk_body(host: str, peer: str, task: str = "t", slice_: str = "",
+            upload_port: int = 2) -> dict:
+    return {
+        "host": {"id": host, "hostname": host, "ip": "10.0.0.1",
+                 "port": 1, "upload_port": upload_port,
+                 "tpu_slice": slice_},
+        "peer_id": peer, "task_id": task, "url": "http://origin/f"}
+
+
+# --------------------------------------------------------------------- #
+# Time-series ring
+# --------------------------------------------------------------------- #
+
+class TestTimeSeries:
+    def test_counters_land_in_time_buckets(self):
+        clock = [100.0]
+        ts = fleet.FleetTimeSeries(bucket_s=5.0, buckets=8,
+                                   clock=lambda: clock[0])
+        ts.inc(fleet.C_PIECES, 3)
+        clock[0] += 5.0
+        ts.inc(fleet.C_PIECES, 2)
+        win = ts.window(30)
+        got = win["counters"]["pieces_landed"]
+        assert got[-1] == 2 and got[-2] == 3
+        assert win["totals"]["pieces_landed"] == 5
+
+    def test_ring_is_bounded_and_stale_slots_zero(self):
+        """A burst, a long idle gap past the ring, then one event: the
+        reused slots must read zero, not ghost the old burst."""
+        clock = [0.0]
+        ts = fleet.FleetTimeSeries(bucket_s=1.0, buckets=4,
+                                   clock=lambda: clock[0])
+        for _ in range(100):
+            ts.inc(fleet.C_PIECES)
+        clock[0] += 1000.0          # idle far past the ring
+        ts.inc(fleet.C_PIECES)
+        win = ts.window(4)
+        assert win["totals"]["pieces_landed"] == 1
+        # Preallocated: the burst grew nothing, the idle freed nothing.
+        assert len(ts._counts) == 4
+        assert all(len(row) == len(fleet.COUNTERS) for row in ts._counts)
+        assert all(len(row) == len(fleet.GAUGES) for row in ts._gauges)
+
+    def test_gauges_sampled_at_rotation(self):
+        clock = [0.0]
+        sampled = {"hosts_total": 7, "tasks_active": 2}
+        ts = fleet.FleetTimeSeries(bucket_s=1.0, buckets=8,
+                                   sampler=lambda: sampled,
+                                   clock=lambda: clock[0])
+        ts.inc(fleet.C_PIECES)      # first rotation samples
+        win = ts.window(2)
+        assert win["gauges"]["hosts_total"][-1] == 7
+        assert win["gauges"]["tasks_active"][-1] == 2
+
+    def test_broken_sampler_does_not_drop_events(self):
+        def boom():
+            raise RuntimeError("sampler died")
+
+        ts = fleet.FleetTimeSeries(bucket_s=1.0, buckets=4, sampler=boom)
+        ts.inc(fleet.C_PIECES, 5)
+        assert ts.window(4)["totals"]["pieces_landed"] == 5
+
+    def test_window_clamps_to_ring(self):
+        ts = fleet.FleetTimeSeries(bucket_s=1.0, buckets=4)
+        win = ts.window(10_000)
+        assert win["buckets"] == 4
+
+
+# --------------------------------------------------------------------- #
+# Scorecards + straggler flag
+# --------------------------------------------------------------------- #
+
+class TestScorecards:
+    def test_slow_server_flagged_uniform_fleet_not(self):
+        sc = fleet.HostScorecards(min_serve_samples=4, min_population=8)
+        for i in range(9):
+            cost = 900.0 if i == 0 else 10.0
+            for _ in range(6):
+                sc.note_serve(f"h{i}", cost)
+        flags = sc.recompute_stragglers()
+        assert flags == {"h0"}
+        assert sc.is_straggler("h0") and not sc.is_straggler("h3")
+        # Uniform fleet: the scale floor keeps z finite — nobody flagged.
+        sc2 = fleet.HostScorecards(min_serve_samples=4, min_population=8)
+        for i in range(9):
+            for _ in range(6):
+                sc2.note_serve(f"u{i}", 10.0 + (i % 3))
+        assert sc2.recompute_stragglers() == set()
+
+    def test_no_flag_below_population_floor(self):
+        """Small pods must never lose their only parent to the advisory
+        filter: under min_population scored hosts, nobody is flagged."""
+        sc = fleet.HostScorecards(min_serve_samples=2, min_population=8)
+        for i in range(4):
+            for _ in range(4):
+                sc.note_serve(f"h{i}", 900.0 if i == 0 else 10.0)
+        assert sc.recompute_stragglers() == set()
+
+    def test_batch_serve_moves_ewma_like_singles(self):
+        a = fleet.HostScorecards()
+        b = fleet.HostScorecards()
+        for _ in range(8):
+            a.note_serve("h", 100.0)
+        b.note_serve("h", 100.0)
+        b.note_serve("h", 100.0, count=7)
+        assert a._hosts["h"].serve_samples == b._hosts["h"].serve_samples
+        assert a._hosts["h"].serve_ewma_ms == pytest.approx(
+            b._hosts["h"].serve_ewma_ms)
+
+    def test_failure_counts_decay(self):
+        clock = [0.0]
+        sc = fleet.HostScorecards(half_life_s=10.0,
+                                  clock=lambda: clock[0])
+        sc.note_failure("h", "corrupt")
+        sc.note_failure("h", "corrupt")
+        clock[0] += 10.0
+        sc.note_failure("h", "stall")
+        s = sc._hosts["h"]
+        sc._decay_failures(s, clock[0])
+        assert s.failures["corrupt"] == pytest.approx(1.0)
+        clock[0] += 200.0
+        sc._decay_failures(s, clock[0])
+        assert "corrupt" not in s.failures   # decayed below the floor
+
+    def test_lru_bound_evicts_least_recently_seen(self):
+        clock = [0.0]
+        sc = fleet.HostScorecards(max_hosts=4, clock=lambda: clock[0])
+        for i in range(6):
+            clock[0] += 1.0
+            sc.note_serve(f"h{i}", 10.0)
+        assert len(sc._hosts) == 4
+        assert "h0" not in sc._hosts and "h5" in sc._hosts
+
+    def test_report_shape(self):
+        sc = fleet.HostScorecards(min_serve_samples=1, min_population=1)
+        sc.note_serve("h", 42.0)
+        sc.note_download("h", 10.0, {"dcn_ms": 8, "stall_ms": 0,
+                                     "store_ms": 2})
+        rep = sc.report()
+        row = rep["hosts"][0]
+        assert row["host"] == "h" and row["serve_ewma_ms"] == 42.0
+        assert row["phase_ewma_ms"]["dcn"] > 0
+        assert rep["hosts_tracked"] == 1
+
+
+# --------------------------------------------------------------------- #
+# Decision audit log
+# --------------------------------------------------------------------- #
+
+class TestDecisionLog:
+    def test_ring_bound_and_newest_first(self):
+        d = fleet.DecisionLog(cap=8)
+        for i in range(20):
+            d.record("handout", task=f"t{i}", host="h")
+        q = d.query(limit=100)
+        assert len(q["decisions"]) == 8
+        assert q["decisions"][0]["task"] == "t19"   # newest first
+        assert q["dropped"] == 12
+        assert q["recorded_total"] == 20
+
+    def test_filters_match_subject_and_alternatives(self):
+        d = fleet.DecisionLog()
+        d.record("handout", task="t1", host="child-h", peer="p",
+                 chosen=("par-a", "par-b"), rejected=("par-c",))
+        d.record("quarantine", task="t1", host="par-c", reason="corrupt")
+        d.record("handout", task="t2", host="other")
+        # host filter matches chosen parents...
+        assert len(d.query(host="par-a")["decisions"]) == 1
+        # ...and rejected alternatives (why did X NOT get picked).
+        got = d.query(host="par-c")["decisions"]
+        assert {g["kind"] for g in got} == {"handout", "quarantine"}
+        assert len(d.query(task="t1")["decisions"]) == 2
+        assert len(d.query(kind="quarantine")["decisions"]) == 1
+
+    def test_decision_metric_counts_kinds(self):
+        from dragonfly2_tpu.pkg import metrics as metrics_mod
+
+        d = fleet.DecisionLog()
+        d.record("back_source", task="t", host="h", reason="first peer")
+        text = metrics_mod.render()[0].decode()
+        assert "dragonfly_tpu_scheduler_decisions_total" in text
+
+
+# --------------------------------------------------------------------- #
+# Service wiring: the report paths feed the observatory
+# --------------------------------------------------------------------- #
+
+class TestServiceWiring:
+    def test_reports_feed_series_scorecards_and_decisions(self, run_async):
+        async def body():
+            svc = SchedulerService(SchedulerConfig())
+            _h, task, peer_a = svc._resolve(
+                mk_body("host-a", "peer-a", slice_="s1"))
+            _h2, _t, peer_b = svc._resolve(
+                mk_body("host-b", "peer-b", slice_="s2"))
+            svc._handle_pieces_finished({"pieces": [
+                {"piece_num": 0, "range_start": 0, "range_size": 4096,
+                 "download_cost_ms": 25, "dst_peer_id": "peer-b",
+                 "timings": {"dcn_ms": 20, "stall_ms": 0, "store_ms": 5}},
+                {"piece_num": 1, "range_start": 4096, "range_size": 4096,
+                 "download_cost_ms": 35, "dst_peer_id": "peer-b"},
+            ]}, task, peer_a)
+            svc._handle_piece_finished({"piece": {
+                "piece_num": 2, "range_start": 8192, "range_size": 4096,
+                "download_cost_ms": 7, "dst_peer_id": "peer-b"}},
+                task, peer_a)
+            svc._handle_piece_failed(
+                {"piece_num": 3, "parent_id": "peer-b",
+                 "temporary": False, "reason": "corrupt"}, task, peer_a)
+            f = svc.fleet
+            totals = f.series.window(60)["totals"]
+            assert totals["pieces_landed"] == 3
+            # host-a (s1) pulled from host-b (s2): cross-slice bytes.
+            assert totals["bytes_cross"] == 3 * 4096
+            assert totals["failed_corrupt"] == 1
+            assert totals["quarantines"] == 1
+            cards = {r["host"]: r for r in f.hosts_report()["hosts"]}
+            assert cards["host-b"]["serve_samples"] == 3
+            assert cards["host-b"]["failures"].get("corrupt") == 1.0
+            # One per PIECE (2 batched + 1 single), same unit as
+            # serve_samples — a batch of k weighs like k singles.
+            assert cards["host-a"]["down_samples"] == 3
+            q = f.decisions.query(host="host-b", kind="quarantine")
+            assert q["decisions"][0]["reason"] == "corrupt"
+            # Gauge sampler sees the resource registries.
+            now = svc._fleet_gauges()
+            assert now["hosts_total"] == 2
+            assert now["hosts_quarantined"] == 1
+
+        run_async(body(), timeout=30)
+
+    def test_duplicate_reports_not_double_counted(self, run_async):
+        async def body():
+            svc = SchedulerService(SchedulerConfig())
+            _h, task, peer = svc._resolve(mk_body("h", "p"))
+            piece = {"piece_num": 0, "range_start": 0, "range_size": 64,
+                     "download_cost_ms": 5}
+            svc._handle_piece_finished({"piece": piece}, task, peer)
+            svc._handle_piece_finished({"piece": piece}, task, peer)
+            svc._handle_pieces_finished({"pieces": [piece]}, task, peer)
+            totals = svc.fleet.series.window(60)["totals"]
+            assert totals["pieces_landed"] == 1
+
+        run_async(body(), timeout=30)
+
+    def test_fleet_disabled_removes_hooks(self, run_async):
+        async def body():
+            cfg = SchedulerConfig()
+            cfg.fleet.enabled = False
+            svc = SchedulerService(cfg)
+            assert svc.fleet is None
+            assert svc.scheduling.fleet is None
+            _h, task, peer = svc._resolve(mk_body("h", "p"))
+            svc._handle_piece_finished({"piece": {
+                "piece_num": 0, "range_start": 0, "range_size": 64,
+                "download_cost_ms": 5}}, task, peer)   # must not blow up
+
+        run_async(body(), timeout=30)
+
+
+# --------------------------------------------------------------------- #
+# Acceptance e2e: seeded consistently-slow host
+# --------------------------------------------------------------------- #
+
+class FakeStream:
+    def __init__(self, open_body):
+        self.open_body = open_body
+        self.to_sched: asyncio.Queue = asyncio.Queue()
+        self.to_peer: asyncio.Queue = asyncio.Queue()
+
+    async def send(self, body):
+        await self.to_peer.put(body)
+
+    async def recv(self, timeout=None):
+        return await self.to_sched.get()
+
+
+class TestStragglerE2E:
+    """One host serves slowly across MANY tasks (seeded costs: the chaos
+    discipline — one constant decides, the schedule replays). The fleet
+    must name it at /debug/fleet/hosts, exclude it from later handouts,
+    and explain each exclusion at /debug/fleet/decisions?host=<slow>."""
+
+    SLOW = "host-3"
+    SEED_COSTS = {True: 1200, False: 12}   # is_slow -> served cost_ms
+
+    def _build(self):
+        cfg = SchedulerConfig()
+        cfg.seed_peer_enabled = False
+        cfg.fleet.min_serve_samples = 4
+        cfg.fleet.min_population = 6
+        return SchedulerService(cfg)
+
+    def test_slow_host_flagged_filtered_and_explained(self, run_async):
+        import aiohttp
+
+        from dragonfly2_tpu.pkg.metrics_server import MetricsServer
+
+        async def body():
+            svc = self._build()
+            n_hosts, n_tasks, pieces = 10, 3, 8
+            # Cross-task report storm: every host downloads every task,
+            # each piece attributed to a ring-neighbor parent — so every
+            # host also SERVES across tasks. Pieces served by SLOW carry
+            # the seeded slow cost.
+            for t in range(n_tasks):
+                task_id = f"task-{t}"
+                peers = {}
+                for i in range(n_hosts):
+                    _h, task, peer = svc._resolve(
+                        mk_body(f"host-{i}", f"p{t}-{i}", task_id))
+                    # The storm skips the announce stream; candidates
+                    # must still be in a serving state.
+                    peer.fsm.event("register_normal")
+                    peer.fsm.event("download")
+                    svc._mark_task_running(task)
+                    peers[i] = (task, peer)
+                for i in range(n_hosts):
+                    task, peer = peers[i]
+                    reports = []
+                    for n in range(pieces):
+                        j = (i + 1 + n) % n_hosts     # rotating parent
+                        if j == i:
+                            j = (i + 1) % n_hosts
+                        reports.append({
+                            "piece_num": n, "range_start": n * 65536,
+                            "range_size": 65536,
+                            "download_cost_ms": self.SEED_COSTS[
+                                f"host-{j}" == self.SLOW],
+                            "dst_peer_id": f"p{t}-{j}"})
+                    svc._handle_pieces_finished({"pieces": reports},
+                                                task, peer)
+            flags = svc.fleet.scorecards.recompute_stragglers()
+            assert flags == {self.SLOW}
+
+            # A late child registers over a REAL announce stream: the
+            # handout must exclude the flagged host, and the exclusion
+            # must be auditable.
+            stream = FakeStream(mk_body("host-late", "p-late", "task-0"))
+            server = asyncio.ensure_future(svc.announce_peer(stream, None))
+            await stream.to_sched.put({"type": "register"})
+            msg = await asyncio.wait_for(stream.to_peer.get(), timeout=30)
+            assert msg["type"] == "normal_task"
+            handed = {(p.get("host") or {}).get("id")
+                      for p in msg["parents"]}
+            assert handed and self.SLOW not in handed
+            await stream.to_sched.put(None)
+            await asyncio.wait_for(server, timeout=30)
+
+            # The acceptance surface: the scheduler's debug endpoints.
+            srv = MetricsServer(fleet=svc.fleet)
+            port = await srv.serve("127.0.0.1", 0)
+            base = f"http://127.0.0.1:{port}"
+            try:
+                async with aiohttp.ClientSession() as sess:
+                    async with sess.get(f"{base}/debug/fleet/hosts") as r:
+                        assert r.status == 200
+                        hosts = await r.json()
+                    assert hosts["stragglers"] == [self.SLOW]
+                    top = hosts["hosts"][0]
+                    assert top["host"] == self.SLOW and top["straggler"]
+                    assert top["zscore"] >= 3.0
+                    async with sess.get(
+                            f"{base}/debug/fleet/decisions",
+                            params={"host": self.SLOW,
+                                    "kind": "straggler_filter"}) as r:
+                        assert r.status == 200
+                        dec = await r.json()
+                    assert dec["decisions"], \
+                        "slow host's demotions are not explained"
+                    assert dec["decisions"][0]["host"] == self.SLOW
+                    assert "straggler" in dec["decisions"][0]["reason"]
+                    # The handout that excluded it is also on record.
+                    async with sess.get(
+                            f"{base}/debug/fleet/decisions",
+                            params={"task": "task-0",
+                                    "kind": "handout"}) as r:
+                        hand = await r.json()
+                    assert any(d["peer"] == "p-late"
+                               for d in hand["decisions"])
+            finally:
+                await srv.close()
+
+        run_async(body(), timeout=120)
+
+    def test_recovered_host_unflagged_after_fast_serves(self, run_async):
+        """Advisory means reversible: once the host serves fast again,
+        the EWMA falls and the next recompute clears the flag."""
+
+        async def body():
+            svc = self._build()
+            sc = svc.fleet.scorecards
+            for i in range(8):
+                for _ in range(6):
+                    sc.note_serve(f"host-{i}",
+                                  1200 if i == 3 else 12)
+            assert sc.recompute_stragglers() == {"host-3"}
+            for _ in range(40):
+                sc.note_serve("host-3", 12)
+            assert sc.recompute_stragglers() == set()
+
+        run_async(body(), timeout=30)
+
+
+# --------------------------------------------------------------------- #
+# Scrape under load (satellite): endpoints answer mid-broadcast
+# --------------------------------------------------------------------- #
+
+class TestScrapeUnderLoad:
+    def test_metrics_and_fleet_endpoints_respond_mid_broadcast(
+            self, run_async):
+        import time as time_mod
+
+        import aiohttp
+
+        from dragonfly2_tpu.pkg.metrics_server import MetricsServer
+
+        async def body():
+            cfg = SchedulerConfig()
+            cfg.seed_peer_enabled = False
+            cfg.scheduling.retry_interval = 0.05
+            svc = SchedulerService(cfg)
+            srv = MetricsServer(pod_flight=svc.pod_flight, fleet=svc.fleet)
+            port = await srv.serve("127.0.0.1", 0)
+            base = f"http://127.0.0.1:{port}"
+
+            n_hosts, n_pieces = 24, 12
+            done = asyncio.Event()
+
+            async def peer(i: int):
+                stream = FakeStream(mk_body(
+                    f"bh-{i}", f"bp-{i}", "bcast",
+                    slice_=f"s{i // 8}"))
+                server = asyncio.ensure_future(
+                    svc.announce_peer(stream, None))
+                await stream.to_sched.put({"type": "register"})
+                msg = await asyncio.wait_for(stream.to_peer.get(),
+                                             timeout=60)
+                if msg.get("type") == "normal_task":
+                    await stream.to_sched.put({
+                        "type": "download_started",
+                        "content_length": n_pieces * 65536,
+                        "piece_size": 65536,
+                        "total_piece_count": n_pieces})
+                for n in range(n_pieces):
+                    await asyncio.sleep(0.02)
+                    await stream.to_sched.put({
+                        "type": "piece_finished",
+                        "piece": {"piece_num": n,
+                                  "range_start": n * 65536,
+                                  "range_size": 65536,
+                                  "download_cost_ms": 3,
+                                  "dst_peer_id": ""}})
+                # Hold the stream open until the scrapes finish: the
+                # broadcast must be MID-FLIGHT while we probe.
+                await done.wait()
+                await stream.to_sched.put({
+                    "type": "download_finished",
+                    "content_length": n_pieces * 65536,
+                    "piece_size": 65536,
+                    "total_piece_count": n_pieces})
+                await stream.to_sched.put(None)
+                await asyncio.wait_for(server, timeout=60)
+
+            peers = [asyncio.ensure_future(peer(i))
+                     for i in range(n_hosts)]
+            await asyncio.sleep(0.1)    # mid-flight: pieces streaming
+            try:
+                async with aiohttp.ClientSession() as sess:
+                    for path, is_json in (
+                            ("/metrics", False),
+                            ("/debug/fleet?window=60", True),
+                            ("/debug/fleet/hosts", True),
+                            ("/debug/fleet/decisions", True),
+                            ("/debug/fleet/info", True)):
+                        t0 = time_mod.perf_counter()
+                        async with sess.get(base + path) as r:
+                            assert r.status == 200, path
+                            raw = await r.read()
+                        dt = time_mod.perf_counter() - t0
+                        assert dt < 1.0, f"{path} took {dt:.2f}s under load"
+                        if is_json:
+                            json.loads(raw)     # valid JSON
+                        else:
+                            assert b"dragonfly_tpu" in raw
+                    # Mid-flight sanity: the observatory saw the storm.
+                    async with sess.get(
+                            f"{base}/debug/fleet?window=60") as r:
+                        snap = await r.json()
+                    assert snap["series"]["totals"]["registers"] >= n_hosts
+                    assert snap["series"]["totals"]["pieces_landed"] > 0
+            finally:
+                done.set()
+                await asyncio.wait_for(
+                    asyncio.gather(*peers, return_exceptions=True),
+                    timeout=120)
+                await srv.close()
+
+        run_async(body(), timeout=180)

@@ -240,6 +240,32 @@ class TestPostmortem:
         names = [e["event"] for e in doc["events"]]
         assert "request" in names and "task_failed" in names
 
+    def test_rotation_keeps_the_newest_bundles(self, tmp_path):
+        """The dump dir is a ring, not a landfill: with keep_bundles=3,
+        nine failures leave exactly the three NEWEST bundles on disk
+        (mtime-ordered; same-second ties break on the filename stamp)."""
+        import os
+
+        rec = flight.FlightRecorder(dump_dir=str(tmp_path), keep_bundles=3)
+        for i in range(9):
+            tf = rec.task(f"rot-{i}")
+            tf.record(flight.EV_REQUEST, 0, 0.0, "a:1")
+            rec.finish_task(f"rot-{i}", "failed")
+            # Force a strict mtime order even on coarse filesystems.
+            for j, p in enumerate(sorted(tmp_path.glob("flight-*.json"))):
+                os.utime(p, (1000 + j, 1000 + j))
+        survivors = sorted(tmp_path.glob("flight-*.json"))
+        assert len(survivors) == 3
+        kept_tasks = {json.loads(p.read_text())["report"]["task_id"]
+                      for p in survivors}
+        assert kept_tasks == {"rot-6", "rot-7", "rot-8"}
+
+    def test_default_rotation_budget_is_32(self):
+        assert flight.FlightRecorder().keep_bundles == 32
+        from dragonfly2_tpu.daemon.config import DaemonConfig
+
+        assert DaemonConfig().flight_keep_bundles == 32
+
     def test_success_does_not_dump(self, tmp_path):
         rec = flight.FlightRecorder(dump_dir=str(tmp_path))
         rec.task("fine")

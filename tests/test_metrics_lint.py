@@ -1,0 +1,110 @@
+"""Metrics-name lint: every registered family follows the
+``{component}_{noun}[_{unit}][_total]`` convention and is documented in
+docs/OBSERVABILITY.md.
+
+Undocumented or misnamed telemetry rots fastest: a dashboard built on a
+family nobody wrote down breaks silently on the next rename. This test
+imports every metric-defining module (so the registry is fully
+populated), then walks ``metrics.families()`` and fails on any family
+that (a) is not snake_case, (b) has the wrong suffix discipline for its
+kind, (c) starts with an unknown component, or (d) has no row in the
+docs page.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import re
+
+import pytest
+
+# Every module that registers a metric family. A new metric in a new
+# module must be added here (the scrape tests would miss it silently
+# otherwise) — grep for `metrics.counter|gauge|histogram` when in doubt.
+METRIC_MODULES = (
+    "dragonfly2_tpu.pkg.bufpool",
+    "dragonfly2_tpu.pkg.chaos",
+    "dragonfly2_tpu.pkg.flight",
+    "dragonfly2_tpu.pkg.fleet",
+    "dragonfly2_tpu.pkg.tracing",
+    "dragonfly2_tpu.daemon.proxy",
+    "dragonfly2_tpu.daemon.upload",
+    "dragonfly2_tpu.daemon.objectstorage",
+    "dragonfly2_tpu.daemon.peer.conductor",
+    "dragonfly2_tpu.daemon.peer.task_manager",
+    "dragonfly2_tpu.daemon.peer.device_sink",
+    "dragonfly2_tpu.scheduler.service",
+    "dragonfly2_tpu.dataset.loader",
+    "dragonfly2_tpu.dataset.shard_reader",
+    "dragonfly2_tpu.dataset.tar_index",
+    "dragonfly2_tpu.dataset.device_feed",
+)
+
+# The documented component vocabulary (docs/OBSERVABILITY.md "Metric
+# families"). Adding a component means documenting it there first.
+COMPONENTS = ("bufpool", "chaos", "dataset", "device_sink", "fleet",
+              "objectstorage", "peer", "proxy", "scheduler", "tracing",
+              "upload")
+
+# Histogram families must name their unit; counters use _total; gauges
+# may end in a unit but never _total.
+UNITS = ("seconds", "bytes", "ms")
+
+DOCS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "docs", "OBSERVABILITY.md")
+
+SNAKE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)*$")
+
+
+@pytest.fixture(scope="module")
+def all_families():
+    for mod in METRIC_MODULES:
+        importlib.import_module(mod)
+    from dragonfly2_tpu.pkg import metrics
+
+    fams = metrics.families()
+    assert len(fams) >= 30, "registry suspiciously small — import miss?"
+    return fams
+
+
+def test_names_are_snake_case(all_families):
+    bad = [f["name"] for f in all_families if not SNAKE.match(f["name"])]
+    assert not bad, f"non-snake_case metric names: {bad}"
+
+
+def test_component_prefix_is_documented(all_families):
+    bad = [f["name"] for f in all_families
+           if not any(f["name"].startswith(c + "_") for c in COMPONENTS)]
+    assert not bad, (
+        f"metric families outside the documented component vocabulary "
+        f"{COMPONENTS}: {bad} — extend docs/OBSERVABILITY.md first")
+
+
+def test_suffix_discipline_per_kind(all_families):
+    bad = []
+    for f in all_families:
+        name, kind = f["name"], f["kind"]
+        if kind == "counter" and not name.endswith("_total"):
+            bad.append((name, "counter must end in _total"))
+        elif kind == "gauge" and name.endswith("_total"):
+            bad.append((name, "gauge must not end in _total"))
+        elif kind == "histogram" and not name.endswith(
+                tuple(f"_{u}" for u in UNITS)):
+            bad.append((name, f"histogram must end in a unit {UNITS}"))
+    assert not bad, f"suffix convention violations: {bad}"
+
+
+def test_every_family_documented(all_families):
+    with open(DOCS) as f:
+        doc = f.read()
+    undocumented = [f["name"] for f in all_families
+                    if f"`{f['name']}`" not in doc]
+    assert not undocumented, (
+        f"metric families missing from docs/OBSERVABILITY.md: "
+        f"{undocumented} — every family needs a table row there")
+
+
+def test_every_family_has_help_text(all_families):
+    thin = [f["name"] for f in all_families if len(f["doc"]) < 10]
+    assert not thin, f"metric families with no real help text: {thin}"
