@@ -94,7 +94,8 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
                   arrival_window_s: float = 1.0,
                   churn: bool = False, churn_waves: int = 1,
                   gc_ttl_s: float = 1.0, fleet: bool = True,
-                  report_batch: int = 1) -> dict:
+                  report_batch: int = 1, podlens: bool = False,
+                  ship_digests: "bool | None" = None) -> dict:
     """``churn=True`` kills whole slices mid-fan-out (their peers' streams
     drop after a few pieces, no finish) and sends straggler waves into the
     SAME slices late — ``churn_waves`` slices die at staggered times, so
@@ -114,9 +115,23 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
     cfg.gc.peer_ttl = cfg.gc.task_ttl = cfg.gc.host_ttl = max(
         gc_ttl_s, arrival_window_s + 60 * piece_latency_s)
     # ``fleet=False`` is the paired control for fleet_bench's observatory
-    # overhead measurement (config9_fleet).
+    # overhead measurement (config9_fleet); ``podlens`` likewise toggles
+    # the SCHEDULER-side pod-lens/SLO machinery for podlens_bench
+    # (config10_podlens). ``ship_digests`` makes every peer record a real
+    # flight ring, digest it and attach it to download_finished (plus a
+    # clock sample) — the paired bench ships digests on BOTH sides so the
+    # pair isolates the scheduler's ingest+SLO cost (the component that
+    # must scale with host count; the daemon-side build cost is a
+    # per-task constant podlens_bench measures separately). Defaults to
+    # ``podlens`` so a lone podlens=True run exercises the whole path.
     cfg.fleet.enabled = fleet
+    cfg.podlens.enabled = cfg.podlens.slo_enabled = podlens
+    if ship_digests is None:
+        ship_digests = podlens
     svc = SchedulerService(cfg)
+    digest_bytes: list[int] = []
+    if ship_digests:
+        from dragonfly2_tpu.pkg import flight as flight_mod
 
     n_slices = max(1, n_hosts // HOSTS_PER_SLICE)
     waves_n = min(churn_waves, max(1, n_slices - 2)) if churn else 0
@@ -226,6 +241,15 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
                 "content_length": N_PIECES * PIECE_SIZE,
                 "piece_size": PIECE_SIZE,
                 "total_piece_count": N_PIECES})
+            tf = None
+            if ship_digests:
+                # The daemon-side half of the pod lens, for real: a
+                # bounded flight ring stamped per piece, digested and
+                # shipped on the terminal message (its build cost is part
+                # of the measured pair).
+                tf = flight_mod.TaskFlight(body["task_id"])
+                tf.record(flight_mod.EV_REGISTER)
+                tf.record(flight_mod.EV_SCHEDULED, -1, 0.0, "normal_task")
             pending: list = []
             for n in range(N_PIECES):
                 if n == die_after:
@@ -237,6 +261,9 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
                         dead_by_slice.get(i // HOSTS_PER_SLICE, 0) + 1
                     return
                 await asyncio.sleep(piece_latency_s * rng.uniform(0.5, 1.5))
+                if tf is not None:
+                    tf.record(flight_mod.EV_REQUEST, n, 0.0, "10.0.0.1:1")
+                    tf.record(flight_mod.EV_LANDED, n, 2.0, "cross")
                 wire_piece = {"piece_num": n,
                               "range_start": n * PIECE_SIZE,
                               "range_size": PIECE_SIZE,
@@ -257,11 +284,18 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
             if pending:
                 await stream.to_sched.put({"type": "pieces_finished",
                                            "pieces": pending})
-            await stream.to_sched.put({
+            finish_msg = {
                 "type": "download_finished",
                 "content_length": N_PIECES * PIECE_SIZE,
                 "piece_size": PIECE_SIZE,
-                "total_piece_count": N_PIECES})
+                "total_piece_count": N_PIECES}
+            if tf is not None:
+                tf.finish("done")
+                now = flight_mod.anchored_wall()
+                finish_msg["flight"] = flight_mod.digest(
+                    tf, clock_samples=[(now - 0.002, now, now - 0.001)])
+                digest_bytes.append(finish_msg["flight"]["bytes"])
+            await stream.to_sched.put(finish_msg)
             finished.add(i)
         finally:
             await stream.to_sched.put(None)
@@ -352,6 +386,16 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
             "registers": win["totals"]["registers"],
             "scorecard_hosts": len(svc.fleet.scorecards._hosts),
         }
+    podlens_stats = None
+    if ship_digests or podlens:
+        podlens_stats = {
+            "digests": len(digest_bytes),
+            "digest_max_bytes": max(digest_bytes) if digest_bytes else 0,
+            "resident_bytes":
+                svc.pod_lens.resident_bytes() if svc.pod_lens else 0,
+            "slo_completions":
+                svc.slo.completions_total if svc.slo else 0,
+        }
     return {
         "config": "pod-fanout-sim" + ("-churn" if churn else ""),
         "hosts": n_hosts,
@@ -398,6 +442,8 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
         "host_cores": os.cpu_count(),
         "fleet_enabled": fleet,
         "fleet": fleet_stats,
+        "podlens_enabled": podlens,
+        "podlens": podlens_stats,
     }
 
 

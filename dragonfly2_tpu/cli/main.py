@@ -44,6 +44,12 @@ def _add_dfget(sub: argparse._SubParsersAction) -> None:
                    help="after the download, print the flight recorder's "
                         "critical-path autopsy (phase breakdown + per-piece "
                         "waterfall) — where the wall time went")
+    p.add_argument("--pod", action="store_true",
+                   help="also fetch the scheduler's merged cross-host pod "
+                        "timeline for this task (clock-aligned per-host "
+                        "phase bars, slowest host named) — the same "
+                        "waterfall /debug/pod/<task_id>/timeline?format="
+                        "text renders")
     p.add_argument("--recursive", action="store_true")
     p.add_argument("--level", type=int, default=5, help="recursion depth")
     p.add_argument("--timeout", type=float, default=0.0)
@@ -83,6 +89,7 @@ def _run_dfget(args: argparse.Namespace) -> int:
         device=args.device,
         pod_broadcast=args.pod_broadcast,
         explain=args.explain,
+        pod=args.pod,
     )
     if not args.output and args.device != "tpu":
         sys.stderr.write("dfget: error: -O/--output is required "
@@ -129,6 +136,9 @@ def _run_dfget(args: argparse.Namespace) -> int:
         flight_info = result.get("flight") or {}
         if args.explain and flight_info.get("text"):
             sys.stderr.write(flight_info["text"] + "\n")
+        pod_info = result.get("pod") or {}
+        if args.pod and pod_info.get("text"):
+            sys.stderr.write(pod_info["text"] + "\n")
         return 0
 
     try:
@@ -214,6 +224,14 @@ def _add_daemon(sub: argparse._SubParsersAction) -> None:
                         "slice lexicographically")
     p.add_argument("--tpu-worker-index", type=int, default=-1,
                    help="worker index within the slice")
+    p.add_argument("--hostname", default="",
+                   help="override this daemon's advertised hostname "
+                        "(multi-daemon-per-machine tests; the host id is "
+                        "hostname-port)")
+    p.add_argument("--clock-offset", type=float, default=0.0,
+                   help="chaos/test knob: skew every wall stamp this "
+                        "daemon reports by this many seconds — the "
+                        "scheduler's clock alignment must recover it")
     p.set_defaults(func=_run_daemon)
 
 
@@ -246,6 +264,10 @@ def _run_daemon(args: argparse.Namespace) -> int:
         cfg.host.tpu_slice = args.tpu_slice
     if args.tpu_worker_index >= 0:
         cfg.host.tpu_worker_index = args.tpu_worker_index
+    if args.hostname:
+        cfg.host.hostname = args.hostname
+    if args.clock_offset:
+        cfg.clock_offset_s = args.clock_offset
     if args.object_storage_port >= 0:
         cfg.object_storage.enabled = True
         cfg.object_storage.port = args.object_storage_port

@@ -40,6 +40,11 @@ class DfgetConfig:
     # phase breakdown + per-piece waterfall (Daemon.FlightReport) and
     # attach it to the result as ``flight`` ({report, text}).
     explain: bool = False
+    # Pod lens: also fetch the scheduler's merged cross-host timeline for
+    # the task (Daemon.PodTimeline proxies Scheduler.PodTimeline) and
+    # attach it as ``pod`` ({report, text}) — the clock-aligned per-host
+    # phase waterfall with the slowest host named.
+    pod: bool = False
 
 
 async def download(cfg: DfgetConfig, on_progress: Callable[[dict], None] | None = None) -> dict:
@@ -103,6 +108,15 @@ async def _daemon_download(cfg: DfgetConfig, on_progress) -> dict:
                 # The autopsy is advisory: a recorder miss (evicted task,
                 # old daemon) must not fail a completed download.
                 log.warning("flight report unavailable", error=str(e))
+        if cfg.pod and final.get("task_id"):
+            try:
+                final["pod"] = await cli.call(
+                    "Daemon.PodTimeline", {"task_id": final["task_id"]},
+                    timeout=15.0)
+            except DfError as e:
+                # Same advisory posture: no scheduler / no digests yet
+                # must not fail a completed download.
+                log.warning("pod timeline unavailable", error=str(e))
         return final
     finally:
         await cli.close()

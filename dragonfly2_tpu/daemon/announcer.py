@@ -8,6 +8,7 @@ announce (:103-156), LeaveHost on stop.
 from __future__ import annotations
 
 import asyncio
+import time
 
 from dragonfly2_tpu.daemon.config import DaemonConfig
 from dragonfly2_tpu.pkg import dflog, idgen
@@ -24,7 +25,8 @@ except ImportError:  # pragma: no cover
 
 class Announcer:
     def __init__(self, config: DaemonConfig, scheduler_client, *,
-                 peer_port: int, upload_port: int, interval: float = 30.0):
+                 peer_port: int, upload_port: int, interval: float = 30.0,
+                 recorder=None):
         self.config = config
         self.scheduler_client = scheduler_client
         self.peer_port = peer_port
@@ -32,6 +34,21 @@ class Announcer:
         self.interval = interval
         self.host_id = idgen.host_id(config.host.hostname, peer_port)
         self._task: asyncio.Task | None = None
+        # Clock-alignment sampling (pkg/podlens): t0/t1 around each
+        # announce on a monotonic-anchored wall clock (an NTP step mid-
+        # run cannot skew a sample) plus the daemon-wide chaos/test skew
+        # knob; the sample completes when the response's ``sched_wall``
+        # echo arrives and SHIPS ON THE NEXT ANNOUNCE (start() announces
+        # twice so a fresh daemon aligns immediately).
+        self._wall0 = time.time() + config.clock_offset_s
+        self._pc0 = time.perf_counter()
+        self._pending_clock: dict | None = None
+        # Flight recorder to stash the scheduler's scorecard row for this
+        # host into (post-mortem bundles embed it).
+        self.recorder = recorder
+
+    def _wall_now(self) -> float:
+        return self._wall0 + (time.perf_counter() - self._pc0)
 
     def host_wire(self) -> dict:
         h = self.config.host
@@ -66,13 +83,34 @@ class Announcer:
 
     async def start(self) -> None:
         await self.announce_once()
+        # Second immediate announce ships the first's round-trip clock
+        # sample — a fresh daemon is alignable before its first task
+        # finishes, not one announce interval later.
+        if self._pending_clock is not None:
+            await self.announce_once()
         self._task = asyncio.ensure_future(self._loop())
 
     async def announce_once(self) -> None:
+        body = self.host_wire()
+        if self._pending_clock is not None:
+            body["clock"] = self._pending_clock
+        t0 = self._wall_now()
         try:
-            await self.scheduler_client.announce_host(self.host_wire())
+            resp = await self.scheduler_client.announce_host(body)
         except Exception as e:
             log.warning("host announce failed", error=str(e))
+            return
+        t1 = self._wall_now()
+        resp = resp if isinstance(resp, dict) else {}
+        echo = resp.get("sched_wall")
+        if isinstance(echo, (int, float)) and echo > 0:
+            self._pending_clock = {"t0": t0, "t1": t1, "echo": float(echo)}
+        scorecard = resp.get("scorecard")
+        if self.recorder is not None and isinstance(scorecard, dict):
+            # The subject host's fleet-wide standing, embedded into any
+            # post-mortem bundle dumped from here on.
+            self.recorder.scorecard_snapshot = {
+                "at_wall": round(t1, 3), **scorecard}
 
     async def _loop(self) -> None:
         while True:

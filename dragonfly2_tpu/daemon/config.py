@@ -157,6 +157,11 @@ class DaemonConfig:
     # Flight-recorder post-mortem bundles kept on disk (newest-N rotation
     # in pkg/flight; a crash-looping task must not fill the log volume).
     flight_keep_bundles: int = 32
+    # Chaos/test knob: skew every wall stamp this daemon reports (flight
+    # start_wall, announce clock samples) by this many seconds — the pod
+    # lens's clock alignment must then RECOVER the skew, and the e2e pins
+    # that the reported error bound covers it.
+    clock_offset_s: float = 0.0
 
     def __post_init__(self):
         if not self.work_home:

@@ -190,6 +190,7 @@ class Daemon:
             piece_manager=self.piece_manager,
             host_info=host_info,
             meta=meta,
+            flight=self.task_manager.flight.task(task_id),
             quarantine=self.task_manager.quarantine,
             is_seed=is_seed or self.config.seed_peer,
             piece_parallelism=self.config.download.parent_concurrency,
@@ -241,7 +242,8 @@ class Daemon:
             if self._started and self.announcer is None:
                 self.announcer = Announcer(
                     self.config, self.scheduler_client,
-                    peer_port=self._peer_port, upload_port=self.upload.port)
+                    peer_port=self._peer_port, upload_port=self.upload.port,
+                    recorder=self.task_manager.flight)
                 asyncio.create_task(self.announcer.start())
         else:
             self.scheduler_client.update_addrs(addrs)
@@ -291,12 +293,12 @@ class Daemon:
         self.task_manager.shaper.serve()
         # Flight recorder: post-mortem bundles land next to the logs so a
         # failed task's autopsy survives the process (pkg/flight).
-        from dragonfly2_tpu.pkg import flight as flightlib
-
-        recorder = flightlib.recorder()
+        recorder = self.task_manager.flight
         if not recorder.dump_dir:
             recorder.dump_dir = self.config.dfpath.log_dir
         recorder.keep_bundles = self.config.flight_keep_bundles
+        if self.config.clock_offset_s:
+            recorder.wall_offset = self.config.clock_offset_s
         if self.config.metrics_port >= 0:
             from dragonfly2_tpu.pkg.metrics_server import MetricsServer
 
@@ -340,6 +342,7 @@ class Daemon:
                 self.config, self.scheduler_client,
                 peer_port=peer_port,
                 upload_port=self.upload.port,
+                recorder=self.task_manager.flight,
             )
             await self.announcer.start()
         self.gc.serve()

@@ -91,6 +91,7 @@ class PeerTaskConductor:
         disable_back_source: bool = False,
         local_range_source=None,
         quarantine=None,
+        flight=None,
     ):
         self.task_id = task_id
         self.peer_id = peer_id
@@ -125,8 +126,17 @@ class PeerTaskConductor:
         self.quarantine = quarantine
         # Flight recorder: this task's bounded event ring (pkg/flight) —
         # every choke point below stamps it so /debug/flight can autopsy
-        # the download after the fact.
-        self.flight = flightlib.for_task(task_id)
+        # the download after the fact. Injectable so embedded multi-daemon
+        # tests can keep per-daemon recorders (and per-daemon wall
+        # offsets); defaults to the process-wide recorder.
+        self.flight = flight if flight is not None \
+            else flightlib.for_task(task_id)
+        # Announce-path clock samples ([t0, t1, sched_echo] on this
+        # host's anchored wall clock): each register/reconnect answer
+        # that carries the scheduler's ``sched_wall`` yields one; they
+        # ship inside the terminal flight digest so the scheduler's pod
+        # lens can align this host's timeline. Bounded.
+        self._clock_samples: list = []
         self.dispatcher = PieceDispatcher(quarantine=quarantine,
                                           flight=self.flight)
         self.downloader = PieceDownloader()
@@ -193,11 +203,13 @@ class PeerTaskConductor:
         msg = None
         register_error = "scheduler closed stream at register"
         self.flight.record(flightlib.EV_REGISTER)
+        t0_clock = self.flight.wall_now()
         try:
             self._stream = await self.scheduler_client.open_announce_stream(
                 open_body)
             await self._stream.send({"type": "register"})
             msg = await self._stream.recv(timeout=60.0)
+            self._note_clock_sample(t0_clock, msg)
         except DfError as e:
             if self.disable_back_source:
                 await self._teardown()
@@ -502,6 +514,21 @@ class PeerTaskConductor:
         arrives) loses ~the hash cost, and the common case saves all N."""
         return min(3.0, 0.05 + 2 * content_length / 1.0e9)
 
+    def _note_clock_sample(self, t0: float, msg: "dict | None") -> None:
+        """Round-trip clock sample from a register/reconnect answer that
+        carried the scheduler's ``sched_wall`` echo: t0/t1 on this host's
+        anchored wall clock bracket the exchange, so the NTP midpoint
+        error is bounded by (t1-t0)/2 no matter how asymmetric the two
+        legs were. Ships inside the terminal flight digest."""
+        if not msg:
+            return
+        echo = msg.get("sched_wall")
+        if not isinstance(echo, (int, float)) or echo <= 0:
+            return
+        self._clock_samples.append(
+            (t0, self.flight.wall_now(), float(echo)))
+        del self._clock_samples[:-4]
+
     def _apply_stripe(self, stripe: dict | None) -> None:
         """Enter/reshuffle/exit stripe mode from a scheduler handout. The
         plan's mates ride a dedicated field (not the parent DAG — mutual
@@ -669,10 +696,12 @@ class PeerTaskConductor:
                 if self._announce_done:
                     return False
                 try:
+                    t0_clock = self.flight.wall_now()
                     stream = await self.scheduler_client.open_announce_stream(
                         self._open_body)
                     await stream.send({"type": "register"})
                     msg = await stream.recv(timeout=30.0)
+                    self._note_clock_sample(t0_clock, msg)
                 except DfError as e:
                     ANNOUNCE_RECONNECT_COUNT.labels("retry").inc()
                     self.flight.record(flightlib.EV_RECONNECT, -1, 0.0,
@@ -956,6 +985,19 @@ class PeerTaskConductor:
         if msg.get("type") in ("download_finished", "reschedule",
                                "download_failed"):
             await self._flush_reports()
+        if msg.get("type") in ("download_finished", "download_failed") \
+                and "flight" not in msg:
+            # Flight shipping: the terminal announce message carries the
+            # compact bounded digest of this task's event ring (plus the
+            # clock samples) so the scheduler's pod lens can merge a
+            # cross-host timeline without a pull round-trip per host.
+            # Advisory — a digest failure must never fail the task path.
+            try:
+                msg["flight"] = flightlib.digest(
+                    self.flight, clock_samples=self._clock_samples)
+            except Exception:
+                log.warning("flight digest failed",
+                            task=self.task_id[:16], exc_info=True)
         stream = self._stream
         if stream is None or stream.closed:
             return False

@@ -164,6 +164,7 @@ class TaskManager:
         pex=None,
         prefetch: bool = False,
         device_sinks=None,
+        flight=None,
     ):
         self.storage = storage
         self.piece_manager = piece_manager
@@ -197,10 +198,12 @@ class TaskManager:
         # Shared bucket (plain algorithm / non-task transfers).
         self.limiter = self.shaper._shared
         self.broker = PieceBroker()
-        # Flight recorder (pkg/flight): the process-wide bounded task
-        # index; download paths stamp events, terminal paths finish the
-        # flight (histograms + post-mortem dump on failure).
-        self.flight = flightlib.recorder()
+        # Flight recorder (pkg/flight): the bounded task index; download
+        # paths stamp events, terminal paths finish the flight
+        # (histograms + post-mortem dump on failure). Injectable so
+        # embedded multi-daemon tests keep per-daemon recorders; real
+        # daemons share the process-wide one.
+        self.flight = flight if flight is not None else flightlib.recorder()
         self._running: dict[str, _RunningTask] = {}
         # Last completed P2P pull's bytes per parent locality
         # (conductor.locality_bytes), keyed by task id — the striped

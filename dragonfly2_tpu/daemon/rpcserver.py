@@ -38,9 +38,16 @@ class DaemonRpcServer:
         self.download_server.register_unary("Daemon.Health", self._health)
         self.download_server.register_unary("Daemon.FlightReport",
                                             self._flight_report)
+        self.download_server.register_unary("Daemon.PodTimeline",
+                                            self._pod_timeline)
         # Peer-facing service (reference rpcserver.go peer server): piece
         # availability sync for children + seed triggering by the scheduler.
         self.peer_server.register_stream("Peer.SyncPieceTasks", self._sync_piece_tasks)
+        # Scheduler-side on-demand flight pull: a host that never shipped
+        # its digest (crashed stream, old daemon) can still be merged
+        # into the pod timeline.
+        self.peer_server.register_unary("Daemon.FlightReport",
+                                        self._flight_report)
         self.peer_server.register_unary("Peer.GetPieceTasks", self._get_piece_tasks)
         self.peer_server.register_unary("Peer.TriggerDownloadTask", self._trigger_download)
         self.peer_server.register_unary("Peer.StatTask", self._stat_task)
@@ -172,14 +179,30 @@ class DaemonRpcServer:
         """Flight-recorder autopsy for a task this daemon ran: the phase
         breakdown + per-piece waterfall, JSON plus the rendered text
         (dfget --explain prints the latter — identical to the
-        /debug/flight/<task_id>?format=text rendering)."""
+        /debug/flight/<task_id>?format=text rendering) plus the compact
+        digest the scheduler's pod lens merges on an on-demand pull."""
         task_id = (body or {}).get("task_id", "")
-        tf = flightlib.recorder().get(task_id)
+        tf = self.task_manager.flight.get(task_id)
         if tf is None:
             raise DfError(Code.PeerTaskNotFound,
                           f"no flight data for task {task_id}")
         report = flightlib.analyze(tf)
-        return {"report": report, "text": flightlib.render_waterfall(report)}
+        return {"report": report,
+                "text": flightlib.render_waterfall(report),
+                "digest": flightlib.digest(tf)}
+
+    async def _pod_timeline(self, body, ctx: RpcContext):
+        """dfget --pod: proxy the merged cross-host timeline from the
+        scheduler (the daemon owns the ring client; dfget only has the
+        unix socket)."""
+        sc = self.task_manager.scheduler_client
+        if sc is None:
+            raise DfError(Code.SchedError,
+                          "no scheduler configured on this daemon")
+        task_id = (body or {}).get("task_id", "")
+        return await sc.unary(task_id, "Scheduler.PodTimeline",
+                              {"task_id": task_id}, timeout=15.0,
+                              idempotent=True)
 
     # -- peer service ------------------------------------------------------
 

@@ -100,14 +100,22 @@ class SchedulerClient:
             raise first
         raise DfError(Code.SchedError, "no scheduler addresses")
 
-    async def announce_host(self, host_wire: dict) -> None:
-        # Host announcements go to every scheduler (each keeps its own view).
+    async def announce_host(self, host_wire: dict) -> "dict | None":
+        # Host announcements go to every scheduler (each keeps its own
+        # view). Returns the first successful response — it carries the
+        # scheduler's ``sched_wall`` clock echo + this host's scorecard
+        # row (announcer feeds the clock aligner / post-mortem bundles;
+        # with multiple ring members the first member's clock anchors).
+        first: "dict | None" = None
         for addr in self._ring.members():
             try:
-                await self._client_for_addr(addr).call("Scheduler.AnnounceHost", host_wire,
-                                                       timeout=10.0)
+                resp = await self._client_for_addr(addr).call(
+                    "Scheduler.AnnounceHost", host_wire, timeout=10.0)
+                if first is None and isinstance(resp, dict):
+                    first = resp
             except DfError as e:
                 log.warning("announce host failed", addr=addr, error=e.message)
+        return first
 
     async def unary(self, task_id: str, method: str, body: dict,
                     timeout: float = 10.0, idempotent: bool = False):

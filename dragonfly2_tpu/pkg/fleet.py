@@ -159,7 +159,8 @@ class FleetTimeSeries:
     zeroes reused slots and samples the gauge provider."""
 
     __slots__ = ("bucket_s", "n_buckets", "_counts", "_gauges", "_stamp",
-                 "_cur", "_sampler", "_clock", "_wall_anchor")
+                 "_gauge_stamp", "_cur", "_sampler", "_clock",
+                 "_wall_anchor")
 
     def __init__(self, bucket_s: float = 5.0, buckets: int = 720,
                  sampler=None, clock=time.monotonic):
@@ -169,6 +170,10 @@ class FleetTimeSeries:
         self._counts = [[0.0] * nc for _ in range(self.n_buckets)]
         self._gauges = [[0.0] * ng for _ in range(self.n_buckets)]
         self._stamp = [-1] * self.n_buckets      # absolute bucket number
+        # Buckets where the gauge sampler actually RAN (rotation stamps
+        # skipped-over gap buckets too, but only the rotation target gets
+        # a sample — gauge consumers must not read the gaps as zeros).
+        self._gauge_stamp = [-1] * self.n_buckets
         self._cur = -1
         self._sampler = sampler
         self._clock = clock
@@ -219,9 +224,11 @@ class FleetTimeSeries:
             except Exception:          # a broken sampler must not drop events
                 sampled = None
             if sampled:
-                grow = self._gauges[b % self.n_buckets]
+                slot = b % self.n_buckets
+                grow = self._gauges[slot]
                 for i, name in enumerate(GAUGES):
                     grow[i] = float(sampled.get(name, 0.0))
+                self._gauge_stamp[slot] = b
 
     # -- export ------------------------------------------------------------
 
@@ -256,6 +263,43 @@ class FleetTimeSeries:
             "gauges": gauges,
             "totals": {name: sum(vals) for name, vals in series.items()},
         }
+
+    def totals(self, seconds: float, columns: "tuple | list") -> dict:
+        """Per-column sums over the trailing window WITHOUT materializing
+        per-bucket series — the SLO engine's repeated-cadence accessor
+        (window() builds one list per column; at a 720-bucket ring that
+        is ~25k list appends per call, too hot for a burn-rate tick)."""
+        now = self._clock()
+        self.bucket(now)               # rotate so stale slots read zero
+        want = max(1, min(self.n_buckets, int(seconds / self.bucket_s) + 1))
+        cur = int(now / self.bucket_s)
+        idx = [COUNTERS.index(c) for c in columns]
+        sums = [0.0] * len(idx)
+        for a in range(cur - want + 1, cur + 1):
+            slot = a % self.n_buckets
+            if a < 0 or self._stamp[slot] != a:
+                continue
+            row = self._counts[slot]
+            for j, i in enumerate(idx):
+                sums[j] += row[i]
+        return dict(zip(columns, sums))
+
+    def gauge_column(self, name: str, seconds: float) -> list:
+        """One gauge column's sampled values over the trailing window —
+        buckets the sampler actually ran for, only. Gap buckets (rotated
+        past, never sampled) are not fabricated as zeros, so a
+        fraction-of-bad-buckets SLI stays honest."""
+        i = GAUGES.index(name)
+        now = self._clock()
+        self.bucket(now)
+        want = max(1, min(self.n_buckets, int(seconds / self.bucket_s) + 1))
+        cur = int(now / self.bucket_s)
+        out = []
+        for a in range(cur - want + 1, cur + 1):
+            slot = a % self.n_buckets
+            if a >= 0 and self._gauge_stamp[slot] == a:
+                out.append(self._gauges[slot][i])
+        return out
 
     def resident_bytes(self) -> int:
         return (_deep_bytes(self._counts) + _deep_bytes(self._gauges)
@@ -528,17 +572,29 @@ class DecisionLog:
         return self._n
 
     def query(self, *, host: str = "", task: str = "", kind: str = "",
-              limit: int = 256) -> dict:
+              limit: int = 256, since: float = 0.0,
+              before: float = 0.0) -> dict:
+        """Newest-first page. ``since``/``before`` are wall-clock bounds
+        (half-open [since, before)); the ring is time-ordered, so
+        ``since`` also terminates the scan early. ``truncated`` marks a
+        page that hit ``limit`` with more matching entries behind it —
+        the hard response cap that keeps this endpoint bounded at
+        16k-host scale (page back with ``before=<oldest ts>``)."""
         out = []
+        truncated = False
         newest = self._n - 1
         oldest = max(0, self._n - self.cap)
         i = newest
-        while i >= oldest and len(out) < limit:
+        while i >= oldest:
             e = self._ring[i % self.cap]
             i -= 1
             if e is None:
                 continue
             ts, k, t, h, p, reason, chosen, rejected = e
+            if since and ts < since:
+                break          # ring is newest-first: nothing older matches
+            if before and ts >= before:
+                continue
             if kind and k != kind:
                 continue
             if task and t != task:
@@ -549,6 +605,11 @@ class DecisionLog:
                     and not (chosen and host in chosen) \
                     and not (rejected and host in rejected):
                 continue
+            if len(out) >= limit:
+                # One matching entry past the cap proves truncation; the
+                # scan stops here either way.
+                truncated = True
+                break
             row = {"ts": round(ts, 3), "kind": k, "task": t, "host": h,
                    "peer": p, "reason": reason}
             if chosen:
@@ -557,7 +618,8 @@ class DecisionLog:
                 row["rejected"] = list(rejected)
             out.append(row)
         return {"decisions": out, "recorded_total": self._n,
-                "dropped": max(0, self._n - self.cap)}
+                "dropped": max(0, self._n - self.cap),
+                "truncated": truncated}
 
     def resident_bytes(self) -> int:
         return _deep_bytes(self._ring)

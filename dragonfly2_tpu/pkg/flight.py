@@ -32,6 +32,7 @@ no-dict property).
 
 from __future__ import annotations
 
+import gzip
 import json
 import os
 import threading
@@ -41,6 +42,19 @@ from collections import OrderedDict
 from dragonfly2_tpu.pkg import dflog, metrics
 
 log = dflog.get("flight")
+
+# Monotonic-anchored wall clock: wall is sampled ONCE at import and every
+# later reading is anchor + perf_counter delta, so an NTP step mid-run
+# cannot skew any timeline or clock sample built from it. Everything this
+# module (and the pod-lens clock alignment on top of it) calls "wall time"
+# is this clock, optionally plus a per-recorder offset (the chaos knob
+# that lets a test inject a known skew).
+_WALL_ANCHOR = time.time()
+_PC_ANCHOR = time.perf_counter()
+
+
+def anchored_wall() -> float:
+    return _WALL_ANCHOR + (time.perf_counter() - _PC_ANCHOR)
 
 # --------------------------------------------------------------------- #
 # Event vocabulary (ints in the ring; names only at export time)
@@ -124,9 +138,9 @@ class TaskFlight:
                  "_piece_cap", "__weakref__")
 
     def __init__(self, task_id: str, capacity: int = 2048,
-                 piece_track_cap: int = 4096):
+                 piece_track_cap: int = 4096, wall_offset: float = 0.0):
         self.task_id = task_id
-        self.start_wall = time.time()
+        self.start_wall = anchored_wall() + wall_offset
         self._start_pc = time.perf_counter()
         self._cap = capacity
         self._ring: list = [None] * capacity
@@ -175,6 +189,12 @@ class TaskFlight:
             time.perf_counter() - self._start_pc)
         return max(0.0, end)
 
+    def wall_now(self) -> float:
+        """This task's anchored wall clock right now (start_wall + the
+        monotonic delta, so it carries the recorder's wall offset and is
+        NTP-step-proof) — what clock-alignment samples stamp."""
+        return self.start_wall + (time.perf_counter() - self._start_pc)
+
     def events(self) -> list:
         """Chronological retained events (oldest dropped on overflow)."""
         if self._n <= self._cap:
@@ -217,10 +237,13 @@ class TaskFlight:
 # Critical-path analyzer
 # --------------------------------------------------------------------- #
 
-def _fold_phases(intervals: list, wall: float) -> "tuple[dict, float]":
+def _fold_phases(intervals: list, wall: float) -> "tuple[dict, float, list]":
     """Partition [0, wall] across phase intervals: a sweep assigns each
     elementary segment to the highest-priority phase active in it, so the
-    per-phase sums plus the residual ``other`` equal ``wall`` exactly."""
+    per-phase sums plus the residual ``other`` equal ``wall`` exactly.
+    Also returns the assigned timeline as merged ``(start, end, phase)``
+    segments (gaps omitted) — the pod lens ships these so a cross-host
+    merge can draw phase-colored bars without re-shipping raw rings."""
     marks: list = []
     for s, e, ph in intervals:
         s = min(max(s, 0.0), wall)
@@ -230,11 +253,12 @@ def _fold_phases(intervals: list, wall: float) -> "tuple[dict, float]":
             marks.append((e, -1, ph))
     phases = {ph: 0.0 for ph in PHASES}
     if not marks:
-        return phases, wall
+        return phases, wall, []
     marks.sort(key=lambda m: m[0])
     active = {ph: 0 for ph in PHASES}
     other = 0.0
     prev = 0.0
+    segments: list = []
     i, n = 0, len(marks)
     while i < n:
         t = marks[i][0]
@@ -245,6 +269,11 @@ def _fold_phases(intervals: list, wall: float) -> "tuple[dict, float]":
                     best, bp = ph, _PRIORITY[ph]
             if best:
                 phases[best] += t - prev
+                if segments and segments[-1][2] == best \
+                        and segments[-1][1] == prev:
+                    segments[-1][1] = t
+                else:
+                    segments.append([prev, t, best])
             else:
                 other += t - prev
             prev = t
@@ -253,11 +282,11 @@ def _fold_phases(intervals: list, wall: float) -> "tuple[dict, float]":
             i += 1
     if wall > prev:
         other += wall - prev
-    return phases, other
+    return phases, other, segments
 
 
 def analyze(tf: TaskFlight, *, stall_ttfb_s: float = STALL_TTFB_S,
-            max_waterfall: int = 256) -> dict:
+            max_waterfall: int = 256, max_segments: int = 256) -> dict:
     """Fold a task's event ring into the phase breakdown + per-piece
     waterfall. Pure function of the ring — safe to call on a live task
     (the in-flight tail classifies as stall/sched_wait as appropriate)."""
@@ -364,7 +393,7 @@ def analyze(tf: TaskFlight, *, stall_ttfb_s: float = STALL_TTFB_S,
     if sched_open is not None:
         intervals.append((sched_open, wall, "sched_wait"))
 
-    phases, other = _fold_phases(intervals, wall)
+    phases, other, segments = _fold_phases(intervals, wall)
     dominant = ""
     if any(v > 0 for v in phases.values()):
         dominant = max(PHASES, key=lambda p: phases[p])
@@ -384,6 +413,9 @@ def analyze(tf: TaskFlight, *, stall_ttfb_s: float = STALL_TTFB_S,
         "phases": {ph: round(v, 6) for ph, v in phases.items()},
         "other_s": round(other, 6),
         "dominant_phase": dominant,
+        "segments": [[round(s, 6), round(e, 6), ph]
+                     for s, e, ph in segments[:max_segments]],
+        "segments_truncated": len(segments) > max_segments,
         "events": tf.events_total,
         "events_dropped": tf.events_dropped,
         "event_counts": counts,
@@ -429,6 +461,92 @@ def render_waterfall(report: dict) -> str:
 
 
 # --------------------------------------------------------------------- #
+# Flight digest: the compact, bounded form that ships off-host
+# --------------------------------------------------------------------- #
+
+# Hard byte budget for one shipped digest (serialized JSON). The daemon
+# attaches one per task to its terminal announce message, so the bound is
+# per TASK, not per piece — podlens_bench publishes the measured maximum.
+DIGEST_MAX_BYTES = 16384
+
+# Compact piece row order inside a digest (arrays, not dicts — at 64
+# pieces the keys would dominate the byte budget):
+# [piece, attempts, t_request, t_first_byte, t_landed, ok, reason, parent]
+DIGEST_PIECE_FIELDS = ("piece", "attempts", "t_request", "t_first_byte",
+                       "t_landed", "ok", "reason", "parent")
+
+
+def _digest_encoded_len(d: dict) -> int:
+    return len(json.dumps(d, separators=(",", ":")))
+
+
+def digest(tf: TaskFlight, *, max_bytes: int = DIGEST_MAX_BYTES,
+           max_pieces: int = 64, max_events: int = 96,
+           max_segments: int = 64,
+           clock_samples: "list | None" = None) -> dict:
+    """Fold a task's ring into the compact digest the daemon ships to the
+    scheduler on task completion/failure: phase totals + merged phase
+    segments + a truncated piece waterfall + the newest named events,
+    hard-capped at ``max_bytes`` of serialized JSON (pieces, events and
+    segments are halved until the cap holds). ``clock_samples`` carries
+    the announce-stream round-trip samples ([t0, t1, sched_echo] triples
+    on this host's anchored wall clock) the scheduler's clock aligner
+    consumes."""
+    report = analyze(tf, max_waterfall=max_pieces,
+                     max_segments=max_segments)
+    pieces = [[r["piece"], r["attempts"], round(r["t_request"], 4),
+               round(r["t_first_byte"], 4), round(r["t_landed"], 4),
+               1 if r["status"] == "ok" else 0, r["reason"],
+               r["parent"]] for r in report["pieces"]]
+    events = [[round(t, 4), EVENT_NAMES.get(code, str(code)), piece,
+               note] for t, code, piece, _aux, note
+              in tf.events()[-max_events:]]
+    d = {
+        "v": 1,
+        "task_id": tf.task_id,
+        "state": tf.state,
+        "note": tf.note[:200],
+        "start_wall": round(tf.start_wall, 6),
+        "wall_s": report["wall_s"],
+        "phases": report["phases"],
+        "other_s": report["other_s"],
+        "dominant_phase": report["dominant_phase"],
+        "segments": report["segments"],
+        "pieces": pieces,
+        "pieces_total": len(report["pieces"]),
+        "pieces_truncated": report["pieces_truncated"],
+        "events": events,
+        "events_total": tf.events_total,
+        "events_dropped": tf.events_dropped,
+    }
+    if clock_samples:
+        d["clock"] = [[round(t0, 6), round(t1, 6), round(echo, 6)]
+                      for t0, t1, echo in clock_samples[-4:]]
+    # Byte cap: drop detail (events first — the segments/pieces carry the
+    # analytic payload), never the phase totals.
+    size = _digest_encoded_len(d)
+    while size > max_bytes and (d["events"] or len(d["pieces"]) > 8
+                                or len(d["segments"]) > 16):
+        if d["events"]:
+            d["events"] = d["events"][len(d["events"]) // 2:] \
+                if len(d["events"]) > 8 else []
+        elif len(d["pieces"]) > 8:
+            d["pieces"] = d["pieces"][:len(d["pieces"]) // 2]
+            d["pieces_truncated"] = True
+        else:
+            d["segments"] = d["segments"][:len(d["segments"]) // 2]
+        size = _digest_encoded_len(d)
+    d["bytes"] = size
+    return d
+
+
+def digest_piece_rows(d: dict) -> list:
+    """Expand a digest's compact piece arrays back into dict rows."""
+    return [dict(zip(DIGEST_PIECE_FIELDS, row))
+            for row in d.get("pieces") or []]
+
+
+# --------------------------------------------------------------------- #
 # Recorder: the bounded per-process task index
 # --------------------------------------------------------------------- #
 
@@ -438,11 +556,21 @@ class FlightRecorder:
     tuples regardless of how many tasks a daemon serves)."""
 
     def __init__(self, *, capacity: int = 2048, max_tasks: int = 128,
-                 dump_dir: str = "", keep_bundles: int = 32):
+                 dump_dir: str = "", keep_bundles: int = 32,
+                 wall_offset: float = 0.0):
         self.capacity = capacity
         self.max_tasks = max_tasks
         self.dump_dir = dump_dir
         self.keep_bundles = keep_bundles
+        # Chaos/test knob: skew every wall stamp this recorder's flights
+        # report (start_wall, clock samples) by a known amount — what the
+        # pod-lens alignment e2e injects and must then recover.
+        self.wall_offset = wall_offset
+        # Latest fleet-scorecard row the scheduler returned for THIS host
+        # (announcer stashes it each announce); embedded into post-mortem
+        # bundles so a failure autopsy carries the subject host's
+        # fleet-wide standing at failure time.
+        self.scorecard_snapshot: dict = {}
         self._tasks: "OrderedDict[str, TaskFlight]" = OrderedDict()
         self._lock = threading.Lock()
 
@@ -455,8 +583,9 @@ class FlightRecorder:
             if tf is None:
                 while len(self._tasks) >= self.max_tasks:
                     self._evict_one()
-                tf = self._tasks[task_id] = TaskFlight(task_id,
-                                                       self.capacity)
+                tf = self._tasks[task_id] = TaskFlight(
+                    task_id, self.capacity,
+                    wall_offset=self.wall_offset)
         return tf
 
     def _evict_one(self) -> None:
@@ -495,14 +624,17 @@ class FlightRecorder:
         return tf
 
     def _dump(self, tf: TaskFlight, report: dict) -> None:
-        """Post-mortem JSON bundle: the autopsy + the raw (named) event
-        timeline, pruned to ``keep_bundles`` files. Best-effort — a full
-        disk must never fail the task path that triggered the dump."""
+        """Post-mortem bundle: the autopsy + the raw (named) event
+        timeline + this host's latest fleet-scorecard row, gzipped
+        (bundles are JSON text — gzip is ~10x on event timelines), pruned
+        to ``keep_bundles`` files. Best-effort — a full disk must never
+        fail the task path that triggered the dump."""
         try:
             os.makedirs(self.dump_dir, exist_ok=True)
             path = os.path.join(
                 self.dump_dir,
-                f"flight-{tf.task_id[:16]}-{int(time.time() * 1000)}.json")
+                f"flight-{tf.task_id[:16]}-"
+                f"{int(time.time() * 1000)}.json.gz")
             bundle = {
                 "report": report,
                 "events": [
@@ -511,7 +643,9 @@ class FlightRecorder:
                      "piece": piece, "aux": aux, "note": note}
                     for t, code, piece, aux, note in tf.events()],
             }
-            with open(path, "w") as f:
+            if self.scorecard_snapshot:
+                bundle["scorecard"] = dict(self.scorecard_snapshot)
+            with gzip.open(path, "wt") as f:
                 json.dump(bundle, f)
             log.info("flight post-mortem dumped", task=tf.task_id[:16],
                      path=path)
@@ -522,12 +656,18 @@ class FlightRecorder:
     def _prune(self) -> None:
         """Newest-``keep_bundles`` rotation: a crash-looping task dumping
         a bundle per attempt must not grow the log volume forever. mtime
-        orders; the filename's ms stamp breaks same-second ties."""
+        orders; the filename's ms stamp breaks same-second ties. Counts
+        ``.json`` (pre-gzip era) and ``.json.gz`` bundles alike — one
+        budget, not one per extension."""
 
         def stamp(path: str) -> int:
             tail = path.rsplit("-", 1)[-1]
+            for suffix in (".json.gz", ".json"):
+                if tail.endswith(suffix):
+                    tail = tail[:-len(suffix)]
+                    break
             try:
-                return int(tail[:-len(".json")])
+                return int(tail)
             except ValueError:
                 return 0
 
@@ -535,7 +675,8 @@ class FlightRecorder:
             bundles = sorted(
                 (os.path.join(self.dump_dir, name)
                  for name in os.listdir(self.dump_dir)
-                 if name.startswith("flight-") and name.endswith(".json")),
+                 if name.startswith("flight-")
+                 and name.endswith((".json", ".json.gz"))),
                 key=lambda p: (os.path.getmtime(p), stamp(p)))
             drop = bundles[:-self.keep_bundles] if self.keep_bundles > 0 \
                 else bundles

@@ -105,6 +105,23 @@ def families() -> list[dict]:
         ]
 
 
-def render() -> tuple[bytes, str]:
-    """Render the registry for an HTTP /metrics endpoint."""
-    return generate_latest(_registry), CONTENT_TYPE_LATEST
+def render(accept: str = "", registry=None) -> tuple[bytes, str]:
+    """Render the registry for an HTTP /metrics endpoint.
+
+    Content-negotiated: an ``Accept`` header asking for
+    ``application/openmetrics-text`` gets the OpenMetrics exposition
+    (``# HELP``/``# TYPE``/``# EOF``, strict label escaping); everything
+    else gets the classic Prometheus text format. Both come from
+    prometheus_client's exposition writers — the OpenMetrics round-trip
+    test (tests/test_metrics_lint.py) parses our output with the strict
+    parser and cross-checks ``families()``. ``registry`` overrides the
+    process registry (tests probe escaping without polluting it)."""
+    reg = registry if registry is not None else _registry
+    if "application/openmetrics-text" in (accept or ""):
+        from prometheus_client.openmetrics.exposition import (
+            CONTENT_TYPE_LATEST as OPENMETRICS_CONTENT_TYPE,
+            generate_latest as openmetrics_latest,
+        )
+
+        return openmetrics_latest(reg), OPENMETRICS_CONTENT_TYPE
+    return generate_latest(reg), CONTENT_TYPE_LATEST

@@ -5,7 +5,8 @@ manager/metrics, client daemon metrics) and the --pprof-port runtime
 dashboards (cmd/dependency/dependency.go:95-114). The /debug surface is
 the Python analog of pprof: live thread stacks and asyncio task dumps.
 
-Routes: GET /metrics (Prometheus text), GET /healthy,
+Routes: GET /metrics (Prometheus text; OpenMetrics via Accept),
+        GET /healthy,
         GET /debug/stacks (all thread stacks), GET /debug/tasks (asyncio),
         GET /debug/profile?seconds=N (cProfile sample, pprof's CPU
         profile analog), GET /debug/heap?topn=N (tracemalloc snapshot,
@@ -15,12 +16,21 @@ Routes: GET /metrics (Prometheus text), GET /healthy,
         phase breakdown + per-piece waterfall, JSON or rendered text),
         GET /debug/pod/{task_id} (scheduler-side per-host straggler
         attribution from piece-report timings),
+        GET /debug/pod/{task_id}/timeline[?format=text] (pod lens: the
+        merged cross-host broadcast timeline, clock-aligned, slowest
+        host + dominant phase named, alignment error bound printed),
+        GET /debug/slo (the continuous SLO / burn-rate engine's state),
         GET /debug/fleet[?window=seconds] (cluster health time-series),
         GET /debug/fleet/hosts (cross-task host scorecards + straggler
-        flags), GET /debug/fleet/decisions?host=|task=|kind=|n= (the
-        scheduling decision audit log), GET /debug/fleet/info (scheduler
-        uptime / build / config snapshot). All fleet routes are backed by
-        the bounded pkg/fleet observatory the scheduler passes in.
+        flags), GET /debug/fleet/decisions?host=|task=|kind=|n=|since=|
+        before= (the scheduling decision audit log, hard-capped with a
+        truncated marker), GET /debug/fleet/info (scheduler uptime /
+        build / config snapshot). All fleet routes are backed by the
+        bounded pkg/fleet observatory the scheduler passes in.
+
+The route table is a class attribute (``ROUTES``) so tooling and the
+docs lint (tests/test_metrics_lint.py) can introspect every registered
+``/debug/*`` route without serving.
 """
 
 from __future__ import annotations
@@ -61,34 +71,56 @@ def _task_dump() -> str:
 
 
 class MetricsServer:
+    # The single source of truth for the HTTP surface: (path, handler
+    # attribute name). serve() registers exactly this; debug_routes()
+    # exposes it so the docs lint can demand every /debug route be
+    # documented without hand-listing paths anywhere.
+    ROUTES = (
+        ("/metrics", "_metrics"),
+        ("/healthy", "_healthy"),
+        ("/debug/stacks", "_stacks"),
+        ("/debug/tasks", "_tasks"),
+        ("/debug/profile", "_profile"),
+        ("/debug/heap", "_heap"),
+        ("/debug/flight", "_flight_index"),
+        ("/debug/flight/{task_id}", "_flight_task"),
+        ("/debug/pod/{task_id}", "_pod_task"),
+        ("/debug/pod/{task_id}/timeline", "_pod_timeline"),
+        ("/debug/slo", "_slo"),
+        ("/debug/fleet", "_fleet_snapshot"),
+        ("/debug/fleet/hosts", "_fleet_hosts"),
+        ("/debug/fleet/decisions", "_fleet_decisions"),
+        ("/debug/fleet/info", "_fleet_info"),
+    )
+
     def __init__(self, *, flight: "flightlib.FlightRecorder | None" = None,
                  pod_flight: "flightlib.PodAggregator | None" = None,
-                 fleet=None):
+                 fleet=None, slo=None, pod_timeline=None):
         # Optional providers: the daemon passes its flight recorder, the
-        # scheduler its pod aggregator + fleet observatory; endpoints 404
-        # without one.
+        # scheduler its pod aggregator + fleet observatory + SLO engine
+        # + pod-timeline assembler (an async callable task_id -> report,
+        # so the on-demand FlightReport pulls stay in the scheduler);
+        # endpoints 404 without one.
         self._flight = flight
         self._pod_flight = pod_flight
         self._fleet = fleet
+        self._slo_engine = slo
+        self._pod_timeline_provider = pod_timeline
         self._runner: web.AppRunner | None = None
         self._port = 0
         self._profiling = False
 
+    @classmethod
+    def debug_routes(cls) -> list:
+        """Every registered /debug route pattern — what the docs lint
+        walks so no endpoint ships undocumented."""
+        return [path for path, _name in cls.ROUTES
+                if path.startswith("/debug/")]
+
     async def serve(self, host: str, port: int) -> int:
         app = web.Application()
-        app.router.add_get("/metrics", self._metrics)
-        app.router.add_get("/healthy", self._healthy)
-        app.router.add_get("/debug/stacks", self._stacks)
-        app.router.add_get("/debug/tasks", self._tasks)
-        app.router.add_get("/debug/profile", self._profile)
-        app.router.add_get("/debug/heap", self._heap)
-        app.router.add_get("/debug/flight", self._flight_index)
-        app.router.add_get("/debug/flight/{task_id}", self._flight_task)
-        app.router.add_get("/debug/pod/{task_id}", self._pod_task)
-        app.router.add_get("/debug/fleet", self._fleet_snapshot)
-        app.router.add_get("/debug/fleet/hosts", self._fleet_hosts)
-        app.router.add_get("/debug/fleet/decisions", self._fleet_decisions)
-        app.router.add_get("/debug/fleet/info", self._fleet_info)
+        for path, name in self.ROUTES:
+            app.router.add_get(path, getattr(self, name))
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, host, port)
@@ -106,7 +138,11 @@ class MetricsServer:
             await self._runner.cleanup()
 
     async def _metrics(self, request: web.Request) -> web.Response:
-        body, content_type = metrics.render()
+        # Content-negotiated: an OpenMetrics Accept header gets the
+        # strict exposition (scrapers that parse strictly — and our own
+        # round-trip test — use it).
+        body, content_type = metrics.render(request.headers.get("Accept",
+                                                                ""))
         # content_type carries params (version/charset); aiohttp's
         # content_type kwarg rejects those — set the raw header.
         return web.Response(body=body, headers={"Content-Type": content_type})
@@ -184,6 +220,35 @@ class MetricsServer:
             raise web.HTTPNotFound(text=f"no pod data for {task_id}\n")
         return web.json_response(report)
 
+    async def _pod_timeline(self, request: web.Request) -> web.Response:
+        """Pod lens (scheduler binary): the merged cross-host broadcast
+        timeline — every host's shipped flight digest aligned onto one
+        wall axis by the announce-path clock estimator, slowest host and
+        dominant phase named, alignment error bound carried.
+        ``?format=text`` renders the per-host phase-colored lag
+        waterfall (the same renderer ``dfget --pod`` prints)."""
+        if self._pod_timeline_provider is None:
+            raise web.HTTPNotFound(
+                text="no pod lens on this binary (scheduler-only)\n")
+        task_id = request.match_info["task_id"]
+        report = await self._pod_timeline_provider(task_id)
+        if report is None:
+            raise web.HTTPNotFound(
+                text=f"no shipped flight digests for {task_id}\n")
+        if request.query.get("format") == "text":
+            from dragonfly2_tpu.pkg import podlens
+
+            return web.Response(text=podlens.render_timeline(report) + "\n")
+        return web.json_response(report)
+
+    async def _slo(self, request: web.Request) -> web.Response:
+        """The continuous SLO / burn-rate engine (scheduler binary):
+        every declared SLO's per-window burn rates and states."""
+        if self._slo_engine is None:
+            raise web.HTTPNotFound(
+                text="no SLO engine on this binary (scheduler-only)\n")
+        return web.json_response(self._slo_engine.report())
+
     def _need_fleet(self):
         if self._fleet is None:
             raise web.HTTPNotFound(text="no fleet observatory on this "
@@ -214,17 +279,24 @@ class MetricsServer:
         """The scheduling decision audit log, newest first, filterable by
         ?host= / ?task= / ?kind= (handout, quarantine, back_source,
         stripe_handout, stripe_reshuffle, straggler_filter,
-        schedule_failed), ?n= caps the page."""
+        schedule_failed) and bounded in time by ?since=/?before= (wall
+        seconds, half-open [since, before)). ?n= caps the page (hard cap
+        4096); a page that hit the cap with more matching entries behind
+        it carries ``truncated: true`` — page back with
+        ``before=<oldest ts>``."""
         fleet = self._need_fleet()
         try:
             limit = min(max(int(request.query.get("n", "256")), 1), 4096)
+            since = float(request.query.get("since", "0") or 0)
+            before = float(request.query.get("before", "0") or 0)
         except ValueError:
-            return web.Response(text="bad n value\n", status=400)
+            return web.Response(text="bad n/since/before value\n",
+                                status=400)
         return web.json_response(fleet.decisions.query(
             host=request.query.get("host", ""),
             task=request.query.get("task", ""),
             kind=request.query.get("kind", ""),
-            limit=limit))
+            limit=limit, since=since, before=before))
 
     async def _fleet_info(self, request: web.Request) -> web.Response:
         """Scheduler identity card: uptime, build, config snapshot, and

@@ -125,6 +125,29 @@ _PERSISTENT_COMMON = dict(
     task_id=F(str, required=True), peer_id=F(str), host=F(dict, spec=HOST),
 )
 
+# Clock-alignment round-trip sample (pkg/podlens.ClockEstimator): the
+# daemon stamped t0/t1 (its anchored monotonic wall clock) around a prior
+# announce whose response echoed the scheduler's ``sched_wall``; the NTP
+# midpoint (t0+t1)/2 - echo estimates the host's offset with a
+# guaranteed |error| <= (t1-t0)/2 bound.
+CLOCK_SAMPLE = Msg(
+    "ClockSample",
+    t0=F(float, required=True), t1=F(float, required=True),
+    echo=F(float, required=True),
+)
+
+# Compact bounded flight digest (pkg/flight.digest): phase totals +
+# merged phase segments + truncated waterfall + clock samples, shipped on
+# the terminal announce message so the scheduler's pod lens can merge
+# cross-host timelines. Validated loosely (dict) — the digest is
+# forward-evolving and byte-capped at the source.
+FLIGHT_DIGEST = Msg(
+    "FlightDigest",
+    v=F(int), task_id=F(str), state=F(str), start_wall=F(float),
+    wall_s=F(float), phases=F(dict), segments=F(list),
+    pieces=F(list), events=F(list), clock=F(list),
+)
+
 # --------------------------------------------------------------------- #
 # Unary request schemas, keyed by method
 # --------------------------------------------------------------------- #
@@ -135,7 +158,16 @@ UNARY: dict[str, Msg] = {
         "AnnounceHost",
         id=F(str, required=True), hostname=F(str), ip=F(str), port=F(int),
         upload_port=F(int), type=F(int), idc=F(str), location=F(str),
-        tpu_slice=F(str), tpu_worker_index=F(int), telemetry=F(dict)),
+        tpu_slice=F(str), tpu_worker_index=F(int), telemetry=F(dict),
+        # Previous announce's round-trip clock sample (the response
+        # carries ``sched_wall`` to echo back) — feeds the pod lens's
+        # per-host clock alignment.
+        clock=F(dict, spec=CLOCK_SAMPLE)),
+    # Merged cross-host broadcast timeline (pkg/podlens): the scheduler
+    # assembles shipped flight digests (+ on-demand Daemon.FlightReport
+    # pulls) into one wall-aligned pod view — dfget --pod's data source.
+    "Scheduler.PodTimeline": Msg(
+        "PodTimeline", task_id=F(str, required=True)),
     "Scheduler.LeaveHost": Msg("LeaveHost", id=F(str, required=True)),
     "Scheduler.LeavePeer": Msg("LeavePeer", id=F(str, required=True)),
     "Scheduler.AnnounceTask": Msg(
@@ -176,9 +208,15 @@ UNARY: dict[str, Msg] = {
     "Daemon.DeleteTask": Msg("DeleteTask", task_id=F(str, required=True)),
     "Daemon.Health": Msg("Health"),
     # Flight-recorder autopsy: the phase breakdown + waterfall for a task
-    # this daemon ran (dfget --explain, tooling).
+    # this daemon ran (dfget --explain, tooling; also served on the PEER
+    # service so the scheduler can pull digests on demand for the pod
+    # timeline).
     "Daemon.FlightReport": Msg("FlightReport",
                                task_id=F(str, required=True)),
+    # dfget --pod: the daemon proxies the merged cross-host timeline from
+    # the scheduler (Scheduler.PodTimeline) over its own ring client.
+    "Daemon.PodTimeline": Msg("DaemonPodTimeline",
+                              task_id=F(str, required=True)),
 
     # Peer service (TCP — other daemons + scheduler triggers)
     "Peer.GetPieceTasks": Msg(
@@ -301,8 +339,14 @@ STREAM_MSGS: dict[str, dict[str, Msg]] = {
             description=F(str)),
         "download_finished": Msg(
             "DownloadFinished", content_length=F(int), piece_size=F(int),
-            total_piece_count=F(int)),
-        "download_failed": Msg("DownloadFailed", reason=F(str)),
+            total_piece_count=F(int),
+            # Compact bounded flight digest (pkg/flight.digest) — the
+            # "flight shipping" half of the pod lens: named events +
+            # phase segments + per-piece waterfall + clock samples, one
+            # per task, byte-capped at the source.
+            flight=F(dict, spec=FLIGHT_DIGEST)),
+        "download_failed": Msg("DownloadFailed", reason=F(str),
+                               flight=F(dict, spec=FLIGHT_DIGEST)),
     },
 }
 

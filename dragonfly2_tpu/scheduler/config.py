@@ -86,6 +86,22 @@ class FleetConfig:
 
 
 @dataclass
+class PodLensConfig:
+    """Pod lens (pkg/podlens) + SLO engine (pkg/slo) bounds: the merged
+    cross-host timeline store, the per-host clock estimator, and the
+    continuous burn-rate evaluation. All bounded; ``enabled=False``
+    removes the digest-ingest hooks entirely (podlens_bench publishes
+    the paired on/off overhead as ``config10_podlens``)."""
+
+    enabled: bool = True
+    slo_enabled: bool = True
+    max_tasks: int = 256           # task digests kept (LRU past it)
+    clock_hosts: int = 4096        # per-host clock sample slots
+    pull_missing: int = 16         # on-demand FlightReport pulls/timeline
+    max_completions: int = 4096    # SLO completion ring length
+
+
+@dataclass
 class GCConfig:
     peer_ttl: float = PEER_TTL
     host_ttl: float = HOST_TTL
@@ -99,6 +115,7 @@ class SchedulerConfig:
     scheduling: SchedulingConfig = field(default_factory=SchedulingConfig)
     gc: GCConfig = field(default_factory=GCConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
+    podlens: PodLensConfig = field(default_factory=PodLensConfig)
     manager_addr: str = ""                 # manager drpc for registration
     cluster_id: int = 1
     # Durable persistent-cache state (reference: Redis-backed

@@ -56,6 +56,18 @@ class SeedPeerClientPool:
         except Exception:
             return None
 
+    async def flight_digest(self, host, task_id: str) -> dict | None:
+        """On-demand pod-lens pull: the compact flight digest for a task
+        a daemon ran but whose shipped digest never arrived (crashed
+        stream, still running). Best-effort — None on any failure."""
+        cli = self._client(host.ip, host.port)
+        try:
+            resp = await cli.call("Daemon.FlightReport",
+                                  {"task_id": task_id}, timeout=5.0)
+            return resp.get("digest") if isinstance(resp, dict) else None
+        except Exception:
+            return None
+
     async def close(self) -> None:
         for cli in self._clients.values():
             await cli.close()

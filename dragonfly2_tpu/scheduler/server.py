@@ -57,6 +57,10 @@ class SchedulerServer:
                                 s.delete_persistent_cache_task)
         self.rpc.register_unary("Scheduler.StatPeer", s.stat_peer)
         self.rpc.register_unary("Scheduler.ListHosts", s.list_hosts)
+        # Pod lens: the merged cross-host broadcast timeline
+        # (dfget --pod reaches it via the daemon's Daemon.PodTimeline
+        # proxy).
+        self.rpc.register_unary("Scheduler.PodTimeline", s.pod_timeline)
 
     async def _gc(self) -> None:
         counts = self.service.gc()
@@ -75,10 +79,15 @@ class SchedulerServer:
             from dragonfly2_tpu.pkg.metrics_server import MetricsServer
 
             # Loopback by default — /debug exposes live stacks; the pod
-            # aggregator adds /debug/pod/<task_id> straggler attribution
-            # and the fleet observatory the /debug/fleet* family.
-            self.metrics = MetricsServer(pod_flight=self.service.pod_flight,
-                                         fleet=self.service.fleet)
+            # aggregator adds /debug/pod/<task_id> straggler attribution,
+            # the fleet observatory the /debug/fleet* family, the pod
+            # lens /debug/pod/<task_id>/timeline, and the SLO engine
+            # /debug/slo.
+            self.metrics = MetricsServer(
+                pod_flight=self.service.pod_flight,
+                fleet=self.service.fleet,
+                slo=self.service.slo,
+                pod_timeline=self.service.pod_timeline_report)
             await self.metrics.serve("127.0.0.1", self.config.metrics_port)
         self.gc.serve()
         if self.config.manager_addr:

@@ -23,6 +23,8 @@ import asyncio
 from dragonfly2_tpu.pkg import aio, dflog
 from dragonfly2_tpu.pkg import fleet as fleetlib
 from dragonfly2_tpu.pkg import flight as flightlib
+from dragonfly2_tpu.pkg import podlens as podlenslib
+from dragonfly2_tpu.pkg import slo as slolib
 from dragonfly2_tpu.pkg.errors import Code, DfError
 from dragonfly2_tpu.pkg.fsm import TransitionError
 from dragonfly2_tpu.pkg.piece import PieceInfo, SizeScope
@@ -126,6 +128,23 @@ class SchedulerService:
                 })
             if fc.straggler_filter:
                 self.scheduling.wire_fleet(self.fleet)
+        # Pod lens (pkg/podlens): per-host clock alignment from announce
+        # round-trip samples + the bounded store of shipped flight
+        # digests, merged on demand into /debug/pod/<task>/timeline.
+        plc = self.config.podlens
+        self.pod_lens: "podlenslib.PodLens | None" = None
+        if plc.enabled:
+            self.pod_lens = podlenslib.PodLens(
+                max_tasks=plc.max_tasks,
+                clock_estimator=podlenslib.ClockEstimator(
+                    max_hosts=plc.clock_hosts))
+        # SLO engine (pkg/slo): continuous burn rates over the fleet
+        # time-series + pod completions, served at /debug/slo.
+        self.slo: "slolib.SLOEngine | None" = None
+        if plc.enabled and plc.slo_enabled:
+            self.slo = slolib.SLOEngine(
+                series=self.fleet.series if self.fleet else None,
+                max_completions=plc.max_completions)
 
     def _fleet_gauges(self) -> dict:
         """Gauge sample for the fleet time-series. O(hosts+peers+tasks)
@@ -269,13 +288,24 @@ class SchedulerService:
 
     # -- register (reference handleRegisterPeerRequest :991) --------------
 
+    @staticmethod
+    def _stamped(msg: dict) -> dict:
+        """Every register/reschedule ANSWER carries the scheduler's
+        anchored wall clock: the daemon brackets the round trip with its
+        own t0/t1 stamps and the triple becomes a clock-alignment sample
+        (pkg/podlens.ClockEstimator) shipped back inside the flight
+        digest — no extra RPC, the announce stream IS the time source."""
+        msg["sched_wall"] = flightlib.anchored_wall()
+        return msg
+
     async def _handle_register(self, task: Task, peer: Peer) -> None:
         # Empty-content shortcut (reference registerEmptyTask).
         if task.content_length == 0:
             peer.fsm.event("register_empty")
             peer.fsm.event("download_succeeded")
             REGISTER_SCOPE_COUNT.labels("empty").inc()
-            await peer.announce_stream.send({"type": "empty_task"})
+            await peer.announce_stream.send(
+                self._stamped({"type": "empty_task"}))
             return
 
         # Size-scope shortcuts (reference service_v1.go:885-996): once the
@@ -297,9 +327,9 @@ class SchedulerService:
                     peer.fsm.event("register_tiny")
                     peer.fsm.event("download_succeeded")
                     REGISTER_SCOPE_COUNT.labels("tiny").inc()
-                    await peer.announce_stream.send({
+                    await peer.announce_stream.send(self._stamped({
                         "type": "tiny_task", "task": task.to_wire(),
-                        "content": task.direct_piece})
+                        "content": task.direct_piece}))
                     return
             if scope == SizeScope.SMALL and await self._register_small(task, peer):
                 REGISTER_SCOPE_COUNT.labels("small").inc()
@@ -313,8 +343,9 @@ class SchedulerService:
         if peer.is_seed:
             self._mark_task_running(task)
             self._to_back_source(task, peer, "seed peer registration")
-            await peer.announce_stream.send(
-                {"type": "need_back_source", "reason": "seed peer", "task": task.to_wire()})
+            await peer.announce_stream.send(self._stamped(
+                {"type": "need_back_source", "reason": "seed peer",
+                 "task": task.to_wire()}))
             return
 
         seeding = False
@@ -331,14 +362,15 @@ class SchedulerService:
                 if task.can_back_to_source():
                     self._mark_task_running(task)
                     self._to_back_source(task, peer, "first peer, no seed")
-                    await peer.announce_stream.send(
+                    await peer.announce_stream.send(self._stamped(
                         {"type": "need_back_source", "reason": "first peer",
-                         "task": task.to_wire()})
+                         "task": task.to_wire()}))
                     return
                 # Out of back-source budget and nothing running: fail fast.
                 self._fail_peer(peer)
-                await peer.announce_stream.send(
-                    {"type": "schedule_failed", "reason": "no sources available"})
+                await peer.announce_stream.send(self._stamped(
+                    {"type": "schedule_failed",
+                     "reason": "no sources available"}))
                 return
 
         # While a seed is actively fetching, hold the peer in the schedule
@@ -366,9 +398,9 @@ class SchedulerService:
             peer.fsm.event("register_small")
         except Exception:
             return False
-        await peer.announce_stream.send({
+        await peer.announce_stream.send(self._stamped({
             "type": "small_task", "task": task.to_wire(),
-            "parent": parent.to_wire(), "piece": piece.to_wire()})
+            "parent": parent.to_wire(), "piece": piece.to_wire()}))
         return True
 
     def _seed_active(self, task: Task) -> bool:
@@ -416,7 +448,7 @@ class SchedulerService:
                 if self.fleet is not None:
                     self.fleet.note_stripe(task.id, peer.id, peer.host.id,
                                            reshuffle=False)
-            await stream.send(msg)
+            await stream.send(self._stamped(msg))
             if peer.host.tpu_slice:
                 # Membership may have just changed (this peer joined or
                 # reshuffled): re-push differing stripe plans to the other
@@ -426,14 +458,16 @@ class SchedulerService:
         elif result.kind == ScheduleResult.NEED_BACK_SOURCE:
             self._mark_task_running(task)
             self._to_back_source(task, peer, result.reason)
-            await stream.send({"type": "need_back_source", "reason": result.reason,
-                               "task": task.to_wire()})
+            await stream.send(self._stamped(
+                {"type": "need_back_source", "reason": result.reason,
+                 "task": task.to_wire()}))
         else:
             self._fail_peer(peer)
             if self.fleet is not None:
                 self.fleet.note_schedule_failed(task.id, peer.id,
                                                 peer.host.id, result.reason)
-            await stream.send({"type": "schedule_failed", "reason": result.reason})
+            await stream.send(self._stamped(
+                {"type": "schedule_failed", "reason": result.reason}))
 
     # -- striped slice broadcast (scheduling/stripe.py) --------------------
 
@@ -740,7 +774,29 @@ class SchedulerService:
 
     # -- completion (reference :1180/:1236) --------------------------------
 
+    def _note_shipped_flight(self, msg: dict, task: Task,
+                             peer: Peer) -> None:
+        """Flight shipping ingest: the terminal announce message carries
+        the daemon's bounded flight digest (pkg/flight.digest). The pod
+        lens stores it (and its clock samples) for the merged timeline;
+        the SLO engine books the completion SLIs."""
+        fl = msg.get("flight")
+        if not isinstance(fl, dict):
+            return
+        if self.pod_lens is not None:
+            self.pod_lens.note_flight(task.id, peer.host.id, fl,
+                                      peer_id=peer.id)
+        if self.slo is not None and fl.get("state") != "failed" \
+                and msg.get("type", "download_finished") \
+                != "download_failed":
+            makespan, ttfb, stall_frac = podlenslib.completion_stats(fl)
+            if makespan > 0:
+                self.slo.note_completion(peer.host.id, makespan,
+                                         ttfb_s=ttfb,
+                                         stall_frac=stall_frac)
+
     def _handle_download_finished(self, msg: dict, task: Task, peer: Peer) -> None:
+        self._note_shipped_flight(msg, task, peer)
         if peer.state == PeerState.SUCCEEDED:
             return  # tiny-register peers are marked succeeded up front
         try:
@@ -787,6 +843,10 @@ class SchedulerService:
                 port=peer.host.port, upload_port=peer.host.upload_port)
 
     def _handle_download_failed(self, msg: dict, task: Task, peer: Peer) -> None:
+        # The failure's flight digest still merges into the pod timeline
+        # (a failed host is exactly the one an operator wants on the
+        # picture); it books no SLO completion.
+        self._note_shipped_flight(msg, task, peer)
         self._fail_peer(peer)
         # Task fails only when nothing is still making progress.
         still_running = any(
@@ -838,12 +898,38 @@ class SchedulerService:
         host.upload_port = h.get("upload_port", host.upload_port)
         if self.fleet is not None:
             self.fleet.note_announce()
+        # Clock alignment: the previous announce's round-trip sample
+        # (daemon t0/t1 bracketing our echoed sched_wall) feeds the pod
+        # lens's per-host offset estimate.
+        clock = h.get("clock")
+        if self.pod_lens is not None and isinstance(clock, dict):
+            self.pod_lens.clock.add_sample(
+                host.id, float(clock.get("t0", 0.0)),
+                float(clock.get("t1", 0.0)), float(clock.get("echo", 0.0)))
         tel = h.get("telemetry") or {}
         for k, v in tel.items():
             if hasattr(host.telemetry, k):
                 setattr(host.telemetry, k, v)
         host.touch()
-        return {"ok": True}
+        resp: dict = {"ok": True, "sched_wall": flightlib.anchored_wall()}
+        # The subject host's fleet-wide standing rides back so the daemon
+        # can embed it into post-mortem bundles.
+        if self.fleet is not None:
+            s = self.fleet.scorecards._hosts.get(host.id)
+            if s is not None:
+                resp["scorecard"] = {
+                    "serve_ewma_ms": round(s.serve_ewma_ms, 2),
+                    "serve_samples": s.serve_samples,
+                    "down_ewma_ms": round(s.down_ewma_ms, 2),
+                    "down_samples": s.down_samples,
+                    "uploads": round(s.uploads, 1),
+                    "failures": {r: round(v, 2)
+                                 for r, v in s.failures.items()},
+                    "straggler":
+                        self.fleet.scorecards.is_straggler(host.id),
+                    "zscore": self.fleet.scorecards.zscore(host.id),
+                }
+        return resp
 
     async def leave_host(self, body: dict, ctx: RpcContext) -> dict:
         """Host shutdown (reference LeaveHost :641): fail its peers, drop it."""
@@ -1113,6 +1199,47 @@ class SchedulerService:
 
     async def list_hosts(self, body: dict, ctx: RpcContext) -> dict:
         return {"hosts": [h.to_wire() for h in self.hosts.all()]}
+
+    # ------------------------------------------------------------------ #
+    # pod lens: merged cross-host timeline
+    # ------------------------------------------------------------------ #
+
+    async def pod_timeline_report(self, task_id: str) -> "dict | None":
+        """Assemble the merged cross-host timeline: the digests daemons
+        shipped on completion, topped up with bounded on-demand
+        ``Daemon.FlightReport`` pulls for task participants that never
+        shipped one (crashed stream, still running, pre-digest daemon).
+        Pulled digests merge but are not retained — the stream-shipped
+        copy stays authoritative."""
+        if self.pod_lens is None:
+            return None
+        extra: dict = {}
+        task = self.tasks.load(task_id)
+        budget = self.config.podlens.pull_missing
+        if task is not None and budget > 0:
+            shipped = self.pod_lens.shipped_hosts(task_id)
+            missing: dict = {}
+            for p in task.peers():
+                h = p.host
+                if h.id not in shipped and h.id not in missing and h.port > 0:
+                    missing[h.id] = h
+            for host_id, host in list(missing.items())[:budget]:
+                d = await self.seed_clients.flight_digest(host, task_id)
+                if isinstance(d, dict):
+                    extra[host_id] = d
+        return self.pod_lens.timeline(task_id, extra=extra)
+
+    async def pod_timeline(self, body: dict, ctx: RpcContext) -> dict:
+        """Unary surface for dfget --pod (Daemon.PodTimeline proxies
+        here): the merged timeline plus its text waterfall — the SAME
+        renderer /debug/pod/<task_id>/timeline?format=text uses."""
+        task_id = (body or {}).get("task_id", "")
+        report = await self.pod_timeline_report(task_id)
+        if report is None:
+            raise DfError(Code.PeerTaskNotFound,
+                          f"no shipped flight digests for task {task_id}")
+        return {"report": report,
+                "text": podlenslib.render_timeline(report)}
 
     # ------------------------------------------------------------------ #
     # GC

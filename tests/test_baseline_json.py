@@ -29,6 +29,7 @@ REQUIRED_CONFIGS = (
     "config7_chaos",
     "config8_flight",
     "config9_fleet",
+    "config10_podlens",
     "ingest_micro",
 )
 
@@ -149,6 +150,44 @@ def test_fleet_entry_paired_shape():
     # The bound: 4x the hosts must not mean 4x the memory — preallocated
     # rings + LRU-capped scorecards keep it flat.
     assert resident["ratio"] <= 1.5, resident
+
+
+def test_podlens_entry_paired_shape():
+    """config10_podlens is a PAIRED overhead run: the 1024-host DES
+    churn sim with flight digests shipped in BOTH modes and the
+    scheduler-side pod lens + SLO engine toggled; overhead = median of
+    adjacent order-alternating pair ratios (the config9 estimator),
+    within the <=3% budget. The digest round pins the per-task byte
+    bound: every shape under the hard cap."""
+    entry = _load()["published"]["config10_podlens"]
+    churn = entry["churn_sim"]
+    on, off = churn["on"], churn["off"]
+    for run in (on, off):
+        assert run["cpu_s"] > 0 and run["wall_s"] > 0
+    assert churn["hosts"] >= 1024
+    ratios = sorted(churn["pair_ratios"])
+    assert len(ratios) == churn["rounds"] and len(ratios) % 2 == 0
+    median = (ratios[len(ratios) // 2 - 1] + ratios[len(ratios) // 2]) / 2
+    assert churn["cpu_overhead_frac"] == pytest.approx(
+        median - 1.0, abs=1e-3)
+    assert churn["cpu_overhead_frac"] <= 0.03, churn["cpu_overhead_frac"]
+    # Flight-digest bytes per task: bounded and asserted, per shape and
+    # as observed in the sim itself.
+    digest = entry["digest"]
+    assert digest["cap_bytes"] > 0
+    assert 0 < digest["max_bytes"] <= digest["cap_bytes"], digest
+    for name, shape in digest["shapes"].items():
+        assert 0 < shape["bytes"] <= digest["cap_bytes"], name
+        assert shape["build_us"] > 0, name
+    assert 0 < churn["sim_digest_max_bytes"] <= digest["cap_bytes"], churn
+    # The sim actually shipped digests (a zero-digest pair measures
+    # nothing).
+    assert churn["sim_digests"] >= churn["hosts"], churn
+    ingest = entry["ingest"]
+    assert ingest["on_us_per_task"] > 0
+    # The scheduler-side ingest price stays sane: well under a
+    # millisecond per completed task.
+    assert ingest["on_us_per_task"] < 200, ingest
 
 
 def test_ingest_micro_serve_round_paired_shape():

@@ -224,21 +224,34 @@ class TestAnalyzer:
 # Post-mortem bundles
 # --------------------------------------------------------------------- #
 
+def _read_bundle(path):
+    import gzip
+
+    if str(path).endswith(".gz"):
+        with gzip.open(path, "rt") as f:
+            return json.load(f)
+    return json.loads(path.read_text())
+
+
 class TestPostmortem:
-    def test_failure_dumps_bounded_bundles(self, tmp_path):
+    def test_failure_dumps_bounded_gzip_bundles(self, tmp_path):
         rec = flight.FlightRecorder(capacity=64, max_tasks=64,
                                     dump_dir=str(tmp_path), keep_bundles=5)
+        rec.scorecard_snapshot = {"serve_ewma_ms": 12.5, "straggler": False}
         for i in range(9):
             tf = rec.task(f"boom-{i}")
             tf.record(flight.EV_REQUEST, 0, 0.0, "a:1")
             rec.finish_task(f"boom-{i}", "failed", note="chaos ate it")
-        bundles = sorted(tmp_path.glob("flight-*.json"))
+        bundles = sorted(tmp_path.glob("flight-*.json.gz"))
         assert 0 < len(bundles) <= 5, bundles
-        doc = json.loads(bundles[-1].read_text())
+        doc = _read_bundle(bundles[-1])
         assert doc["report"]["state"] == "failed"
         assert doc["report"]["note"] == "chaos ate it"
         names = [e["event"] for e in doc["events"]]
         assert "request" in names and "task_failed" in names
+        # The subject host's fleet scorecard rides in the bundle — the
+        # failure autopsy carries the host's fleet-wide standing.
+        assert doc["scorecard"]["serve_ewma_ms"] == 12.5
 
     def test_rotation_keeps_the_newest_bundles(self, tmp_path):
         """The dump dir is a ring, not a landfill: with keep_bundles=3,
@@ -252,13 +265,41 @@ class TestPostmortem:
             tf.record(flight.EV_REQUEST, 0, 0.0, "a:1")
             rec.finish_task(f"rot-{i}", "failed")
             # Force a strict mtime order even on coarse filesystems.
-            for j, p in enumerate(sorted(tmp_path.glob("flight-*.json"))):
+            for j, p in enumerate(sorted(
+                    tmp_path.glob("flight-*.json.gz"))):
                 os.utime(p, (1000 + j, 1000 + j))
-        survivors = sorted(tmp_path.glob("flight-*.json"))
+        survivors = sorted(tmp_path.glob("flight-*.json.gz"))
         assert len(survivors) == 3
-        kept_tasks = {json.loads(p.read_text())["report"]["task_id"]
+        kept_tasks = {_read_bundle(p)["report"]["task_id"]
                       for p in survivors}
         assert kept_tasks == {"rot-6", "rot-7", "rot-8"}
+
+    def test_rotation_counts_json_and_gz_alike(self, tmp_path):
+        """Pre-gzip-era ``.json`` bundles and fresh ``.json.gz`` ones
+        share ONE rotation budget: with keep_bundles=4, three legacy
+        plain bundles plus four fresh failures leave exactly the four
+        newest files — the oldest legacies are reaped, not grandfathered
+        into a second budget."""
+        import os
+
+        for i in range(3):
+            p = tmp_path / f"flight-legacy-{i}-{i}.json"
+            p.write_text(json.dumps({"report": {"task_id": f"legacy-{i}"}}))
+            os.utime(p, (500 + i, 500 + i))
+        rec = flight.FlightRecorder(dump_dir=str(tmp_path), keep_bundles=4)
+        for i in range(4):
+            tf = rec.task(f"mix-{i}")
+            tf.record(flight.EV_REQUEST, 0, 0.0, "a:1")
+            rec.finish_task(f"mix-{i}", "failed")
+            for j, p in enumerate(sorted(
+                    tmp_path.glob("flight-mix-*.json.gz"))):
+                os.utime(p, (1000 + j, 1000 + j))
+        rec._prune()
+        survivors = sorted(str(p.name) for p in tmp_path.glob("flight-*"))
+        assert len(survivors) == 4, survivors
+        kept = {_read_bundle(tmp_path / name)["report"]["task_id"]
+                for name in survivors}
+        assert kept == {"mix-0", "mix-1", "mix-2", "mix-3"}
 
     def test_default_rotation_budget_is_32(self):
         assert flight.FlightRecorder().keep_bundles == 32
@@ -270,7 +311,7 @@ class TestPostmortem:
         rec = flight.FlightRecorder(dump_dir=str(tmp_path))
         rec.task("fine")
         rec.finish_task("fine", "done")
-        assert not list(tmp_path.glob("flight-*.json"))
+        assert not list(tmp_path.glob("flight-*"))
 
 
 # --------------------------------------------------------------------- #

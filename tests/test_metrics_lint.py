@@ -27,6 +27,7 @@ METRIC_MODULES = (
     "dragonfly2_tpu.pkg.chaos",
     "dragonfly2_tpu.pkg.flight",
     "dragonfly2_tpu.pkg.fleet",
+    "dragonfly2_tpu.pkg.slo",
     "dragonfly2_tpu.pkg.tracing",
     "dragonfly2_tpu.daemon.proxy",
     "dragonfly2_tpu.daemon.upload",
@@ -108,3 +109,120 @@ def test_every_family_documented(all_families):
 def test_every_family_has_help_text(all_families):
     thin = [f["name"] for f in all_families if len(f["doc"]) < 10]
     assert not thin, f"metric families with no real help text: {thin}"
+
+
+# --------------------------------------------------------------------- #
+# Exposition round trips (OpenMetrics conformance satellite)
+# --------------------------------------------------------------------- #
+
+def test_prometheus_exposition_round_trips_families(all_families):
+    """Strict-parse our own classic exposition and cross-check every
+    registered family appears with # HELP/# TYPE and the right kind —
+    a silent serialization bug would otherwise only surface when an
+    external scraper chokes."""
+    from prometheus_client import parser
+
+    from dragonfly2_tpu.pkg import metrics
+
+    text = metrics.render()[0].decode()
+    assert "# HELP" in text and "# TYPE" in text
+    parsed = {f.name: f for f in parser.text_string_to_metric_families(text)}
+    for fam in all_families:
+        full = f"dragonfly_tpu_{fam['name']}"
+        # The parser names counters without the _total suffix.
+        key = full[:-len("_total")] if fam["kind"] == "counter" else full
+        assert key in parsed, f"{full} missing from exposition"
+        assert parsed[key].type == fam["kind"], full
+        assert parsed[key].documentation, full
+
+
+def test_openmetrics_round_trip_and_label_escaping():
+    """The OpenMetrics content negotiation: render with the OpenMetrics
+    Accept type, parse with the STRICT OpenMetrics parser (it rejects
+    missing # EOF, bad escapes, suffix violations), and recover a label
+    value containing every character class the escaping rules cover —
+    in an isolated registry so the process registry stays lint-clean."""
+    from prometheus_client import CollectorRegistry, Counter
+    from prometheus_client.openmetrics import parser as om_parser
+
+    from dragonfly2_tpu.pkg import metrics
+
+    reg = CollectorRegistry()
+    c = Counter("scheduler_escape_probe", "Label escaping probe",
+                ("note",), namespace="dragonfly_tpu", registry=reg)
+    tricky = 'quote " backslash \\ newline \n tab \t end'
+    c.labels(tricky).inc(3)
+
+    body, ctype = metrics.render("application/openmetrics-text",
+                                 registry=reg)
+    assert "openmetrics" in ctype
+    text = body.decode()
+    assert text.rstrip().endswith("# EOF")
+    fams = list(om_parser.text_string_to_metric_families(text))
+    samples = [s for f in fams for s in f.samples
+               if s.name == "dragonfly_tpu_scheduler_escape_probe_total"]
+    assert samples, fams
+    assert samples[0].labels["note"] == tricky
+    assert samples[0].value == 3
+
+    # The classic format negotiates too, and round-trips the same value.
+    from prometheus_client import parser as classic_parser
+
+    body, ctype = metrics.render("", registry=reg)
+    assert "openmetrics" not in ctype
+    fams = list(classic_parser.text_string_to_metric_families(
+        body.decode()))
+    samples = [s for f in fams for s in f.samples
+               if s.name == "dragonfly_tpu_scheduler_escape_probe_total"]
+    assert samples[0].labels["note"] == tricky
+
+
+def test_metrics_endpoint_negotiates_openmetrics(run_async):
+    import aiohttp
+
+    from dragonfly2_tpu.pkg.metrics_server import MetricsServer
+
+    async def body():
+        srv = MetricsServer()
+        port = await srv.serve("127.0.0.1", 0)
+        try:
+            async with aiohttp.ClientSession() as sess:
+                headers = {"Accept":
+                           "application/openmetrics-text; version=1.0.0"}
+                async with sess.get(f"http://127.0.0.1:{port}/metrics",
+                                    headers=headers) as r:
+                    assert "openmetrics" in r.headers["Content-Type"]
+                    text = await r.text()
+                assert text.rstrip().endswith("# EOF")
+                async with sess.get(
+                        f"http://127.0.0.1:{port}/metrics") as r:
+                    assert "openmetrics" not in r.headers["Content-Type"]
+        finally:
+            await srv.close()
+
+    run_async(body(), timeout=60)
+
+
+# --------------------------------------------------------------------- #
+# Debug-route documentation lint (routes introspected, not hand-listed)
+# --------------------------------------------------------------------- #
+
+def test_every_debug_route_documented():
+    """Every /debug route the MetricsServer registers must appear in
+    docs/OBSERVABILITY.md. Routes come from MetricsServer.ROUTES — the
+    same table serve() registers from — so an endpoint cannot ship
+    undocumented and this list cannot rot."""
+    from dragonfly2_tpu.pkg.metrics_server import MetricsServer
+
+    routes = MetricsServer.debug_routes()
+    assert "/debug/pod/{task_id}/timeline" in routes
+    assert "/debug/slo" in routes
+    with open(DOCS) as f:
+        doc = f.read()
+    missing = []
+    for route in routes:
+        needle = route.replace("{task_id}", "<task_id>")
+        if needle not in doc:
+            missing.append(route)
+    assert not missing, (
+        f"debug routes missing from docs/OBSERVABILITY.md: {missing}")

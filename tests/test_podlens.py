@@ -1,0 +1,934 @@
+"""Pod lens + SLO engine: clock alignment under seeded jitter, bounded
+flight digests, cross-host timeline merge, burn-rate evaluation, the new
+debug endpoints, and the chaos-seeded 4-host REAL-process pod e2e.
+
+The acceptance battery (ISSUE 8): a real scheduler + 4 real daemons with
+a seeded slow host (chaos piece-body stalls), one corrupt body, and an
+injected clock skew must yield a merged /debug/pod/<task>/timeline that
+names the seeded host slowest with stall/dcn dominant, matches each
+host's own /debug/flight autopsy within ±5% of wall, and prints an
+alignment error bound that covers the injected skew — while the seeded
+degradation flips an SLO's burn rate over threshold at /debug/slo.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+
+import pytest
+
+from dragonfly2_tpu.pkg import flight, podlens, slo
+from dragonfly2_tpu.pkg.fleet import FleetTimeSeries
+
+
+# --------------------------------------------------------------------- #
+# Clock estimator: the CI guard for alignment regressions
+# --------------------------------------------------------------------- #
+
+class TestClockEstimator:
+    def test_error_within_bound_under_jitter_and_asymmetry(self):
+        """The tier-1 alignment guard: for seeded true offsets, RTT
+        jitter and ASYMMETRIC up/down legs, the estimate's error must
+        stay inside the estimator's own reported bound — the NTP
+        midpoint guarantee |err| <= rtt/2 the merge's printed bound
+        relies on. 100 hosts x 6 samples each."""
+        rng = random.Random(1234)
+        clock = [0.0]
+        est = podlens.ClockEstimator(clock=lambda: clock[0])
+        truths = {}
+        for h in range(100):
+            true_off = rng.uniform(-2.0, 2.0)
+            truths[f"h{h}"] = true_off
+            for _ in range(6):
+                ts = rng.uniform(0, 1000)           # scheduler send time
+                d_up = rng.uniform(0.0005, 0.040)   # asymmetric legs
+                d_down = rng.uniform(0.0005, 0.040)
+                t0 = ts + true_off                  # host clock at send
+                echo = ts + d_up                    # scheduler at receipt
+                t1 = ts + d_up + d_down + true_off  # host clock at reply
+                assert est.add_sample(f"h{h}", t0, t1, echo)
+        for host, true_off in truths.items():
+            off, err, n = est.estimate(host)
+            assert n >= 1
+            assert abs(off - true_off) <= err, (host, off, true_off, err)
+            assert err <= 0.040 / 2 + 0.005, err   # min-rtt selection won
+
+    def test_estimate_error_grows_with_sample_age(self):
+        """An old tight sample must not report a stale-tight bound: the
+        aged bound grows by the drift allowance, and a fresher (looser)
+        sample eventually wins the min-aged-bound selection."""
+        clock = [0.0]
+        est = podlens.ClockEstimator(clock=lambda: clock[0])
+        est.add_sample("h", 100.0, 100.001, 99.5)     # rtt 1ms, off 0.5
+        _, err0, _ = est.estimate("h")
+        clock[0] = 3600.0
+        _, err1, _ = est.estimate("h")
+        assert err1 > err0
+        assert err1 >= 3600.0 * podlens.DRIFT_PPM * 1e-6
+
+    def test_rejects_malformed_samples_and_defaults_unaligned(self):
+        est = podlens.ClockEstimator()
+        assert not est.add_sample("h", 10.0, 9.0, 5.0)   # negative rtt
+        assert not est.add_sample("h", 10.0, 11.0, 0.0)  # no echo
+        off, err, n = est.estimate("h")
+        assert (off, n) == (0.0, 0)
+        assert err == podlens.UNALIGNED_ERR_S
+
+    def test_host_cap_is_lru(self):
+        est = podlens.ClockEstimator(max_hosts=4)
+        for h in range(10):
+            est.add_sample(f"h{h}", 1.0, 1.01, 0.5)
+        assert est.hosts_tracked() == 4
+        assert est.estimate("h9")[2] == 1
+        assert est.estimate("h0")[2] == 0     # evicted
+
+
+# --------------------------------------------------------------------- #
+# Flight digest: compact, bounded, honest
+# --------------------------------------------------------------------- #
+
+class TestFlightDigest:
+    def _flight(self, pieces: int) -> flight.TaskFlight:
+        tf = flight.TaskFlight("digest-t")
+        tf.record(flight.EV_REGISTER)
+        tf.record(flight.EV_SCHEDULED, -1, 0.0, "normal_task")
+        for n in range(pieces):
+            tf.record(flight.EV_REQUEST, n, 0.0, "10.0.0.1:80")
+            tf.record(flight.EV_FIRST_BYTE, n)
+            tf.record(flight.EV_LANDED, n, 3.0, "cross")
+        tf.finish("done")
+        return tf
+
+    def test_digest_holds_byte_cap_under_soak(self):
+        """8192 pieces through the ring: the digest still serializes
+        under DIGEST_MAX_BYTES and says so truthfully."""
+        d = flight.digest(self._flight(8192))
+        raw = json.dumps({k: v for k, v in d.items() if k != "bytes"},
+                         separators=(",", ":"))
+        assert d["bytes"] == len(raw)
+        assert d["bytes"] <= flight.DIGEST_MAX_BYTES
+        assert d["pieces_truncated"] or len(d["pieces"]) <= 64
+
+    def test_digest_carries_segments_phases_and_clock(self):
+        tf = self._flight(8)
+        d = flight.digest(tf, clock_samples=[(10.0, 10.01, 9.7)])
+        assert d["state"] == "done"
+        assert set(d["phases"]) == set(flight.PHASES)
+        assert d["segments"], "phase segments must ship"
+        assert all(len(s) == 3 for s in d["segments"])
+        assert d["clock"] == [[10.0, 10.01, 9.7]]
+        rows = flight.digest_piece_rows(d)
+        assert rows[0]["piece"] == 0 and rows[0]["ok"] == 1
+        # The digest's phase totals are the analyzer's — one source.
+        rep = flight.analyze(tf)
+        assert d["phases"] == rep["phases"]
+
+    def test_tiny_cap_still_yields_valid_digest(self):
+        d = flight.digest(self._flight(512), max_bytes=2048)
+        assert d["bytes"] <= 2048
+        assert d["phases"] and d["wall_s"] >= 0
+
+    def test_recorder_wall_offset_skews_start_wall(self):
+        rec = flight.FlightRecorder(wall_offset=1.5)
+        tf = rec.task("skewed")
+        assert tf.start_wall == pytest.approx(
+            flight.anchored_wall() + 1.5, abs=0.2)
+        assert tf.wall_now() >= tf.start_wall
+
+
+# --------------------------------------------------------------------- #
+# Timeline merge
+# --------------------------------------------------------------------- #
+
+def _mk_digest(host_wall0: float, wall_s: float, *, stall=0.0, dcn=1.0,
+               clock=None) -> dict:
+    segs = []
+    t = 0.0
+    if stall:
+        segs.append([t, t + stall, "stall"])
+        t += stall
+    segs.append([t, t + dcn, "dcn"])
+    d = {
+        "v": 1, "task_id": "merge-t", "state": "done", "note": "",
+        "start_wall": host_wall0, "wall_s": wall_s,
+        "phases": {"sched_wait": 0.0, "dcn": dcn, "ici": 0.0,
+                   "verify": 0.0, "store": 0.0, "stall": stall,
+                   "origin": 0.0},
+        "other_s": max(0.0, wall_s - dcn - stall),
+        "dominant_phase": "stall" if stall > dcn else "dcn",
+        "segments": segs,
+        "pieces": [[0, 1, 0.0, 0.01, dcn, 1, "", "p:1"]],
+        "pieces_total": 1, "pieces_truncated": False,
+        "events": [], "events_total": 4, "events_dropped": 0,
+    }
+    if clock:
+        d["clock"] = clock
+    return d
+
+
+class TestTimelineMerge:
+    def test_alignment_recovers_injected_offsets(self):
+        """Three hosts started simultaneously in TRUE time but with
+        skewed clocks; after merging with their clock samples the
+        aligned starts agree within the carried error bounds."""
+        lens = podlens.PodLens()
+        sched_t0 = 1000.0
+        for host, off in (("ha", 0.0), ("hb", 0.75), ("hc", -0.4)):
+            clock = [[sched_t0 - 0.001 + off, sched_t0 + 0.001 + off,
+                      sched_t0]]
+            lens.note_flight("merge-t", host,
+                             _mk_digest(sched_t0 + off, 1.0, clock=clock))
+        rep = lens.timeline("merge-t")
+        assert rep["hosts_total"] == 3
+        starts = {h["host"]: h["start_wall"] for h in rep["hosts"]}
+        errs = {h["host"]: h["align_err_s"] for h in rep["hosts"]}
+        for a in starts:
+            for b in starts:
+                assert abs(starts[a] - starts[b]) <= errs[a] + errs[b]
+        assert rep["align_err_max_s"] < 0.05
+        offsets = {h["host"]: h["clock_offset_s"] for h in rep["hosts"]}
+        assert offsets["hb"] == pytest.approx(0.75, abs=0.01)
+        assert offsets["hc"] == pytest.approx(-0.4, abs=0.01)
+
+    def test_slowest_host_and_dominant_phase_named(self):
+        lens = podlens.PodLens()
+        lens.note_flight("merge-t", "fast1",
+                         _mk_digest(10.0, 0.5, dcn=0.5))
+        lens.note_flight("merge-t", "fast2",
+                         _mk_digest(10.0, 0.6, dcn=0.6))
+        lens.note_flight("merge-t", "laggard",
+                         _mk_digest(10.0, 4.0, stall=3.0, dcn=1.0))
+        rep = lens.timeline("merge-t")
+        assert rep["slowest_host"] == "laggard"
+        assert rep["dominant_phase"] == "stall"
+        assert rep["hosts"][0]["host"] == "laggard"   # sorted slow-first
+
+    def test_render_draws_bars_bound_and_star(self):
+        lens = podlens.PodLens()
+        lens.note_flight("merge-t", "fast", _mk_digest(10.0, 0.5))
+        lens.note_flight("merge-t", "slow",
+                         _mk_digest(10.0, 2.0, stall=1.5, dcn=0.5))
+        text = podlens.render_timeline(lens.timeline("merge-t"))
+        assert "slowest=slow" in text
+        assert "align_err<=" in text
+        assert "*slow" in text          # slowest starred
+        assert "!" in text and "=" in text   # stall + dcn bars
+        assert "legend:" in text
+
+    def test_on_demand_extra_digests_merge_but_are_not_retained(self):
+        lens = podlens.PodLens()
+        lens.note_flight("merge-t", "shipped", _mk_digest(10.0, 1.0))
+        extra = {"pulled": _mk_digest(10.0, 3.0, stall=2.0)}
+        rep = lens.timeline("merge-t", extra=extra)
+        assert rep["hosts_total"] == 2
+        assert rep["slowest_host"] == "pulled"
+        assert set(lens.digests_for("merge-t")) == {"shipped"}
+
+    def test_task_index_is_bounded(self):
+        lens = podlens.PodLens(max_tasks=4)
+        for i in range(12):
+            lens.note_flight(f"t{i}", "h", _mk_digest(1.0, 1.0))
+        assert len(lens._tasks) == 4
+        assert lens.timeline("t0") is None
+
+    def test_completion_stats_reads_compact_rows(self):
+        d = _mk_digest(10.0, 2.0, stall=1.0, dcn=1.0)
+        makespan, ttfb, stall_frac = podlens.completion_stats(d)
+        assert makespan == 2.0
+        assert ttfb == pytest.approx(0.01)
+        assert stall_frac == pytest.approx(0.5)
+
+
+# --------------------------------------------------------------------- #
+# SLO engine
+# --------------------------------------------------------------------- #
+
+class TestSLOEngine:
+    def test_seeded_degradation_flips_burn_over_threshold(self):
+        """The acceptance semantics in miniature: healthy completions
+        keep every burn at 0; one stall-heavy completion in a small pod
+        burns the 1%-budget stall SLO far past both window thresholds
+        and /debug/slo-shaped output names the breached windows."""
+        clock = [100.0]
+        eng = slo.SLOEngine(clock=lambda: clock[0])
+        for _ in range(6):
+            eng.note_completion("h-ok", 2.0, ttfb_s=0.1, stall_frac=0.01)
+        rep = eng.evaluate()
+        sf = next(s for s in rep["slos"] if s["name"] == "stall_fraction")
+        assert sf["state"] == "ok"
+        eng.note_completion("h-bad", 3.0, ttfb_s=0.2, stall_frac=0.8)
+        rep = eng.evaluate()
+        sf = next(s for s in rep["slos"] if s["name"] == "stall_fraction")
+        assert sf["state"] == "breach"
+        breached_windows = [w for w in sf["windows"]
+                            if w["state"] == "breach"]
+        assert breached_windows, sf
+        for w in breached_windows:
+            assert w["burn_rate"] >= w["burn_threshold"]
+        assert "stall_fraction" in rep["breached"]
+
+    def test_breach_counter_is_edge_triggered(self):
+        clock = [0.0]
+        eng = slo.SLOEngine(clock=lambda: clock[0])
+        eng.note_completion("h", 1.0, stall_frac=0.9)
+        eng.evaluate()
+        eng.evaluate()
+        eng.evaluate()
+        rep = eng.evaluate()
+        sf = next(s for s in rep["slos"] if s["name"] == "stall_fraction")
+        assert sf["breaches_total"] == 1    # one transition, not per eval
+        # Recovery then re-breach counts again.
+        clock[0] += 4000.0                  # old completion ages out
+        for _ in range(3):
+            eng.note_completion("h", 1.0, stall_frac=0.0)
+        rep = eng.evaluate()
+        sf = next(s for s in rep["slos"] if s["name"] == "stall_fraction")
+        assert sf["state"] == "ok"
+        eng.note_completion("h", 1.0, stall_frac=0.9)
+        rep = eng.evaluate()
+        sf = next(s for s in rep["slos"] if s["name"] == "stall_fraction")
+        assert sf["breaches_total"] == 2
+
+    def test_ratio_sli_reads_fleet_series(self):
+        clock = [50.0]
+        series = FleetTimeSeries(clock=lambda: clock[0])
+        from dragonfly2_tpu.pkg import fleet as fleetlib
+
+        for _ in range(10):
+            series.inc(fleetlib.C_REGISTERS)
+        for _ in range(8):
+            series.inc(fleetlib.C_BACK_SOURCE)
+        eng = slo.SLOEngine(series=series, clock=lambda: clock[0])
+        rep = eng.evaluate()
+        bs = next(s for s in rep["slos"] if s["name"] == "back_source_rate")
+        w = bs["windows"][0]
+        assert w["events"] == 10 and w["bad"] == 8
+        assert w["burn_rate"] == pytest.approx(0.8 / 0.25, rel=1e-3)
+        assert bs["state"] == "breach"
+
+    def test_gauge_sli_counts_bad_buckets(self):
+        clock = [50.0]
+        series = FleetTimeSeries(
+            clock=lambda: clock[0],
+            sampler=lambda: {"straggler_hosts": 2.0})
+        from dragonfly2_tpu.pkg import fleet as fleetlib
+
+        for i in range(5):
+            clock[0] += 5.0                # one event per bucket
+            series.inc(fleetlib.C_PIECES)
+        eng = slo.SLOEngine(series=series, clock=lambda: clock[0])
+        rep = eng.evaluate()
+        sg = next(s for s in rep["slos"] if s["name"] == "straggler_hosts")
+        w = sg["windows"][0]
+        assert w["events"] >= 5 and w["bad"] >= 5
+        assert sg["state"] == "breach"
+
+    def test_no_data_without_series_or_completions(self):
+        eng = slo.SLOEngine()
+        rep = eng.evaluate()
+        assert all(s["state"] == "no_data" for s in rep["slos"])
+
+    def test_burn_gauges_exported(self):
+        from dragonfly2_tpu.pkg import metrics as metrics_mod
+
+        eng = slo.SLOEngine()
+        eng.note_completion("h", 1.0, stall_frac=0.9)
+        eng.evaluate()
+        text = metrics_mod.render()[0].decode()
+        assert "dragonfly_tpu_scheduler_slo_burn_rate" in text
+        assert 'slo="stall_fraction"' in text
+        assert "dragonfly_tpu_scheduler_slo_breaches_total" in text
+
+
+# --------------------------------------------------------------------- #
+# Scheduler service integration (in-process)
+# --------------------------------------------------------------------- #
+
+class FakeStream:
+    def __init__(self, open_body):
+        self.open_body = open_body
+        self.to_sched: asyncio.Queue = asyncio.Queue()
+        self.to_peer: asyncio.Queue = asyncio.Queue()
+
+    async def send(self, body):
+        await self.to_peer.put(body)
+
+    async def recv(self, timeout=None):
+        return await self.to_sched.get()
+
+
+def _svc(**podlens_overrides):
+    from dragonfly2_tpu.scheduler.config import SchedulerConfig
+    from dragonfly2_tpu.scheduler.service import SchedulerService
+
+    cfg = SchedulerConfig()
+    cfg.seed_peer_enabled = False
+    cfg.scheduling.retry_interval = 0.05
+    for k, v in podlens_overrides.items():
+        setattr(cfg.podlens, k, v)
+    return SchedulerService(cfg)
+
+
+def _body(host, peer, task="lens-task"):
+    return {"host": {"id": host, "hostname": host, "ip": "127.0.0.1",
+                     "port": 1, "upload_port": 2},
+            "peer_id": peer, "task_id": task, "url": "http://o/f"}
+
+
+class TestServiceIntegration:
+    def test_register_answers_carry_sched_wall(self, run_async):
+        async def body():
+            svc = _svc()
+            stream = FakeStream(_body("h1", "p1"))
+            server = asyncio.ensure_future(svc.announce_peer(stream, None))
+            await stream.to_sched.put({"type": "register"})
+            msg = await asyncio.wait_for(stream.to_peer.get(), timeout=30)
+            assert msg["type"] == "need_back_source"
+            assert msg["sched_wall"] > 0
+            await stream.to_sched.put(None)
+            await asyncio.wait_for(server, timeout=30)
+
+        run_async(body(), timeout=60)
+
+    def test_shipped_digest_feeds_lens_and_slo(self, run_async):
+        async def body():
+            svc = _svc()
+            stream = FakeStream(_body("h1", "p1"))
+            server = asyncio.ensure_future(svc.announce_peer(stream, None))
+            await stream.to_sched.put({"type": "register"})
+            await asyncio.wait_for(stream.to_peer.get(), timeout=30)
+            d = _mk_digest(flight.anchored_wall(), 2.0, stall=1.5,
+                           dcn=0.5,
+                           clock=[[10.0, 10.002, 9.701]])
+            await stream.to_sched.put({"type": "download_finished",
+                                       "content_length": 8,
+                                       "flight": d})
+            await stream.to_sched.put(None)
+            await asyncio.wait_for(server, timeout=30)
+            assert set(svc.pod_lens.digests_for("lens-task")) == {"h1"}
+            off, err, n = svc.pod_lens.clock.estimate("h1")
+            assert n == 1 and off == pytest.approx(0.3, abs=0.01)
+            assert svc.slo.completions_total == 1
+            rep = await svc.pod_timeline_report("lens-task")
+            assert rep["hosts"][0]["host"] == "h1"
+            assert await svc.pod_timeline_report("absent") is None
+
+        run_async(body(), timeout=60)
+
+    def test_timeline_pulls_missing_hosts_on_demand(self, run_async):
+        async def body():
+            svc = _svc()
+            # Two peers register; only h1 ships a digest (h2's stream
+            # "crashed" before download_finished).
+            for host, peer in (("h1", "p1"), ("h2", "p2")):
+                stream = FakeStream(_body(host, peer))
+                server = asyncio.ensure_future(
+                    svc.announce_peer(stream, None))
+                await stream.to_sched.put({"type": "register"})
+                await asyncio.wait_for(stream.to_peer.get(), timeout=30)
+                if host == "h1":
+                    await stream.to_sched.put(
+                        {"type": "download_finished",
+                         "flight": _mk_digest(10.0, 1.0)})
+                await stream.to_sched.put(None)
+                await asyncio.wait_for(server, timeout=30)
+
+            pulled = []
+
+            async def fake_pull(host, task_id):
+                pulled.append((host.id, task_id))
+                return _mk_digest(10.0, 5.0, stall=4.0)
+
+            svc.seed_clients.flight_digest = fake_pull
+            rep = await svc.pod_timeline_report("lens-task")
+            assert pulled == [("h2", "lens-task")]
+            assert rep["hosts_total"] == 2
+            assert rep["slowest_host"] == "h2"
+            # Pulled digests are not retained as shipped.
+            assert set(svc.pod_lens.digests_for("lens-task")) == {"h1"}
+
+        run_async(body(), timeout=60)
+
+    def test_announce_host_clock_sample_and_scorecard(self, run_async):
+        async def body():
+            svc = _svc()
+            resp = await svc.announce_host(
+                {"id": "ah-1", "hostname": "ah", "ip": "1.1.1.1",
+                 "port": 9, "upload_port": 10,
+                 "clock": {"t0": 100.2, "t1": 100.202, "echo": 100.0}},
+                None)
+            assert resp["ok"] and resp["sched_wall"] > 0
+            off, err, n = svc.pod_lens.clock.estimate("ah-1")
+            assert n == 1 and off == pytest.approx(0.201, abs=0.01)
+            # Once the fleet has a scorecard row it rides the response.
+            svc.fleet.scorecards.note_serve("ah-1", 12.0)
+            resp = await svc.announce_host(
+                {"id": "ah-1", "hostname": "ah", "ip": "1.1.1.1"}, None)
+            assert resp["scorecard"]["serve_ewma_ms"] == 12.0
+            assert resp["scorecard"]["straggler"] is False
+
+        run_async(body(), timeout=60)
+
+    def test_podlens_disabled_removes_surfaces(self, run_async):
+        async def body():
+            svc = _svc(enabled=False)
+            assert svc.pod_lens is None and svc.slo is None
+            assert await svc.pod_timeline_report("x") is None
+
+        run_async(body(), timeout=30)
+
+
+# --------------------------------------------------------------------- #
+# Conductor ships the digest (in-process, fake scheduler)
+# --------------------------------------------------------------------- #
+
+class TestConductorShipping:
+    def test_terminal_message_carries_digest_and_clock(self, run_async,
+                                                       tmp_path):
+        from tests.test_chaos import (
+            FakeAnnounceStream,
+            FakeSchedulerClient,
+            _make_conductor,
+        )
+
+        async def body():
+            announce = FakeAnnounceStream([{
+                "type": "normal_task",
+                "task": {"content_length": 8, "piece_size": 4,
+                         "total_piece_count": 2},
+                "parents": [],
+                "sched_wall": flight.anchored_wall() - 0.25,
+            }])
+            sched = FakeSchedulerClient([announce])
+            c = _make_conductor(tmp_path, sched)
+            # Both pieces already on disk: the pull completes instantly.
+            await c.run()
+            finals = [m for m in announce.sent
+                      if m.get("type") == "download_finished"]
+            assert finals, announce.sent
+            d = finals[-1]["flight"]
+            assert d["task_id"] == "chaos-t"
+            assert set(d["phases"]) == set(flight.PHASES)
+            assert d["bytes"] <= flight.DIGEST_MAX_BYTES
+            # The register round trip became a clock sample with the
+            # scheduler's echo in the middle.
+            assert len(d["clock"]) == 1
+            t0, t1, echo = d["clock"][0]
+            assert t0 <= t1
+            assert echo == pytest.approx(t0 + 0.25, abs=2.0)
+
+        run_async(body(), timeout=60)
+
+
+# --------------------------------------------------------------------- #
+# Debug endpoints
+# --------------------------------------------------------------------- #
+
+class TestEndpoints:
+    def test_slo_and_timeline_routes(self, run_async):
+        import aiohttp
+
+        from dragonfly2_tpu.pkg.metrics_server import MetricsServer
+
+        async def body():
+            lens = podlens.PodLens()
+            lens.note_flight("ep-t", "h-slow",
+                             _mk_digest(10.0, 2.0, stall=1.5))
+            lens.note_flight("ep-t", "h-fast", _mk_digest(10.0, 0.5))
+            eng = slo.SLOEngine()
+            eng.note_completion("h-slow", 2.0, stall_frac=0.75)
+
+            async def provider(task_id):
+                return lens.timeline(task_id)
+
+            srv = MetricsServer(slo=eng, pod_timeline=provider)
+            port = await srv.serve("127.0.0.1", 0)
+            base = f"http://127.0.0.1:{port}"
+            try:
+                async with aiohttp.ClientSession() as sess:
+                    async with sess.get(f"{base}/debug/slo") as r:
+                        assert r.status == 200
+                        rep = await r.json()
+                    names = {s["name"] for s in rep["slos"]}
+                    assert {"broadcast_makespan", "stall_fraction",
+                            "back_source_rate"} <= names
+                    async with sess.get(
+                            f"{base}/debug/pod/ep-t/timeline") as r:
+                        assert r.status == 200
+                        tl = await r.json()
+                    assert tl["slowest_host"] == "h-slow"
+                    async with sess.get(f"{base}/debug/pod/ep-t/timeline",
+                                        params={"format": "text"}) as r:
+                        text = await r.text()
+                    assert "slowest=h-slow" in text
+                    assert "align_err<=" in text
+                    async with sess.get(
+                            f"{base}/debug/pod/absent/timeline") as r:
+                        assert r.status == 404
+            finally:
+                await srv.close()
+
+        run_async(body(), timeout=60)
+
+    def test_routes_404_without_providers(self, run_async):
+        import aiohttp
+
+        from dragonfly2_tpu.pkg.metrics_server import MetricsServer
+
+        async def body():
+            srv = MetricsServer()
+            port = await srv.serve("127.0.0.1", 0)
+            try:
+                async with aiohttp.ClientSession() as sess:
+                    for path in ("/debug/slo", "/debug/pod/x/timeline"):
+                        async with sess.get(
+                                f"http://127.0.0.1:{port}{path}") as r:
+                            assert r.status == 404, path
+            finally:
+                await srv.close()
+
+        run_async(body(), timeout=60)
+
+
+# --------------------------------------------------------------------- #
+# Decision-log time filters (satellite)
+# --------------------------------------------------------------------- #
+
+class TestDecisionTimeFilters:
+    def test_since_before_and_truncation(self, monkeypatch):
+        from dragonfly2_tpu.pkg.fleet import DecisionLog
+
+        dl = DecisionLog(cap=64)
+        t = [1000.0]
+        monkeypatch.setattr("dragonfly2_tpu.pkg.fleet.time",
+                            type("T", (), {"time": lambda: t[0]}))
+        for i in range(20):
+            t[0] = 1000.0 + i
+            dl.record("handout", task=f"t{i}", host="h")
+        page = dl.query(since=1005.0, before=1010.0)
+        assert [d["ts"] for d in page["decisions"]] == [
+            1009.0, 1008.0, 1007.0, 1006.0, 1005.0]
+        assert page["truncated"] is False
+        page = dl.query(limit=3)
+        assert len(page["decisions"]) == 3
+        assert page["truncated"] is True
+        assert page["decisions"][0]["ts"] == 1019.0
+        # Paging back with before= walks older entries.
+        older = dl.query(limit=3, before=page["decisions"][-1]["ts"])
+        assert older["decisions"][0]["ts"] == 1016.0
+        # A filter that matches everything scanned but nothing beyond
+        # the limit is NOT truncated.
+        exact = dl.query(since=1018.0)
+        assert len(exact["decisions"]) == 2
+        assert exact["truncated"] is False
+
+
+# --------------------------------------------------------------------- #
+# Chaos-seeded 4-host REAL-process pod e2e (the acceptance case)
+# --------------------------------------------------------------------- #
+
+E2E_CONTENT = bytes(random.Random(88).randbytes(12 * 1024 * 1024))
+TRUE_OFFSET_S = 0.35
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_cli(args, log_path, env_extra=None):
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env.update(env_extra or {})
+    logf = open(log_path, "w")
+    return subprocess.Popen(
+        [sys.executable, "-m", "dragonfly2_tpu.cli.main", *args],
+        stdout=logf, stderr=subprocess.STDOUT, env=env)
+
+
+async def _start_e2e_origin():
+    from aiohttp import web
+
+    from dragonfly2_tpu.pkg.piece import Range
+
+    async def blob(request):
+        rng = request.headers.get("Range")
+        if rng:
+            r = Range.parse_http(rng, len(E2E_CONTENT))
+            data = E2E_CONTENT[r.start:r.start + r.length]
+            return web.Response(status=206, body=data, headers={
+                "Accept-Ranges": "bytes",
+                "Content-Range": f"bytes {r.start}-"
+                                 f"{r.start + r.length - 1}/"
+                                 f"{len(E2E_CONTENT)}"})
+        return web.Response(body=E2E_CONTENT,
+                            headers={"Accept-Ranges": "bytes"})
+
+    app = web.Application()
+    app.router.add_get("/pod.bin", blob)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    return runner, site._server.sockets[0].getsockname()[1]
+
+
+class TestPodLensE2E:
+    """Real scheduler + 4 real daemon processes + chaos: the merged
+    timeline must name the seeded slow host, agree with every host's own
+    autopsy, carry an alignment bound covering the injected clock skew,
+    and the seeded degradation must breach an SLO at /debug/slo."""
+
+    def test_chaos_pod_timeline_and_slo(self, run_async, tmp_path):
+        import glob
+        import hashlib
+        import os
+        import subprocess
+
+        import aiohttp
+
+        sha = hashlib.sha256(E2E_CONTENT).hexdigest()
+
+        async def wait_sock(path, timeout=90.0):
+            deadline = asyncio.get_running_loop().time() + timeout
+            while asyncio.get_running_loop().time() < deadline:
+                if os.path.exists(path):
+                    return True
+                await asyncio.sleep(0.1)
+            return False
+
+        async def run():
+            runner, origin_port = await _start_e2e_origin()
+            url = f"http://127.0.0.1:{origin_port}/pod.bin"
+            sched_port = _free_port()
+            sched_metrics = _free_port()
+            procs = []
+            homes = {}
+            metrics_ports = {}
+            try:
+                procs.append(_spawn_cli(
+                    ["scheduler", "--host", "127.0.0.1",
+                     "--port", str(sched_port),
+                     "--metrics-port", str(sched_metrics)],
+                    str(tmp_path / "sched.log")))
+
+                # The seeded schedule: pod-slow's piece bodies stall 2 s
+                # before the first chunk (silent parent — the flight
+                # recorder books it as stall); pod-a sees ONE corrupt
+                # body (crc reject + retry); pod-slow's clock is skewed
+                # by a known 350 ms the alignment must recover.
+                daemons = {
+                    "pod-seed": ([], {}),
+                    "pod-a": ([], {"DF_CHAOS": json.dumps({
+                        "seed": 11, "rules": [{
+                            "site": "piece.body", "kind": "corrupt",
+                            "rate": 1.0, "max_fires": 1}]})}),
+                    "pod-b": ([], {}),
+                    "pod-slow": (
+                        ["--clock-offset", str(TRUE_OFFSET_S)],
+                        {"DF_CHAOS": json.dumps({
+                            "seed": 7, "rules": [{
+                                "site": "piece.body", "kind": "stall",
+                                "rate": 1.0, "stall_s": 2.0,
+                                "max_fires": 3}]})}),
+                }
+                for name, (extra, env) in daemons.items():
+                    home = str(tmp_path / name)
+                    homes[name] = home
+                    metrics_ports[name] = _free_port()
+                    args = ["daemon", "--work-home", home,
+                            "--hostname", name,
+                            "--scheduler", f"127.0.0.1:{sched_port}",
+                            "--metrics-port",
+                            str(metrics_ports[name]), *extra]
+                    if name == "pod-seed":
+                        args.append("--seed-peer")
+                    procs.append(_spawn_cli(
+                        args, str(tmp_path / f"{name}.log"), env))
+                for name, home in homes.items():
+                    ok = await wait_sock(f"{home}/run/dfdaemon.sock")
+                    assert ok, open(tmp_path / f"{name}.log").read()[-2000:]
+
+                def dfget(name, out, extra=()):
+                    return _spawn_cli(
+                        ["dfget", url, "-O", out,
+                         "--work-home", homes[name], "--no-daemon",
+                         "--digest", f"sha256:{sha}", *extra],
+                        out + ".log")
+
+                async def await_dl(proc, out):
+                    rc = await asyncio.to_thread(proc.wait, 180)
+                    assert rc == 0, open(out + ".log").read()[-2000:]
+                    with open(out, "rb") as f:
+                        got = hashlib.sha256(f.read()).hexdigest()
+                    assert got == sha
+
+                # Warm phase: pod-a (corrupt chaos) + pod-b (clean, with
+                # --explain --pod exercising the full CLI surface).
+                out_a = str(tmp_path / "out-a.bin")
+                out_b = str(tmp_path / "out-b.bin")
+                dl_a = dfget("pod-a", out_a)
+                dl_b = dfget("pod-b", out_b, ("--explain", "--pod"))
+                await asyncio.gather(await_dl(dl_a, out_a),
+                                     await_dl(dl_b, out_b))
+                # The slow host joins a WARM pod: its wall is dominated
+                # by the seeded stalls, not by seed-fetch scheduling.
+                out_s = str(tmp_path / "out-slow.bin")
+                await await_dl(dfget("pod-slow", out_s), out_s)
+
+                # dfget --explain --pod rendered both waterfalls.
+                cli_log = open(out_b + ".log").read()
+                assert "phase breakdown:" in cli_log, cli_log[-2000:]
+                assert "\npod " in cli_log or cli_log.startswith("pod "), \
+                    cli_log[-2000:]
+                assert "legend:" in cli_log
+
+                task_id = None
+                for meta_path in glob.glob(
+                        f"{homes['pod-b']}/**/metadata.json",
+                        recursive=True):
+                    task_id = json.load(open(meta_path))["task_id"]
+                assert task_id
+
+                base = f"http://127.0.0.1:{sched_metrics}"
+                async with aiohttp.ClientSession() as sess:
+                    # -- merged timeline ------------------------------- #
+                    async with sess.get(
+                            f"{base}/debug/pod/{task_id}/timeline") as r:
+                        assert r.status == 200, await r.text()
+                        tl = await r.json()
+                    assert tl["hosts_total"] >= 4, tl
+                    rows = {h["host"]: h for h in tl["hosts"]}
+                    slow_rows = [h for hid, h in rows.items()
+                                 if hid.startswith("pod-slow-")]
+                    assert slow_rows, rows.keys()
+                    slow = slow_rows[0]
+                    # The seeded host is named slowest, stall/dcn
+                    # dominant.
+                    assert tl["slowest_host"].startswith("pod-slow-"), tl
+                    assert slow["dominant_phase"] in ("stall", "dcn"), \
+                        slow
+                    assert slow["phases"]["stall"] >= 1.0, slow
+                    # The alignment bound covers the injected offset.
+                    assert abs(slow["clock_offset_s"] - TRUE_OFFSET_S) \
+                        <= slow["align_err_s"] + 0.005, slow
+                    assert slow["clock_samples"] >= 1
+                    # Unskewed hosts estimate ~zero offset.
+                    for hid, h in rows.items():
+                        if not hid.startswith("pod-slow-") \
+                                and h["clock_samples"]:
+                            assert abs(h["clock_offset_s"]) \
+                                <= h["align_err_s"] + 0.005, h
+
+                    # -- per-host agreement with own autopsies --------- #
+                    for name, mport in metrics_ports.items():
+                        hrow = next(
+                            (h for hid, h in rows.items()
+                             if hid.startswith(f"{name}-")), None)
+                        assert hrow is not None, (name, rows.keys())
+                        async with sess.get(
+                                f"http://127.0.0.1:{mport}"
+                                f"/debug/flight/{task_id}") as r:
+                            assert r.status == 200, name
+                            own = await r.json()
+                        tol = 0.05 * max(own["wall_s"],
+                                         hrow["wall_s"]) + 0.05
+                        for ph in ("stall", "dcn", "origin", "ici"):
+                            assert abs(hrow["phases"][ph]
+                                       - own["phases"][ph]) <= tol, (
+                                name, ph, hrow["phases"], own["phases"])
+
+                    # -- text waterfall -------------------------------- #
+                    async with sess.get(
+                            f"{base}/debug/pod/{task_id}/timeline",
+                            params={"format": "text"}) as r:
+                        text = await r.text()
+                    assert "slowest=pod-slow-" in text
+                    assert "align_err<=" in text
+                    assert "*pod-slow-" in text
+
+                    # -- SLO breach ------------------------------------ #
+                    async with sess.get(f"{base}/debug/slo") as r:
+                        assert r.status == 200
+                        slo_rep = await r.json()
+                    sf = next(s for s in slo_rep["slos"]
+                              if s["name"] == "stall_fraction")
+                    assert sf["state"] == "breach", slo_rep
+                    breached = [w for w in sf["windows"]
+                                if w["state"] == "breach"]
+                    assert breached, sf
+                    for w in breached:
+                        assert w["burn_rate"] >= w["burn_threshold"]
+                    assert "stall_fraction" in slo_rep["breached"]
+                    async with sess.get(f"{base}/metrics") as r:
+                        metrics_text = await r.text()
+                    assert ("dragonfly_tpu_scheduler_slo_burn_rate"
+                            in metrics_text)
+                    assert 'slo="stall_fraction"' in metrics_text
+            finally:
+                import signal
+
+                for p in procs:
+                    if p.poll() is None:
+                        p.send_signal(signal.SIGTERM)
+                for p in procs:
+                    try:
+                        p.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                await runner.cleanup()
+
+        run_async(run(), timeout=420)
+
+
+# --------------------------------------------------------------------- #
+# Wire schema
+# --------------------------------------------------------------------- #
+
+class TestWireSchema:
+    def test_flight_digest_on_terminal_messages(self):
+        from dragonfly2_tpu.proto import wire
+
+        wire.validate_stream_msg("Scheduler.AnnouncePeer", {
+            "type": "download_finished", "content_length": 8,
+            "flight": {"v": 1, "task_id": "t", "wall_s": 1.0,
+                       "phases": {}, "segments": [], "pieces": [],
+                       "clock": [[1.0, 1.01, 0.7]]}})
+        wire.validate_stream_msg("Scheduler.AnnouncePeer", {
+            "type": "download_failed", "reason": "x",
+            "flight": {"v": 1}})
+        with pytest.raises(wire.SchemaError, match="flight"):
+            wire.validate_stream_msg("Scheduler.AnnouncePeer", {
+                "type": "download_finished", "flight": "nope"})
+
+    def test_announce_host_clock_sample(self):
+        from dragonfly2_tpu.proto import wire
+
+        wire.validate_unary("Scheduler.AnnounceHost", {
+            "id": "h", "clock": {"t0": 1.0, "t1": 1.01, "echo": 0.7}})
+        with pytest.raises(wire.SchemaError, match="echo"):
+            wire.validate_unary("Scheduler.AnnounceHost", {
+                "id": "h", "clock": {"t0": 1.0, "t1": 1.01}})
+
+    def test_pod_timeline_unaries(self):
+        from dragonfly2_tpu.proto import wire
+
+        wire.validate_unary("Scheduler.PodTimeline", {"task_id": "t"})
+        wire.validate_unary("Daemon.PodTimeline", {"task_id": "t"})
+        for method in ("Scheduler.PodTimeline", "Daemon.PodTimeline"):
+            with pytest.raises(wire.SchemaError, match="task_id"):
+                wire.validate_unary(method, {})
