@@ -68,6 +68,9 @@ class FakeStream:
     async def recv(self, timeout=None):
         return await self.to_sched.get()
 
+    async def close(self):
+        await self.to_sched.put(None)
+
 
 async def _serve(svc, stream):
     try:
@@ -95,7 +98,8 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
                   churn: bool = False, churn_waves: int = 1,
                   gc_ttl_s: float = 1.0, fleet: bool = True,
                   report_batch: int = 1, podlens: bool = False,
-                  ship_digests: "bool | None" = None) -> dict:
+                  ship_digests: "bool | None" = None,
+                  restart: bool = False) -> dict:
     """``churn=True`` kills whole slices mid-fan-out (their peers' streams
     drop after a few pieces, no finish) and sends straggler waves into the
     SAME slices late — ``churn_waves`` slices die at staggered times, so
@@ -109,6 +113,19 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
     cfg.scheduling.retry_interval = 0.05
     cfg.scheduling.no_source_patience = 1.0
     cfg.seed_peer_enabled = False
+    snapshot_path = ""
+    if restart:
+        # ``restart=True`` kills the scheduler mid-sim: the service is
+        # snapshot-flushed, abandoned, and a NEW service restores from
+        # the durable snapshot while every live peer re-registers with
+        # resume state — the crash-recovery acceptance drill at DES
+        # scale. The snapshot must live in a real file so the fresh
+        # service (a fresh sqlite connection) can read it.
+        import tempfile
+
+        fd, snapshot_path = tempfile.mkstemp(suffix=".snapdb")
+        os.close(fd)
+        cfg.ha.snapshot_db = snapshot_path
     # Short registry TTLs so the post-run sweep proves pod-scale state
     # actually drains (reference scheduler/config/constants.go:77-88) —
     # well above any single peer's in-run idle gap.
@@ -129,6 +146,16 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
     if ship_digests is None:
         ship_digests = podlens
     svc = SchedulerService(cfg)
+    # Peers resolve the CURRENT scheduler through this box: the restart
+    # swaps in the restored replacement service and bumps ``gen`` so
+    # every live peer re-homes (the conductor announce-recovery path,
+    # DES-modeled).
+    svc_box: dict = {"svc": svc, "gen": 0}
+    restart_info: dict = {
+        "at": 0.0, "rebuild_done_at": 0.0, "reregistered": 0,
+        "resume_answers": {}, "rebuilt_piece_mismatch": 0,
+        "restored_peers": 0, "restored_tasks": 0,
+    }
     digest_bytes: list[int] = []
     if ship_digests:
         from dragonfly2_tpu.pkg import flight as flight_mod
@@ -146,8 +173,15 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
     finished: set[int] = set()
     max_lag = 0.0
     dead_peer_ids: set[str] = set()
+    # Which service GENERATION processed each death: a handout of a peer
+    # whose death THIS scheduler observed is a real bug; a snapshot-
+    # restored ghost whose death only the pre-crash scheduler saw is
+    # inherent snapshot staleness (children detect parent-gone and
+    # reschedule) — counted separately, not as a violation.
+    dead_gen: dict[str, int] = {}
     dead_by_slice: dict[int, int] = {k: 0 for k in killed_slice_ids}
     straggler_dead_picks = 0
+    straggler_stale_ghost_picks = 0
     straggler_pick_count = 0
     rss_start = _rss_mb()
 
@@ -165,7 +199,8 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
 
     async def peer(i: int, *, die_after: int = -1,
                    straggler_into: int = -1):
-        nonlocal origin_fetches, straggler_dead_picks, straggler_pick_count
+        nonlocal origin_fetches, straggler_dead_picks, \
+            straggler_stale_ghost_picks, straggler_pick_count
         my_slice = f"slice-{(i // HOSTS_PER_SLICE) % n_slices}"
         body = _open_body(i)
         if straggler_into >= 0:
@@ -176,7 +211,9 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
             body["host"]["idc"] = f"slice-{straggler_into}"
             my_slice = f"slice-{straggler_into}"
         stream = FakeStream(body)
-        server = asyncio.ensure_future(_serve(svc, stream))
+        server = asyncio.ensure_future(_serve(svc_box["svc"], stream))
+        my_gen = svc_box["gen"]
+        killed_here = False
         try:
             t_reg = time.perf_counter()
             await stream.to_sched.put({"type": "register"})
@@ -199,7 +236,7 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
                 intra_in_msg = sum(
                     1 for p in parents_in_msg
                     if (p.get("host") or {}).get("tpu_slice") == my_slice)
-                task_obj = svc.tasks.load(body["task_id"])
+                task_obj = svc_box["svc"].tasks.load(body["task_id"])
                 mates = 0
                 if task_obj is not None:
                     for pid in task_obj.slice_index.get(my_slice, ()):
@@ -224,7 +261,10 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
                     if straggler_into >= 0:
                         straggler_pick_count += 1
                         if p.get("id") in dead_peer_ids:
-                            straggler_dead_picks += 1
+                            if dead_gen.get(p.get("id")) == my_gen:
+                                straggler_dead_picks += 1
+                            else:
+                                straggler_stale_ghost_picks += 1
             elif kind == "small_task":
                 finished.add(i)
                 await stream.to_sched.put(
@@ -252,13 +292,47 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
                 tf.record(flight_mod.EV_SCHEDULED, -1, 0.0, "normal_task")
             pending: list = []
             for n in range(N_PIECES):
+                if restart and svc_box["gen"] != my_gen:
+                    # The scheduler "crashed" under us: abandon the dead
+                    # member's stream, connect to the restored service
+                    # and re-register with FULL resume state — the DES
+                    # model of the conductor's announce recovery. The
+                    # answer must rebuild our landed set (zero re-
+                    # downloads) and must never demote us to origin.
+                    await stream.to_sched.put(None)
+                    await asyncio.wait_for(server, timeout=300)
+                    my_gen = svc_box["gen"]
+                    stream = FakeStream(body)
+                    server = asyncio.ensure_future(
+                        _serve(svc_box["svc"], stream))
+                    done_nums = list(range(n))
+                    await stream.to_sched.put({
+                        "type": "register",
+                        "resume": {"piece_nums": done_nums,
+                                   "content_length": N_PIECES * PIECE_SIZE,
+                                   "piece_size": PIECE_SIZE,
+                                   "total_piece_count": N_PIECES}})
+                    ans = await asyncio.wait_for(stream.to_peer.get(),
+                                                 timeout=300)
+                    kind2 = ans.get("type")
+                    ra = restart_info["resume_answers"]
+                    ra[kind2] = ra.get(kind2, 0) + 1
+                    restart_info["reregistered"] += 1
+                    restart_info["rebuild_done_at"] = time.perf_counter()
+                    q = svc_box["svc"].peers.load(body["peer_id"])
+                    if q is None or not set(done_nums) <= q.finished_pieces:
+                        restart_info["rebuilt_piece_mismatch"] += 1
+                    # Landed pieces ride the resume bitset; buffered
+                    # batch reports for them are redundant.
+                    pending = []
                 if n == die_after:
                     # Slice kill: the stream drops mid-download, no
-                    # finish, no goodbye — the scheduler's stream-gone
-                    # path must reap this peer from the DAG.
-                    dead_peer_ids.add(body["peer_id"])
-                    dead_by_slice[i // HOSTS_PER_SLICE] = \
-                        dead_by_slice.get(i // HOSTS_PER_SLICE, 0) + 1
+                    # finish — the scheduler's stream-gone path must reap
+                    # this peer from the DAG. Bookkeeping happens in the
+                    # finally AFTER the server task drained, so gates
+                    # (stragglers, the restart snapshot) only fire once
+                    # the death has actually been PROCESSED.
+                    killed_here = True
                     return
                 await asyncio.sleep(piece_latency_s * rng.uniform(0.5, 1.5))
                 if tf is not None:
@@ -300,6 +374,11 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
         finally:
             await stream.to_sched.put(None)
             await asyncio.wait_for(server, timeout=300)
+            if killed_here:
+                dead_peer_ids.add(body["peer_id"])
+                dead_gen[body["peer_id"]] = my_gen
+                dead_by_slice[i // HOSTS_PER_SLICE] = \
+                    dead_by_slice.get(i // HOSTS_PER_SLICE, 0) + 1
 
     # Freeze whatever heap the hosting process already carries (a full
     # pytest run drags ~700 MB of prior-test objects): cyclic-GC passes
@@ -324,7 +403,40 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
             await peer(i, die_after=rng.randint(2, N_PIECES // 2)
                        if in_killed else -1)
 
+        async def restarter():
+            """Kill the scheduler mid-sim: flush the durable snapshot,
+            abandon the service, bring up a replacement restored from the
+            snapshot, and bump the generation so every live peer re-homes
+            with resume state. Gated on the first churn wave having been
+            PROCESSED (or ~1/3 completions without churn) so the snapshot
+            is post-kill consistent — the real flush cadence gives the
+            same property via the stream-gone path running before the
+            next periodic flush."""
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + 600
+            if churn:
+                while dead_by_slice.get(killed_slice_ids[0], 0) \
+                        < HOSTS_PER_SLICE:
+                    if loop.time() > deadline:
+                        raise AssertionError("restart gate never opened")
+                    await asyncio.sleep(0.02)
+            else:
+                while len(finished) < max(1, n_hosts // 3):
+                    if loop.time() > deadline:
+                        raise AssertionError("restart gate never opened")
+                    await asyncio.sleep(0.02)
+            old = svc_box["svc"]
+            old.snapshot_flush()
+            restart_info["at"] = time.perf_counter()
+            replacement = SchedulerService(cfg)   # restores from snapshot
+            restart_info["restored_peers"] = len(replacement.peers.all())
+            restart_info["restored_tasks"] = len(replacement.tasks.all())
+            svc_box["svc"] = replacement
+            svc_box["gen"] += 1
+
         waves = [delayed(i) for i in range(n_hosts)]
+        if restart:
+            waves.append(restarter())
         for w, k in enumerate(killed_slice_ids):
             async def straggle(i, k=k, w=w):
                 # Join AFTER this wave's kills have actually LANDED —
@@ -346,6 +458,12 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
     finally:
         hb.cancel()
         gc.unfreeze()
+        if snapshot_path:
+            try:
+                os.unlink(snapshot_path)
+            except OSError:
+                pass
+    svc = svc_box["svc"]   # the post-restart service owns the end state
     wall = time.perf_counter() - t0
     # Scheduler CPU for the storm itself — read BEFORE the TTL sweep and
     # the fleet-stats export below (resident_bytes is a deliberate deep
@@ -419,6 +537,7 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
         "killed_peers": len(dead_peer_ids),
         "straggler_parent_picks": straggler_pick_count,
         "straggler_dead_parent_picks": straggler_dead_picks,
+        "straggler_stale_ghost_picks": straggler_stale_ghost_picks,
         "parent_picks": total_picks,
         "schedule_p50_ms": round(
             statistics.median(schedule_lat) * 1000, 1),
@@ -444,6 +563,18 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
         "fleet": fleet_stats,
         "podlens_enabled": podlens,
         "podlens": podlens_stats,
+        "restart_enabled": restart,
+        "restart": {
+            "rebuild_s": round(max(0.0, restart_info["rebuild_done_at"]
+                                   - restart_info["at"]), 3),
+            "reregistered": restart_info["reregistered"],
+            "resume_answers": restart_info["resume_answers"],
+            "rebuilt_piece_mismatch": restart_info["rebuilt_piece_mismatch"],
+            "restored_peers": restart_info["restored_peers"],
+            "restored_tasks": restart_info["restored_tasks"],
+        } if restart else None,
+        "completion_rate": round(len(finished) / expected_finishers, 4)
+        if expected_finishers else 1.0,
     }
 
 
@@ -531,6 +662,25 @@ def check_churn(result: dict) -> None:
     check_timing(result)
 
 
+def check_restart_behavior(result: dict) -> None:
+    """Load-independent invariants for the mid-sim scheduler restart:
+    completion despite the restart, every live peer re-registered onto
+    the restored service, every resume answer was normal_task (a
+    back-source demotion here would be the origin-storm bug this PR
+    exists to prevent), and the restored service's view of each peer's
+    landed set covered the peer's actual landed set (zero re-downloaded
+    landed bytes — the scheduler can never reschedule a piece it knows
+    is landed)."""
+    assert result["restart_enabled"], "restart invariants need restart=True"
+    r = result["restart"]
+    assert result["completion_rate"] == 1.0, result
+    assert r["reregistered"] > 0, r
+    assert set(r["resume_answers"]) == {"normal_task"}, r
+    assert r["rebuilt_piece_mismatch"] == 0, r
+    assert r["restored_peers"] > 0, r
+    assert r["rebuild_s"] >= 0, r
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--hosts", type=int, default=256)
@@ -538,19 +688,36 @@ def main() -> int:
                     help="kill slices mid-fan-out + late stragglers")
     ap.add_argument("--churn-waves", type=int, default=1,
                     help="how many slices die (sustained churn)")
+    ap.add_argument("--restart", action="store_true",
+                    help="kill + snapshot-restore the scheduler mid-sim "
+                         "(crash-recovery drill)")
+    ap.add_argument("--piece-latency", type=float, default=0.002)
     ap.add_argument("--publish", action="store_true")
     args = ap.parse_args()
 
     result = asyncio.run(run_sim(args.hosts, churn=args.churn,
-                                 churn_waves=args.churn_waves))
-    (check_churn if args.churn else check)(result)
+                                 churn_waves=args.churn_waves,
+                                 piece_latency_s=args.piece_latency,
+                                 restart=args.restart))
+    if args.restart:
+        # Restart runs assert BEHAVIOR only: the in-process crash window
+        # (synchronous snapshot restore + the whole fleet re-registering
+        # at once) IS a loop stall by design — max_loop_lag measures the
+        # deliberate outage, not a scheduler pathology. The numbers still
+        # publish for tracking.
+        (check_churn_behavior if args.churn else check_behavior)(result)
+        check_restart_behavior(result)
+    else:
+        (check_churn if args.churn else check)(result)
     print(json.dumps(result))
 
     if args.publish:
         path = os.path.join(REPO, "BASELINE.json")
         doc = json.load(open(path))
         key = "config5_pod_sim_churn" if args.churn else "config5_pod_sim"
-        if args.hosts >= 1024:
+        if args.hosts >= 4096:
+            key += "_4k"
+        elif args.hosts >= 1024:
             key += "_1024"
         doc.setdefault("published", {})[key] = result
         with open(path, "w") as f:

@@ -171,6 +171,10 @@ class PeerTaskConductor:
         self._announce_lock = asyncio.Lock()
         self._announce_done = False
         self._stream_reconnects = 0
+        # Ring-rebuild re-homing: set when dynconfig moved this task's
+        # ownership to a different live member — the next successful
+        # reconnect books as result="rehomed" instead of "ok".
+        self._rehome_pending = False
 
     # ------------------------------------------------------------------ #
 
@@ -200,6 +204,12 @@ class PeerTaskConductor:
         # and clients without source-fallback permission still succeed).
         # A scheduler-SENT rejection (schedule_failed) stays fatal via the
         # dispatch below.
+        # Ring-rebuild observation (dynconfig scheduler-set changes):
+        # when ownership moves to a different LIVE member, drain and
+        # re-home instead of riding the stale shard until it dies.
+        watch = getattr(self.scheduler_client, "watch_ring", None)
+        if watch is not None:
+            watch(self.task_id, self._on_ring_change)
         msg = None
         register_error = "scheduler closed stream at register"
         self.flight.record(flightlib.EV_REGISTER)
@@ -207,7 +217,13 @@ class PeerTaskConductor:
         try:
             self._stream = await self.scheduler_client.open_announce_stream(
                 open_body)
-            await self._stream.send({"type": "register"})
+            reg: dict = {"type": "register"}
+            if self.store.metadata.pieces:
+                # Daemon restart with a partial store (or a re-run over
+                # persisted pieces): the scheduler rebuilds our state
+                # instead of treating us as fresh.
+                reg["resume"] = self._resume_state()
+            await self._stream.send(reg)
             msg = await self._stream.recv(timeout=60.0)
             self._note_clock_sample(t0_clock, msg)
         except DfError as e:
@@ -663,6 +679,54 @@ class PeerTaskConductor:
     RECONNECT_BUDGET = 4
     MAX_STREAM_RECONNECTS = 8
 
+    def _resume_state(self) -> dict:
+        """This task's full local state for a (re-)register: landed piece
+        bitset, task geometry, the verified content digest once the store
+        completed (mid-flight the per-piece digests ride the idempotent
+        re-report instead), stripe membership and the pod-broadcast flag.
+        A failover ring member — or a restarted scheduler — rebuilds its
+        Task/Peer FSMs from this instead of treating us as fresh."""
+        m = self.store.metadata
+        resume: dict = {
+            "piece_nums": sorted(m.pieces.keys()),
+            "content_length": m.content_length,
+            "piece_size": m.piece_size,
+            "total_piece_count": m.total_piece_count,
+            "prefix_digest": m.digest or "",
+            "pod_broadcast": bool(self.meta.get("pod_broadcast")),
+        }
+        stripe = self.dispatcher.stripe
+        if stripe is not None:
+            resume["stripe"] = {"slice_size": stripe[0],
+                                "slice_rank": stripe[1]}
+        return resume
+
+    def _on_ring_change(self, new_owner: str) -> None:
+        """SchedulerClient ring-rebuild callback: this task's ownership
+        moved to a different live member (the old one may be perfectly
+        healthy — just no longer owning). Drain gracefully and re-home:
+        flush buffered reports to the old member, close the stream, and
+        let the receiver loop's recovery path reconnect — the ring now
+        resolves to the new owner, and the re-register carries resume
+        state so the new member adopts the task mid-flight."""
+        if self._announce_done:
+            return
+        self._rehome_pending = True
+        log.info("task ownership moved; re-homing announce stream",
+                 task=self.task_id[:16], new_owner=new_owner)
+        asyncio.ensure_future(self._rehome())
+
+    async def _rehome(self) -> None:
+        try:
+            await self._flush_reports()
+        except Exception:
+            pass  # stream already dying: recovery re-reports anyway
+        stream = self._stream
+        if stream is not None and not stream.closed:
+            await stream.close()
+        # The receiver loop's recv now returns None → recovery reconnects
+        # on the rebuilt ring (and books result="rehomed").
+
     def _degrade_after_scheduler_loss(self) -> None:
         """Reconnect budget exhausted: the schedulerless endgame. With
         origin allowed the workers hand the remainder to back-to-source
@@ -699,7 +763,11 @@ class PeerTaskConductor:
                     t0_clock = self.flight.wall_now()
                     stream = await self.scheduler_client.open_announce_stream(
                         self._open_body)
-                    await stream.send({"type": "register"})
+                    # Re-register with FULL resume state: a failover ring
+                    # member (or restarted scheduler) rebuilds Task/Peer
+                    # FSMs from it instead of demoting us to origin.
+                    await stream.send({"type": "register",
+                                       "resume": self._resume_state()})
                     msg = await stream.recv(timeout=30.0)
                     self._note_clock_sample(t0_clock, msg)
                 except DfError as e:
@@ -751,10 +819,13 @@ class PeerTaskConductor:
                         "dst_peer_id": "",
                     })
                 await self._flush_reports()
-                ANNOUNCE_RECONNECT_COUNT.labels("ok").inc()
-                self.flight.record(flightlib.EV_RECONNECT, -1, 0.0, "ok")
+                outcome = "rehomed" if self._rehome_pending else "ok"
+                self._rehome_pending = False
+                ANNOUNCE_RECONNECT_COUNT.labels(outcome).inc()
+                self.flight.record(flightlib.EV_RECONNECT, -1, 0.0, outcome)
                 log.info("announce stream recovered",
                          task=self.task_id[:16], attempt=attempt,
+                         result=outcome,
                          reconnects=self._stream_reconnects)
                 return True
             ANNOUNCE_RECONNECT_COUNT.labels("exhausted").inc()
@@ -1009,6 +1080,9 @@ class PeerTaskConductor:
 
     async def _teardown(self) -> None:
         self._announce_done = True   # recovery must not race teardown
+        unwatch = getattr(self.scheduler_client, "unwatch_ring", None)
+        if unwatch is not None:
+            unwatch(self.task_id)
         if self._flush_task is not None and not self._flush_task.done():
             self._flush_task.cancel()
         await self._flush_reports()

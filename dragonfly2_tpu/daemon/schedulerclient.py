@@ -7,13 +7,65 @@ wrapper the conductor drives.
 
 from __future__ import annotations
 
-from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg import dflog, metrics
 from dragonfly2_tpu.pkg.errors import Code, DfError
 from dragonfly2_tpu.pkg.types import NetAddr
 from dragonfly2_tpu.rpc import Client, ClientStream
 from dragonfly2_tpu.rpc.balancer import HashRing
 
 log = dflog.get("daemon.schedulerclient")
+
+FAILOVER_COUNT = metrics.counter(
+    "peer_scheduler_failover_total",
+    "Announce-stream opens by ring outcome: owner (the consistent-hash "
+    "owner answered), failover (a clockwise substitute answered while "
+    "the owner was unreachable), exhausted (no ring member reachable)",
+    ("result",))
+
+# --------------------------------------------------------------------- #
+# RPC classification — THE table (satellite of ISSUE 9).
+#
+# Every scheduler RPC this daemon speaks must have a row here; the guard
+# test (tests/test_scheduler_ha.py) greps the daemon/client sources for
+# quoted Scheduler.<Method> literals and fails on any name missing from
+# the table — a silently misclassified RPC is a failover correctness bug
+# (ring failover of a state-bearing call turns a retryable connection
+# error into an authoritative-looking "not found" from a member that
+# never owned the task).
+#
+#   stream         AnnouncePeer: ring-ordered open with clockwise
+#                  failover; recovery re-registers with resume state and
+#                  re-reports idempotently, so ANY member can adopt it.
+#   idempotent     safe to land on any ring member — registration-shaped
+#                  or read-only; ``unary()`` fails over on connection
+#                  errors.
+#   state_bearing  must land on the member holding the task FSM; NO ring
+#                  failover — the owning member's (retryable) connection
+#                  error is the correct surface.
+#   fanout         sent to every ring member (each keeps its own view).
+# --------------------------------------------------------------------- #
+
+STREAM = "stream"
+IDEMPOTENT = "idempotent"
+STATE_BEARING = "state_bearing"
+FANOUT = "fanout"
+
+RPC_TABLE: dict[str, str] = {
+    "Scheduler.AnnouncePeer": STREAM,
+    "Scheduler.AnnounceHost": FANOUT,
+    "Scheduler.LeaveHost": FANOUT,
+    "Scheduler.AnnounceTask": IDEMPOTENT,
+    "Scheduler.LeavePeer": IDEMPOTENT,
+    "Scheduler.StatTask": IDEMPOTENT,
+    "Scheduler.StatPeer": IDEMPOTENT,
+    "Scheduler.PodTimeline": IDEMPOTENT,
+    "Scheduler.UploadPersistentCacheTaskStarted": STATE_BEARING,
+    "Scheduler.UploadPersistentCacheTaskFinished": STATE_BEARING,
+    "Scheduler.UploadPersistentCacheTaskFailed": STATE_BEARING,
+    "Scheduler.StatPersistentCacheTask": STATE_BEARING,
+    "Scheduler.ListPersistentCacheTasks": STATE_BEARING,
+    "Scheduler.DeletePersistentCacheTask": STATE_BEARING,
+}
 
 
 class SchedulerClient:
@@ -22,6 +74,12 @@ class SchedulerClient:
             raise DfError(Code.BadRequest, "no scheduler addresses")
         self._ring = HashRing(addrs)
         self._clients: dict[str, Client] = {}
+        # Ring-rebuild observers: task_id → callback(new_owner_addr),
+        # fired when a dynconfig scheduler-set change moves the task's
+        # ownership away from the member its announce stream currently
+        # sits on (conductor re-homes gracefully — satellite of ISSUE 9).
+        self._watchers: dict[str, object] = {}
+        self._stream_addrs: dict[str, str] = {}
 
     def _client_for(self, task_id: str) -> Client:
         return self._client_for_addr(self._ring.pick(task_id))
@@ -33,12 +91,13 @@ class SchedulerClient:
         the OWNING member's error is what surfaces if all fail (it is the
         one operators need to diagnose).
 
-        Failover is OPT-IN per method (``idempotent=True``): a
-        state-bearing call (e.g. the persistent-cache family, whose
-        Started/Finished pair must land on the member holding the task
-        FSM) must NOT fail over — the substitute member would give an
-        authoritative-looking "not found" where the caller needs a
-        retryable connection error (advisor round 3)."""
+        Failover is OPT-IN per method (``idempotent=True``, resolved from
+        RPC_TABLE by ``unary``): a state-bearing call (e.g. the
+        persistent-cache family, whose Started/Finished pair must land on
+        the member holding the task FSM) must NOT fail over — the
+        substitute member would give an authoritative-looking "not found"
+        where the caller needs a retryable connection error (advisor
+        round 3)."""
         members = (self._ring.pick_n(task_id, len(self._ring.members()))
                    if idempotent else self._ring.pick_n(task_id, 1))
         first: DfError | None = None
@@ -61,7 +120,10 @@ class SchedulerClient:
     def update_addrs(self, addrs: list[str]) -> None:
         """Dynconfig observer: rebuild the hash ring when the manager's
         scheduler set changes (reference pkg/resolver/scheduler_resolver.go).
-        Clients for removed schedulers are closed, not leaked."""
+        Clients for removed schedulers are closed, not leaked; announce
+        streams sitting on a still-alive but NO-LONGER-OWNING member get
+        their conductor's ring-change callback so they can drain and
+        re-home instead of riding a stale shard."""
         if not addrs or set(addrs) == set(self._ring.members()):
             return
         log.info("scheduler set changed", addrs=addrs)
@@ -75,6 +137,31 @@ class SchedulerClient:
                 asyncio.get_running_loop().create_task(cli.close())
             except RuntimeError:  # no loop: close() at daemon stop handled it
                 pass
+        for task_id, cb in list(self._watchers.items()):
+            owner = self._ring.pick(task_id)
+            current = self._stream_addrs.get(task_id)
+            if owner and current and owner != current:
+                try:
+                    cb(owner)
+                except Exception:
+                    log.warning("ring-change callback failed",
+                                task=task_id[:16], exc_info=True)
+
+    # -- ring-rebuild observation (conductor re-homing) --------------------
+
+    def watch_ring(self, task_id: str, cb) -> None:
+        """Register ``cb(new_owner_addr)`` to fire when a ring rebuild
+        moves ``task_id``'s ownership off the member its announce stream
+        was opened on."""
+        self._watchers[task_id] = cb
+
+    def unwatch_ring(self, task_id: str) -> None:
+        self._watchers.pop(task_id, None)
+        self._stream_addrs.pop(task_id, None)
+
+    def stream_addr(self, task_id: str) -> str:
+        """The ring member the task's announce stream last opened on."""
+        return self._stream_addrs.get(task_id, "")
 
     async def open_announce_stream(self, open_body: dict) -> ClientStream:
         """Open the AnnouncePeer stream on the ring member owning this
@@ -88,14 +175,19 @@ class SchedulerClient:
         for i, addr in enumerate(members):
             try:
                 cli = self._client_for_addr(addr)
-                return await cli.open_stream("Scheduler.AnnouncePeer",
-                                             open_body)
+                stream = await cli.open_stream("Scheduler.AnnouncePeer",
+                                               open_body)
             except DfError as e:
                 if first is None:
                     first = e
                 if i + 1 < len(members):
                     log.warning("scheduler unreachable, trying next ring "
                                 "member", addr=addr, error=e.message)
+                continue
+            self._stream_addrs[task_id] = addr
+            FAILOVER_COUNT.labels("owner" if i == 0 else "failover").inc()
+            return stream
+        FAILOVER_COUNT.labels("exhausted").inc()
         if first is not None:
             raise first
         raise DfError(Code.SchedError, "no scheduler addresses")
@@ -118,12 +210,17 @@ class SchedulerClient:
         return first
 
     async def unary(self, task_id: str, method: str, body: dict,
-                    timeout: float = 10.0, idempotent: bool = False):
+                    timeout: float = 10.0,
+                    idempotent: "bool | None" = None):
         """Unary call routed by task id through the consistent-hash ring
         (public surface for call families without a dedicated wrapper,
-        e.g. the persistent cache RPCs). Ring failover only when the
-        caller declares the method ``idempotent`` — the safe default for
-        state-bearing methods is the owning member's error, retryable."""
+        e.g. the persistent cache RPCs). Ring failover is resolved from
+        RPC_TABLE — only ``idempotent``-classified methods fail over; the
+        safe posture for state-bearing methods is the owning member's
+        error, retryable. An explicit ``idempotent=`` overrides (plugin
+        methods the table cannot know)."""
+        if idempotent is None:
+            idempotent = RPC_TABLE.get(method) == IDEMPOTENT
         return await self._routed_call(task_id, method, body, timeout,
                                        idempotent=idempotent)
 

@@ -13,11 +13,16 @@ already flows through:
   piece.body      piece body stream                     truncate | corrupt | drop | stall
   source.request  source client download/probe          refuse | http5xx | stall
   source.body     origin body stream                    truncate | corrupt | drop | stall
+  sched.announce  scheduler/service announce loop       drop | stall
 
 ``rpc.recv`` drop against the scheduler connection IS the
 scheduler-member-crash simulation from the daemon's point of view: the
 read loop dies, every pending call and stream fails, and the announce
-recovery path has to do its job.
+recovery path has to do its job. ``sched.announce`` is the SERVER-side
+twin — armed inside a scheduler process it severs (or stalls) announce
+streams at the service loop, killing the stream for every daemon at
+once without killing the process: the shard-failover drill
+(tests/test_scheduler_ha.py) and the crash-recovery e2e both ride it.
 
 Determinism: the decision for the n-th invocation of a given
 ``(site, key)`` is a pure function of ``(seed, site, key, n, rule)`` —
@@ -293,9 +298,11 @@ def _hooked_modules():
     from dragonfly2_tpu.daemon.peer import piece_downloader
     from dragonfly2_tpu.rpc import client as rpc_client
     from dragonfly2_tpu.rpc import framing as rpc_framing
+    from dragonfly2_tpu.scheduler import service as scheduler_service
     from dragonfly2_tpu.source import client as source_client
 
-    return (rpc_client, rpc_framing, piece_downloader, source_client)
+    return (rpc_client, rpc_framing, piece_downloader, source_client,
+            scheduler_service)
 
 
 def enable(fabric: ChaosFabric) -> ChaosFabric:

@@ -52,6 +52,14 @@ class FSM:
             desc = self._events.get(event)
             return desc is not None and self._state in desc.src
 
+    def restore(self, state: str) -> None:
+        """Set the state directly, bypassing transitions — ONLY for
+        rebuilding an FSM from a durable snapshot (scheduler HA restore),
+        where the recorded state was reached through real transitions in
+        a previous process. No callbacks fire."""
+        with self._mu:
+            self._state = state
+
     def event(self, name: str) -> None:
         with self._mu:
             desc = self._events.get(name)

@@ -136,6 +136,20 @@ CLOCK_SAMPLE = Msg(
     echo=F(float, required=True),
 )
 
+# Resume state on a (re-)register: the daemon's full local task state —
+# landed piece bitset, task geometry, contiguous-prefix digest, stripe
+# membership — so a failover ring member or a restarted scheduler can
+# rebuild Task/Peer FSMs from re-registrations instead of treating the
+# peer as fresh (no re-download of landed pieces, no spurious
+# back-to-source). piece_nums is the compact form; digests ride the
+# idempotent re-report that follows.
+RESUME = Msg(
+    "Resume",
+    piece_nums=F(list, item=F(int)),
+    content_length=F(int), piece_size=F(int), total_piece_count=F(int),
+    prefix_digest=F(str), pod_broadcast=F(bool), stripe=F(dict),
+)
+
 # Compact bounded flight digest (pkg/flight.digest): phase totals +
 # merged phase segments + truncated waterfall + clock samples, shipped on
 # the terminal announce message so the scheduler's pod lens can merge
@@ -319,7 +333,7 @@ STREAM_OPEN: dict[str, Msg] = {
 
 STREAM_MSGS: dict[str, dict[str, Msg]] = {
     "Scheduler.AnnouncePeer": {
-        "register": Msg("Register"),
+        "register": Msg("Register", resume=F(dict, spec=RESUME)),
         "download_started": Msg(
             "DownloadStarted", content_length=F(int), piece_size=F(int),
             total_piece_count=F(int)),

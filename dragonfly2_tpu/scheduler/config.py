@@ -102,6 +102,28 @@ class PodLensConfig:
 
 
 @dataclass
+class HAConfig:
+    """Crash-recovery (scheduler HA): a bounded, periodically-flushed
+    snapshot of live task/peer/host state in the same embedded-sqlite
+    backend as the persistent-cache rows, so a restarted scheduler serves
+    correct stripe plans and parent sets immediately — before every host
+    has re-announced. Snapshot load and live resume re-registration
+    converge to the same state (property-tested in
+    tests/test_scheduler_ha.py)."""
+
+    enabled: bool = True
+    # Snapshot db path; "" reuses ``persistent_cache_db`` (one durable
+    # file per scheduler). ":memory:" keeps the machinery live for tests
+    # without durability.
+    snapshot_db: str = ""
+    snapshot_interval: float = 5.0
+    # Bounds: newest tasks win; peers are capped per flush (terminal
+    # peers are never written, so these bound live state only).
+    max_tasks: int = 1024
+    max_peers: int = 65536
+
+
+@dataclass
 class GCConfig:
     peer_ttl: float = PEER_TTL
     host_ttl: float = HOST_TTL
@@ -116,6 +138,7 @@ class SchedulerConfig:
     gc: GCConfig = field(default_factory=GCConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
     podlens: PodLensConfig = field(default_factory=PodLensConfig)
+    ha: HAConfig = field(default_factory=HAConfig)
     manager_addr: str = ""                 # manager drpc for registration
     cluster_id: int = 1
     # Durable persistent-cache state (reference: Redis-backed

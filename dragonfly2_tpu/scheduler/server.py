@@ -27,6 +27,12 @@ class SchedulerServer:
         self._register()
         self.gc = GC(log)
         self.gc.add(GCTask("resource", self.config.gc.interval, 30.0, self._gc))
+        if self.service.snapshot is not None:
+            # HA: periodic durable snapshot flush so a crash loses at most
+            # one interval of state (resume re-registration reconciles the
+            # rest — scheduler/resource/snapshot.py).
+            self.gc.add(GCTask("snapshot", self.config.ha.snapshot_interval,
+                               15.0, self._snapshot_flush))
         self.announcer = None       # manager registration (set in start)
         self.dynconfig = None       # manager-fed cluster config + seed peers
         self.job_worker = None      # manager job-queue consumer (preheat etc.)
@@ -66,6 +72,9 @@ class SchedulerServer:
         counts = self.service.gc()
         if any(counts.values()):
             log.info("resource gc", **counts)
+
+    async def _snapshot_flush(self) -> None:
+        self.service.snapshot_flush()
 
     async def serve(self) -> None:
         await self.start()
@@ -159,6 +168,14 @@ class SchedulerServer:
 
     async def stop(self) -> None:
         self.gc.stop()
+        if self.service.snapshot is not None:
+            # A graceful stop leaves a fresh snapshot behind; a crash
+            # leaves the last periodic flush — both are valid restore
+            # points (re-registration reconciles the delta).
+            try:
+                self.service.snapshot_flush()
+            except Exception:
+                log.warning("snapshot flush at stop failed", exc_info=True)
         if self.job_worker is not None:
             self.job_worker.stop()
         if self._manager_retry is not None:

@@ -76,6 +76,19 @@ PEER_REREGISTER_COUNT = metrics.counter(
     "Terminal peers replaced by a fresh registration (announce-stream "
     "recovery after a drop)")
 
+STATE_REBUILT_COUNT = metrics.counter(
+    "scheduler_state_rebuilt_peers_total",
+    "Peers whose Task/Peer state this scheduler rebuilt without having "
+    "watched the download: resume-carrying re-registrations after a "
+    "failover/restart, and durable-snapshot restores at boot",
+    ("source",))
+
+# Chaos fabric hook (pkg/chaos site ``sched.announce``): severs/stalls
+# the server side of announce streams so failover paths can be driven
+# deterministically. None unless chaos.enable() arms it — the hot loop
+# pays one ``is not None`` check.
+_chaos = None
+
 
 class SchedulerService:
     def __init__(self, config: SchedulerConfig | None = None):
@@ -145,6 +158,23 @@ class SchedulerService:
             self.slo = slolib.SLOEngine(
                 series=self.fleet.series if self.fleet else None,
                 max_completions=plc.max_completions)
+        # Scheduler HA (crash recovery): durable bounded snapshot of live
+        # task/peer/host state, restored at boot so a restarted scheduler
+        # serves correct parent sets and stripe plans before every host
+        # has re-announced; live resume re-registrations converge to the
+        # same state (scheduler/resource/snapshot.py).
+        self.snapshot = None
+        if self.config.ha.enabled:
+            from dragonfly2_tpu.scheduler.resource.snapshot import (
+                SnapshotStore,
+            )
+
+            self.snapshot = SnapshotStore(
+                self.config.ha.snapshot_db
+                or self.config.persistent_cache_db)
+            restored = self.restore_from_snapshot()
+            if restored:
+                log.info("state restored from snapshot", **restored)
 
     def _fleet_gauges(self) -> dict:
         """Gauge sample for the fleet time-series. O(hosts+peers+tasks)
@@ -162,6 +192,97 @@ class SchedulerService:
             "straggler_hosts": len(
                 self.fleet.scorecards._stragglers) if self.fleet else 0,
         }
+
+    # ------------------------------------------------------------------ #
+    # HA: durable snapshot save/restore (scheduler/resource/snapshot.py)
+    # ------------------------------------------------------------------ #
+
+    def snapshot_flush(self) -> dict | None:
+        """Write the bounded live-state snapshot (periodic GC-style task
+        in scheduler/server.py + once at stop)."""
+        if self.snapshot is None:
+            return None
+        ha = self.config.ha
+        return self.snapshot.save(
+            self.hosts.all(), self.tasks.all(), self.peers.all(),
+            max_tasks=ha.max_tasks, max_peers=ha.max_peers)
+
+    def restore_from_snapshot(self) -> dict | None:
+        """Rebuild Host/Task/Peer objects from the snapshot rows. Piece
+        metadata rebuilds through the SAME apply path live resume
+        re-registration uses, so snapshot load and re-registration are one
+        code path and converge by construction (property-tested)."""
+        if self.snapshot is None:
+            return None
+        data = self.snapshot.load()
+        if not data["peers"] and not data["tasks"]:
+            return None
+        for hw in data["hosts"]:
+            host = self.hosts.load_or_store(
+                Host(
+                    hw.get("id", "unknown"),
+                    hostname=hw.get("hostname", ""), ip=hw.get("ip", ""),
+                    port=hw.get("port", 0),
+                    upload_port=hw.get("upload_port", 0),
+                    host_type=HostType(hw.get("type", 0)),
+                    idc=hw.get("idc", ""), location=hw.get("location", ""),
+                    tpu_slice=hw.get("tpu_slice", ""),
+                    tpu_worker_index=hw.get("tpu_worker_index", -1),
+                ))
+            host.touch()
+        for tr in data["tasks"]:
+            task = self.tasks.load_or_store(Task(
+                tr["task_id"], url=tr["url"], tag=tr["tag"],
+                application=tr["application"], digest=tr["digest"],
+                back_to_source_limit=self.config.scheduling.back_to_source_count,
+                range_header=tr["range_header"],
+            ))
+            task.update_lengths(tr["content_length"], tr["piece_size"],
+                                tr["total_piece_count"])
+            task.fsm.restore(tr["state"])
+        restored_peers = 0
+        for pr in data["peers"]:
+            task = self.tasks.load(pr["task_id"])
+            host = self.hosts.load(pr["host_id"])
+            if task is None or host is None:
+                continue
+            peer = self.peers.load_or_store(Peer(
+                pr["peer_id"], task, host,
+                is_seed=bool(pr["is_seed"]), priority=pr["priority"],
+                range_header=pr["range_header"],
+            ))
+            peer.fsm.restore(pr["state"])
+            peer.pod_broadcast = bool(pr["pod_broadcast"])
+            self._apply_resume_pieces(task, peer, pr["piece_nums"])
+            restored_peers += 1
+            STATE_REBUILT_COUNT.labels("snapshot").inc()
+        return {"hosts": len(data["hosts"]), "tasks": len(data["tasks"]),
+                "peers": restored_peers}
+
+    def _apply_resume_pieces(self, task: Task, peer: Peer,
+                             piece_nums) -> int:
+        """Idempotently install a re-announced landed-piece bitset: the
+        peer's finished set plus task piece metadata computed from the
+        task geometry (digests arrive via the idempotent re-report that
+        follows — the duplicate path backfills them)."""
+        added = 0
+        ps = task.piece_size
+        cl = task.content_length
+        for num in piece_nums:
+            num = int(num)
+            if num in peer.finished_pieces:
+                continue
+            peer.finished_pieces.add(num)
+            added += 1
+            if ps > 0 and num not in task.pieces:
+                offset = num * ps
+                size = ps if cl < 0 else max(0, min(ps, cl - offset))
+                task.store_piece(PieceInfo(
+                    piece_num=num, range_start=offset, range_size=size))
+        if added:
+            peer.touch()
+            task.touch()
+        return added
 
     # ------------------------------------------------------------------ #
     # resource resolution (reference handleResource :1457)
@@ -258,6 +379,12 @@ class SchedulerService:
                 msg = await stream.recv()
                 if msg is None:
                     break
+                if _chaos is not None and await _chaos.on_frame(
+                        "sched.announce", peer.id) == "drop":
+                    # Scheduler-side stream sever: from the daemon's view
+                    # its announce stream just died mid-download — the
+                    # failover/recovery machinery must take over.
+                    break
                 await self._dispatch(msg, task, peer)
                 if peer.is_done():
                     break
@@ -268,7 +395,7 @@ class SchedulerService:
     async def _dispatch(self, msg: dict, task: Task, peer: Peer) -> None:
         kind = msg.get("type", "")
         if kind == "register":
-            await self._handle_register(task, peer)
+            await self._handle_register(task, peer, msg)
         elif kind == "download_started":
             self._handle_download_started(msg, task, peer)
         elif kind == "piece_finished":
@@ -298,7 +425,25 @@ class SchedulerService:
         msg["sched_wall"] = flightlib.anchored_wall()
         return msg
 
-    async def _handle_register(self, task: Task, peer: Peer) -> None:
+    async def _handle_register(self, task: Task, peer: Peer,
+                               msg: dict | None = None) -> None:
+        # Failover / restart re-registration: the register carries the
+        # daemon's full resume state, or the peer object is a ghost this
+        # scheduler restored from its snapshot (already RUNNING, stream
+        # only now attached). Either way the peer holds landed bytes and
+        # live parent sync streams — rebuild state and answer normal_task,
+        # never demote it to origin.
+        # Seeds stay on the reference path: a seed re-announcing a
+        # complete store rides the need_back_source answer into the
+        # conductor's announce-only fast path, which re-reports every
+        # piece WITH digests — strictly more information than the bitset.
+        resume = (msg or {}).get("resume")
+        if (resume is not None and not peer.is_seed) \
+                or peer.fsm.current in (PeerState.RUNNING,
+                                        PeerState.BACK_TO_SOURCE):
+            await self._handle_resume_register(task, peer, resume or {})
+            return
+
         # Empty-content shortcut (reference registerEmptyTask).
         if task.content_length == 0:
             peer.fsm.event("register_empty")
@@ -377,6 +522,72 @@ class SchedulerService:
         # loop instead of demoting it to a redundant origin fetch.
         patience = 30.0 if seeding else 0.0
         await self._schedule_and_send(task, peer, patience=patience)
+
+    async def _handle_resume_register(self, task: Task, peer: Peer,
+                                      resume: dict) -> None:
+        """Rebuild Task/Peer state from a resume-carrying re-registration
+        (scheduler failover/restart — the server half of the conductor's
+        announce recovery). The answer is ALWAYS normal_task: a peer that
+        re-announced landed pieces is itself a parent candidate the pod
+        needs, its remainder keeps flowing from the sync streams it never
+        lost, and a back-source demotion here would re-fetch bytes the pod
+        already holds. An empty parent list is fine — the conductor keeps
+        its live parents, and membership-change pushes top it up as the
+        rest of the pod re-registers."""
+        task.update_lengths(
+            resume.get("content_length", -1),
+            resume.get("piece_size", 0),
+            resume.get("total_piece_count", -1),
+        )
+        if resume.get("pod_broadcast"):
+            peer.pod_broadcast = True
+        added = self._apply_resume_pieces(
+            task, peer, resume.get("piece_nums") or [])
+        # Fresh peers walk the normal register→download transitions; a
+        # snapshot ghost is already RUNNING; a SUCCEEDED ghost whose
+        # daemon says "still running" drops back to RUNNING — the daemon
+        # is the authority on its own download state.
+        for event in ("register_normal", "download"):
+            if peer.fsm.can(event):
+                peer.fsm.event(event)
+        if peer.fsm.current not in (PeerState.RUNNING,
+                                    PeerState.BACK_TO_SOURCE):
+            peer.fsm.restore(PeerState.RUNNING)
+        if task.fsm.current != TaskState.SUCCEEDED:
+            # A resuming peer never demotes task-level success: SUCCEEDED
+            # means the content is fully available somewhere, which one
+            # peer's unfinished remainder does not contradict.
+            self._mark_task_running(task)
+        STATE_REBUILT_COUNT.labels("reregister").inc()
+        if self.fleet is not None:
+            self.fleet.note_register(reconnect=True)
+        if added:
+            # The re-announced pieces make this peer a usable parent NOW:
+            # wake every schedule loop blocked on this task.
+            task.notify_parents_changed()
+        log.info("peer resume-registered", peer=peer.id[:24],
+                 task=task.id[:16], pieces=len(peer.finished_pieces),
+                 rebuilt=added)
+        stream = peer.announce_stream
+        if stream is None:
+            return
+        parents = self.scheduling.find_candidate_parents(peer)
+        if parents:
+            self.scheduling.reattach_peer(peer, parents)
+        out = {"type": "normal_task", "task": task.to_wire(),
+               "parents": [p.to_wire() for p in parents]}
+        stripe = self._stripe_for(task, peer)
+        peer.stripe = stripe
+        if stripe is not None:
+            out["stripe"] = stripe
+            STRIPE_HANDOUT_COUNT.labels("striped").inc()
+            if self.fleet is not None:
+                self.fleet.note_stripe(task.id, peer.id, peer.host.id,
+                                       reshuffle=False)
+        await stream.send(self._stamped(out))
+        if peer.host.tpu_slice:
+            aio.spawn(self._push_stripe_updates(
+                task, peer.host.tpu_slice, exclude=peer.id))
 
     async def _register_small(self, task: Task, peer: Peer) -> bool:
         """Single-piece shortcut (reference registerSmallTask :917): hand
@@ -625,6 +836,12 @@ class SchedulerService:
             # not re-count the parent's upload or duplicate cost samples.
             # Checked on the raw dict BEFORE any PieceInfo construction:
             # this runs once per piece per peer across the whole pod.
+            # Resume-rebuilt piece metadata has no digest (the bitset is
+            # numbers-only); the idempotent re-report that follows a
+            # re-registration is where the digest arrives — backfill it.
+            info = task.pieces.get(num)
+            if info is not None and not info.digest and p.get("digest"):
+                info.digest = p["digest"]
             peer.touch()
             return
         first_piece = not peer.finished_pieces
@@ -680,7 +897,12 @@ class SchedulerService:
         for p in pieces:
             num = p["piece_num"]
             if num in peer.finished_pieces:
-                continue   # idempotent re-delivery (see _apply_piece_finished)
+                # Idempotent re-delivery (see _apply_piece_finished) —
+                # digest backfill for resume-rebuilt piece metadata.
+                info = task.pieces.get(num)
+                if info is not None and not info.digest and p.get("digest"):
+                    info.digest = p["digest"]
+                continue
             cost = p.get("download_cost_ms", 0)
             peer.add_finished_piece(num, cost)
             self.pod_flight.note_piece(task.id, peer.host.id,
@@ -1170,8 +1392,10 @@ class SchedulerService:
             body.get("piece_size", task.piece_size),
             body.get("total_piece_count", task.total_piece_count),
         )
-        for num in body.get("piece_nums") or []:
-            peer.finished_pieces.add(int(num))
+        # Same apply path as resume re-registration and snapshot restore:
+        # the bitset also rebuilds task piece metadata, so all three
+        # reconstruction routes converge on one Task state.
+        self._apply_resume_pieces(task, peer, body.get("piece_nums") or [])
         for event in ("register_normal", "download", "download_succeeded"):
             if peer.fsm.can(event):
                 peer.fsm.event(event)

@@ -24,6 +24,7 @@ REQUIRED_CONFIGS = (
     "config1_single",
     "config2_fanout",
     "config5_pod_sim",
+    "config5_pod_sim_churn_4k",
     "config2_fanout_striped",
     "config6_stripe_sim",
     "config7_chaos",
@@ -218,6 +219,33 @@ def test_ingest_micro_hash_fallback_round():
     assert hf["python_mbps"] > 0 and hf["fallback_mbps"] > 0
     if hf["backend"] != "python":
         assert hf["speedup"] >= 3.0, hf
+
+
+def test_pod_sim_churn_4k_shape():
+    """config5_pod_sim_churn_4k is the scheduler-HA acceptance sim: 4096
+    hosts under sustained join/leave with one mid-sim scheduler
+    crash/restore. Shape guard: completion despite the restart, every
+    resume re-registration answered normal_task (zero re-downloaded
+    landed bytes, no origin storm), the snapshot actually restored
+    state, and rebuild time is reported."""
+    entry = _load()["published"]["config5_pod_sim_churn_4k"]
+    assert entry["hosts"] >= 4096
+    assert entry["churn_waves"] >= 1
+    assert entry["restart_enabled"] is True
+    assert entry["completion_rate"] == 1.0
+    assert entry["finished"] == entry["expected_finishers"]
+    assert entry["origin_fetches"] <= 3
+    r = entry["restart"]
+    assert r["reregistered"] > 0
+    assert set(r["resume_answers"]) == {"normal_task"}, r
+    assert r["rebuilt_piece_mismatch"] == 0
+    assert r["restored_peers"] > 0
+    assert r["rebuild_s"] >= 0
+    # The churn invariants promoted from the 1024-host variant.
+    assert entry["straggler_dead_parent_picks"] == 0
+    assert entry["peers_after_gc"] == 0
+    assert entry["tasks_after_gc"] == 0
+    assert entry["hosts_after_gc"] == 0
 
 
 def test_stripe_sim_meets_acceptance_bounds():

@@ -422,7 +422,14 @@ class TestAnnounceRecovery:
             ok = await c._recover_announce_stream()
             assert ok and c._stream is fresh
             assert sched.opens == 2          # first open failed, second ok
-            assert fresh.sent[0] == {"type": "register"}
+            # The recovery register carries FULL resume state (ISSUE 9):
+            # a failover member rebuilds Task/Peer from it instead of
+            # treating us as fresh.
+            assert fresh.sent[0]["type"] == "register"
+            resume = fresh.sent[0]["resume"]
+            assert resume["piece_nums"] == [0, 1]
+            assert resume["content_length"] == 8
+            assert resume["piece_size"] == 4
             # The flush carried BOTH the buffered report and the full
             # completed-piece re-report (idempotent at the scheduler).
             reported = []
