@@ -40,6 +40,11 @@ def _add_dfget(sub: argparse._SubParsersAction) -> None:
                    help="register as a striped slice broadcast: each "
                         "same-slice host DCN-pulls 1/S of the pieces and "
                         "the slice completes the copy internally")
+    p.add_argument("--delta-base", default="",
+                   help="task id of a locally-landed base version: chunks "
+                        "the base already holds are copied (and verified) "
+                        "locally, only changed chunks cross the wire as "
+                        "ranged P2P tasks (checkpoint-delta plane)")
     p.add_argument("--explain", action="store_true",
                    help="after the download, print the flight recorder's "
                         "critical-path autopsy (phase breakdown + per-piece "
@@ -90,6 +95,7 @@ def _run_dfget(args: argparse.Namespace) -> int:
         pod_broadcast=args.pod_broadcast,
         explain=args.explain,
         pod=args.pod,
+        delta_base=args.delta_base,
     )
     if not args.output and args.device != "tpu":
         sys.stderr.write("dfget: error: -O/--output is required "

@@ -216,6 +216,32 @@ async def _pull_ranges(daemon, url: str, ranges, *, tag: str = "",
     return landed
 
 
+def coalesce_spans(spans) -> list[tuple[int, int]]:
+    """Touching/overlapping ``(start, end)`` spans merged into
+    super-ranges (sorted). The one merge rule for download_global's
+    ranged-task planning — unit-testable without a daemon."""
+    merged: list[list[int]] = []
+    for s0, s1 in sorted(spans):
+        if merged and s0 <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], s1)
+        else:
+            merged.append([s0, s1])
+    return [(s0, s1) for s0, s1 in merged]
+
+
+def covering_span(coverage, a: int, b: int) -> tuple[int, int]:
+    """The first span of ``coverage`` that fully contains [a, b); a miss
+    is a planner bug surfaced as SafetensorsError, never a silent wrong
+    carve."""
+    from dragonfly2_tpu.ops import safetensors as st
+
+    for s0, s1 in coverage:
+        if s0 <= a and b <= s1:
+            return (s0, s1)
+    raise st.SafetensorsError(
+        f"internal: span [{a}, {b}) not covered by any landed range")
+
+
 def _validated_span(name: str, meta, data_start: int) -> tuple[int, int]:
     """(absolute_start, absolute_end) of a tensor's bytes, with the
     malformed-header failure modes surfaced as SafetensorsError."""
@@ -443,15 +469,10 @@ async def download_global(daemon, url: str,
                 spans_needed.add(span)
 
     # Coalesce touching spans into super-ranges → one ranged task each.
-    merged: list[list[int]] = []
-    for s0, s1 in sorted(spans_needed):
-        if merged and s0 <= merged[-1][1]:
-            merged[-1][1] = max(merged[-1][1], s1)
-        else:
-            merged.append([s0, s1])
+    merged = coalesce_spans(spans_needed)
 
     # Ranges the header-guess landing already covers carve from it free.
-    pull_list = [tuple(m) for m in merged if m[1] > plen]
+    pull_list = [m for m in merged if m[1] > plen]
     landed = await _pull_ranges(daemon, url, pull_list,
                                 tag=tag, application=application,
                                 header=header)
@@ -460,10 +481,7 @@ async def download_global(daemon, url: str,
     coverage = pull_list + ([(0, plen)] if plen else [])
 
     def super_range(a: int, b: int) -> tuple[int, int]:
-        for s0, s1 in coverage:
-            if s0 <= a and b <= s1:
-                return (s0, s1)
-        raise st.SafetensorsError("internal: span not covered")  # pragma: no cover
+        return covering_span(coverage, a, b)
 
     out: dict[str, object] = {}
     by_name: dict[str, list] = {}
@@ -494,4 +512,256 @@ async def download_global(daemon, url: str,
         shape = tuple(header_dict[name].get("shape") or ())
         out[name] = jax.make_array_from_single_device_arrays(
             shape, sharding, by_name[name])
+    return out
+
+
+# ------------------------------------------------------------------ #
+# Checkpoint-delta hot-swap (delta plane + ops/hbm_sink.DoubleBuffer)
+# ------------------------------------------------------------------ #
+
+@dataclass
+class HotSwapResult:
+    """One hot-swapped checkpoint generation: the verified device buffer
+    plus its named tensor views and the delta accounting that produced
+    it. ``buffer``/``tensors`` are also installed into the caller's
+    DoubleBuffer (when given) by an atomic flip."""
+
+    task_id: str
+    content_length: int
+    generation: int
+    buffer: object                  # uint8 device array (np on fallback)
+    tensors: dict
+    on_device: bool
+    flipped: bool
+    reused_device_bytes: int        # HBM->HBM copied from the live buffer
+    staged_bytes: int               # host->device staged (fetched chunks)
+    stats: dict                     # delta resolver accounting (may be {})
+
+
+def _read_store_span(store, start: int, length: int) -> bytes:
+    """Pooled read of [start, start+length) of a completed store."""
+    from dragonfly2_tpu.storage.local_store import (
+        acquire_read_buffer,
+        release_read_buffer,
+    )
+
+    buf = acquire_read_buffer(length)
+    try:
+        with store:
+            store.read_into(start, length, buf)
+        return bytes(buf[:length])
+    finally:
+        release_read_buffer(buf)
+
+
+def _host_piece_checksums(store) -> dict[int, tuple[int, int]]:
+    """checksum_numpy over every piece of the landed disk copy — the
+    host side of the hot-swap verify gate."""
+    from dragonfly2_tpu.ops.checksum import checksum_numpy
+
+    out: dict[int, tuple[int, int]] = {}
+    with store:
+        for rec in store.get_pieces():
+            out[rec.num] = checksum_numpy(store.read_piece(rec.num))
+    return out
+
+
+def _device_parts(new_m, base_m, store) -> tuple[list, int, int]:
+    """The assemble plan for the spare buffer: reused chunks as live-
+    buffer slices, fetched chunks as host bytes read from the VERIFIED
+    disk landing (never the wire). Returns (parts, reused, staged)."""
+    from dragonfly2_tpu.delta.resolver import plan_delta
+
+    plan = plan_delta(new_m, base_m)
+    base_of = {c.offset: b for c, b in plan.reused}
+    parts: list = []
+    reused = staged = 0
+    for c in new_m.chunks:
+        b = base_of.get(c.offset)
+        if b is not None:
+            parts.append(("r", b.offset, b.length))
+            reused += c.length
+        else:
+            parts.append(("f", _read_store_span(store, c.offset, c.length)))
+            staged += c.length
+    return parts, reused, staged
+
+
+async def download_delta(daemon, url: str, *, base, hot=None,
+                         digest: str = "", tag: str = "",
+                         application: str = "", header: dict | None = None,
+                         names: list[str] | None = None,
+                         shardings: dict | None = None):
+    """Land version N+1 of a checkpoint as a delta against version N and
+    hot-swap the device tensors without a serving gap.
+
+    ``base``: the live generation — a DeviceResult/HotSwapResult from the
+    previous download, or a bare base task id (then the live buffer, if
+    any, comes from ``hot``). ``hot``: an ops.hbm_sink.DoubleBuffer;
+    when given, the verified new generation is installed with one atomic
+    flip, so a reader thread iterating ``hot.snapshot()`` only ever sees
+    complete old-or-new tensor sets.
+
+    The wire side rides the delta plane (TaskManager.start_delta_task):
+    only changed chunks cross DCN, and the patched disk landing is
+    digest-verified and served to peers. The device side then copies
+    reused chunks HBM->HBM out of the live buffer, stages only fetched
+    chunks from the disk landing, and verifies the assembled buffer
+    on-device against the disk copy's piece checksums BEFORE the flip.
+    """
+    import asyncio
+
+    import numpy as np
+
+    from dragonfly2_tpu.daemon.peer.task_manager import FileTaskRequest
+    from dragonfly2_tpu.delta.resolver import fetch_manifest
+    from dragonfly2_tpu.ops import hbm_sink
+    from dragonfly2_tpu.ops import safetensors as st
+
+    tm = daemon.task_manager
+    base_task_id = base if isinstance(base, str) else base.task_id
+    live_u8 = None
+    if hot is not None and hot.generation > 0:
+        live_u8 = hot.buffer()
+    elif not isinstance(base, str):
+        live_u8 = (base.buffer if isinstance(base, HotSwapResult)
+                   else base.as_bytes_array())
+
+    req = FileTaskRequest(
+        url=url, output="",
+        meta=UrlMeta(digest=digest, tag=tag, application=application,
+                     header=header or {}))
+    final = None
+    async for progress in tm.start_delta_task(req, base_task_id):
+        if progress.state == "failed":
+            raise DfError.from_wire(progress.error or {})
+        if progress.state == "done":
+            final = progress
+    if final is None:
+        raise DfError(Code.UnknownError, "delta download ended silently")
+    store = tm.storage.find_completed_task(final.task_id)
+    if store is None:
+        raise DfError(Code.UnknownError, "delta task has no store")
+    total = store.metadata.content_length
+
+    # Device plan: chunk-mapped when the live buffer + both manifests
+    # are at hand, whole-buffer staging otherwise.
+    parts = None
+    reused = staged = 0
+    if live_u8 is not None:
+        new_m = await fetch_manifest(tm, final.task_id)
+        base_store = tm.storage.find_completed_task(base_task_id)
+        base_m = (await fetch_manifest(tm, base_task_id)
+                  if base_store is not None else None)
+        if base_m is None and base_store is not None and new_m is not None:
+            from dragonfly2_tpu.delta.manifest import manifest_from_store
+
+            base_m = await asyncio.to_thread(
+                manifest_from_store, base_store, base_store.metadata.url,
+                new_m.params)
+        if new_m is not None and base_m is not None \
+                and base_m.params == new_m.params:
+            parts, reused, staged = await asyncio.to_thread(
+                _device_parts, new_m, base_m, store)
+    if parts is None:
+        parts = [("f", await asyncio.to_thread(
+            _read_store_span, store, 0, total))]
+        staged = total
+
+    on_device = True
+    try:
+        u8 = hbm_sink.assemble_delta_u8(live_u8, parts)
+    except Exception as e:
+        # Device trouble (OOM, runtime errors) degrades to a host
+        # buffer over the verified disk landing — the device_feed
+        # discipline: the pipeline must outlive a sink hiccup.
+        log.warning("delta device assembly failed; numpy fallback",
+                    task=final.task_id[:16], error=str(e)[:200])
+        u8 = np.frombuffer(await asyncio.to_thread(
+            _read_store_span, store, 0, total), dtype=np.uint8)
+        on_device = False
+        reused, staged = 0, total
+    if on_device:
+        # The flip gate: a verify MISMATCH is corruption, never a
+        # fallback — handing back a bad buffer would defeat
+        # verify-on-land exactly like the device sink path.
+        checks = await asyncio.to_thread(_host_piece_checksums, store)
+        piece_size = store.metadata.piece_size
+        if store.metadata.total_piece_count <= 1:
+            piece_size = (total + ((-total) % 4)) or 4
+        try:
+            await asyncio.to_thread(
+                hbm_sink.verify_u8_against_host, u8, piece_size, checks)
+        except ValueError as e:
+            raise DfError(Code.ClientPieceDownloadFail,
+                          f"hot-swap verify failed: {e}")
+
+    head = np.asarray(u8[:min(total, 8)]).tobytes()
+    if len(head) < 8:
+        raise st.SafetensorsError("content shorter than the length prefix")
+    n = int.from_bytes(head, "little")
+    if 8 + n > total:
+        raise st.SafetensorsError("header length exceeds content")
+    header_dict, data_start = st.parse_header(
+        np.asarray(u8[:8 + n]).tobytes())
+    if on_device:
+        tensors = st.tensor_views(u8, header_dict, data_start, names)
+        if shardings:
+            unknown = [k for k in shardings if k not in tensors]
+            if unknown:
+                raise st.SafetensorsError(
+                    f"shardings reference tensors not loaded: {unknown}")
+            import jax
+
+            for k, sharding in shardings.items():
+                tensors[k] = jax.device_put(tensors[k], sharding)
+    else:
+        tensors = _numpy_views(u8, header_dict, data_start, names)
+
+    generation = 1
+    flipped = False
+    if hot is not None:
+        generation = hot.flip(u8, tensors)
+        flipped = True
+    return HotSwapResult(
+        task_id=final.task_id, content_length=total, generation=generation,
+        buffer=u8, tensors=tensors, on_device=on_device, flipped=flipped,
+        reused_device_bytes=reused, staged_bytes=staged,
+        stats=dict(tm.delta_stats.get(final.task_id, {})))
+
+
+_NP_DTYPES = {
+    "F64": "f8", "F32": "f4", "F16": "f2", "I64": "i8", "I32": "i4",
+    "I16": "i2", "I8": "i1", "U8": "u1", "U16": "u2", "U32": "u4",
+    "U64": "u8", "BOOL": "?", "BF16": "u2",   # numpy has no bfloat16
+}
+
+
+def _numpy_views(u8, header: dict, data_start: int,
+                 names: list[str] | None) -> dict:
+    """CPU fallback tensor views over a host uint8 buffer (BF16 surfaces
+    as raw uint16 words — numpy has no bfloat16)."""
+    import numpy as np
+
+    from dragonfly2_tpu.ops import safetensors as st
+
+    out: dict = {}
+    for name, meta in header.items():
+        if name == "__metadata__":
+            continue
+        if names is not None and name not in names:
+            continue
+        begin, end = _validated_span(name, meta, 0)
+        dt = _NP_DTYPES.get(meta.get("dtype", ""))
+        shape = meta.get("shape")
+        if dt is None or not isinstance(shape, list):
+            raise st.SafetensorsError(f"{name}: bad entry for numpy views")
+        out[name] = np.frombuffer(
+            u8, dtype=np.dtype("<" + dt),
+            count=(end - begin) // np.dtype(dt).itemsize,
+            offset=data_start + begin).reshape(shape)
+    if names is not None:
+        missing = [k for k in names if k not in out]
+        if missing:
+            raise st.SafetensorsError(f"tensors not in checkpoint: {missing}")
     return out

@@ -45,6 +45,11 @@ class DfgetConfig:
     # attach it as ``pod`` ({report, text}) — the clock-aligned per-host
     # phase waterfall with the slowest host named.
     pod: bool = False
+    # Checkpoint-delta plane: task id of a locally-landed base version.
+    # The daemon copies chunks the base already holds out of its local
+    # store (digest-verified) and fetches only changed chunks as ranged
+    # P2P tasks (dfget --delta-base).
+    delta_base: str = ""
 
 
 async def download(cfg: DfgetConfig, on_progress: Callable[[dict], None] | None = None) -> dict:
@@ -83,6 +88,7 @@ async def _daemon_download(cfg: DfgetConfig, on_progress) -> dict:
                 "disable_back_source": cfg.disable_back_source,
                 "device": cfg.device,
                 "pod_broadcast": cfg.pod_broadcast,
+                "delta_base": cfg.delta_base,
             },
         )
         final: dict | None = None

@@ -210,6 +210,10 @@ class TaskManager:
         # e2e/bench per-host DCN-bytes readout. Bounded: small dicts,
         # overwritten per task id, cleared with the entry cap below.
         self.locality_bytes: dict[str, dict] = {}
+        # Last delta landing's byte/chunk accounting per task id
+        # (delta/resolver.py): reused vs fetched bytes, corrupt-base
+        # refetches. Same bounding discipline as locality_bytes.
+        self.delta_stats: dict[str, dict] = {}
 
     # -- shared download core ---------------------------------------------
 
@@ -650,6 +654,20 @@ class TaskManager:
             return
         yield self._final_progress(store, task_id, peer_id, from_p2p=from_p2p,
                                    device_verified=device_verified)
+
+    # -- delta task (checkpoint-delta plane, delta/resolver.py) ------------
+
+    async def start_delta_task(self, req: FileTaskRequest,
+                               base_task_id: str) -> AsyncIterator[FileTaskProgress]:
+        """Land ``req`` as a delta against the locally-landed base task:
+        chunks the base already holds are copied (and digest-verified)
+        locally; only changed chunks cross the wire as ranged P2P tasks.
+        Degrades to a plain ``start_file_task`` whenever the delta path
+        is not viable (no base, no published manifest, zero overlap)."""
+        from dragonfly2_tpu.delta.resolver import run_delta_task
+
+        async for p in run_delta_task(self, req, base_task_id):
+            yield p
 
     # -- seed task (reference StartSeedTask :401 + seeder ObtainSeeds) -----
 

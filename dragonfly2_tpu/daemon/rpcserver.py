@@ -95,7 +95,17 @@ class DaemonRpcServer:
             except ValueError as e:
                 raise DfError(Code.BadRequest,
                               f"bad range {req.meta.range!r}: {e}")
-        async for progress in self.task_manager.start_file_task(req):
+        delta_base = body.get("delta_base", "")
+        if delta_base:
+            # Checkpoint-delta plane: copy chunks the local base version
+            # already holds, fetch only changed chunks as ranged tasks
+            # (delta/resolver.py; degrades to a plain download when the
+            # delta path is not viable).
+            progress_iter = self.task_manager.start_delta_task(
+                req, delta_base)
+        else:
+            progress_iter = self.task_manager.start_file_task(req)
+        async for progress in progress_iter:
             await stream.send(progress.to_wire())
 
     async def _stat_task(self, body, ctx: RpcContext):

@@ -51,10 +51,19 @@ class DaemonRangeFetcher:
     must match whatever other consumers use (the gateway uses the bucket
     name) so ranged tasks dedupe across surfaces."""
 
-    def __init__(self, task_manager, url: str, *, tag: str = ""):
+    def __init__(self, task_manager, url: str, *, tag: str = "",
+                 application: str = "", header: dict | None = None,
+                 pod_broadcast: bool = False):
         self.tm = task_manager
         self.url = url
         self.tag = tag
+        # Extra task-identity fields for consumers whose spans must dedup
+        # with other surfaces carrying them (the delta plane threads the
+        # original request's application/header through so every host
+        # running the same delta issues byte-identical span tasks).
+        self.application = application
+        self.header = dict(header or {})
+        self.pod_broadcast = pod_broadcast
         self.stats = {"cold": 0, "reuse": 0}
 
     async def fetch_into(self, start: int, end: int, buf: memoryview) -> None:
@@ -65,7 +74,11 @@ class DaemonRangeFetcher:
 
         rng = Range.normalize_header(f"{start}-{end - 1}")
         req = FileTaskRequest(url=self.url, output="",
-                              meta=UrlMeta(tag=self.tag, range=rng))
+                              meta=UrlMeta(tag=self.tag,
+                                           application=self.application,
+                                           header=dict(self.header),
+                                           range=rng),
+                              pod_broadcast=self.pod_broadcast)
         req.range = Range.parse_http(rng)
         final = None
         async for p in self.tm.start_file_task(req):

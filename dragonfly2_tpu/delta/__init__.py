@@ -1,0 +1,34 @@
+"""Checkpoint-delta distribution plane.
+
+Content-defined chunking + chunk manifests + a delta resolver so version
+N+1 of a checkpoint re-transfers only the chunks that actually changed;
+everything else is copied locally out of the landed version N
+(digest-verified during the copy). See docs/ARCHITECTURE.md
+"Checkpoint delta plane".
+"""
+
+from dragonfly2_tpu.delta.chunker import CDCParams, Chunk, GearChunker, chunk_bytes
+from dragonfly2_tpu.delta.manifest import (
+    DeltaManifest,
+    ManifestError,
+    build_manifest,
+    fetch_or_build_manifest,
+    manifest_from_store,
+    manifest_object_key,
+)
+from dragonfly2_tpu.delta.resolver import (
+    DeltaPlan,
+    fetch_manifest,
+    manifest_url,
+    plan_delta,
+    publish_manifest_for,
+    run_delta_task,
+)
+
+__all__ = [
+    "CDCParams", "Chunk", "GearChunker", "chunk_bytes",
+    "DeltaManifest", "ManifestError", "build_manifest",
+    "fetch_or_build_manifest", "manifest_from_store", "manifest_object_key",
+    "DeltaPlan", "fetch_manifest", "manifest_url", "plan_delta",
+    "publish_manifest_for", "run_delta_task",
+]

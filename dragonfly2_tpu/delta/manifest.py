@@ -1,0 +1,204 @@
+"""Chunk manifests: the shippable description of one content version.
+
+A manifest is the CDC chunk list ``{offset, length, sha256}`` over one
+content version plus the chunking geometry that produced it (two hosts
+can only dedup against each other when their manifests agree on
+params). Manifests are small (a 70B-scale shard is ~10k chunks, ~1 MB of
+JSON) and are themselves cached as P2P objects so the chunk walk runs
+once per version, not once per host:
+
+  * object-gateway surface: ``.dfdelta/<key>.json`` beside the object,
+    ``fetch_or_build_manifest`` — the exact ``.dfidx`` pattern from the
+    dataset plane (dataset/tar_index.py::fetch_or_build_index);
+  * fabric surface: published as a ``dfdelta://<task_id>`` P2P task
+    keyed by the content task id (delta/resolver.py).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from dragonfly2_tpu.delta.chunker import CDCParams, Chunk, GearChunker
+from dragonfly2_tpu.pkg import dflog, metrics
+
+log = dflog.get("delta.manifest")
+
+MANIFEST_VERSION = 1
+# Hidden bucket prefix for gateway-cached manifests (same bucket as the
+# content so ACL/lifecycle follow it; same discipline as INDEX_PREFIX).
+MANIFEST_PREFIX = ".dfdelta/"
+
+MANIFEST_FETCHES = metrics.counter(
+    "peer_delta_manifest_total",
+    "Delta manifest resolutions by outcome", ("result",))
+
+
+class ManifestError(Exception):
+    """Malformed or inconsistent chunk manifest."""
+
+
+@dataclass
+class DeltaManifest:
+    """One content version's chunk map. ``name`` is the object key or
+    URL it describes (informational); identity is carried by where the
+    manifest is cached (object key / task id)."""
+
+    name: str
+    content_length: int
+    chunks: list[Chunk]
+    params: CDCParams = field(default_factory=CDCParams)
+    version: int = MANIFEST_VERSION
+
+    @property
+    def num_chunks(self) -> int:
+        return len(self.chunks)
+
+    def digest_map(self) -> dict[str, Chunk]:
+        """sha256 hex -> chunk (first occurrence wins; duplicate content
+        chunks are interchangeable by construction)."""
+        out: dict[str, Chunk] = {}
+        for c in self.chunks:
+            out.setdefault(c.sha256, c)
+        return out
+
+    def validate(self) -> None:
+        """Chunks must exactly tile [0, content_length)."""
+        off = 0
+        for c in self.chunks:
+            if c.offset != off or c.length <= 0:
+                raise ManifestError(
+                    f"chunk at {c.offset} breaks tiling (expected {off})")
+            off = c.end
+        if off != self.content_length:
+            raise ManifestError(
+                f"chunks cover {off}B of {self.content_length}B content")
+
+    # -- serialization (the P2P-cached form) -------------------------------
+
+    def to_json_bytes(self) -> bytes:
+        doc = {
+            "v": self.version,
+            "name": self.name,
+            "size": self.content_length,
+            "params": [self.params.mask_bits, self.params.min_size,
+                       self.params.max_size],
+            "chunks": [[c.offset, c.length, c.sha256] for c in self.chunks],
+        }
+        return json.dumps(doc, separators=(",", ":")).encode()
+
+    @classmethod
+    def from_json_bytes(cls, raw: bytes) -> "DeltaManifest":
+        try:
+            doc = json.loads(raw)
+            if doc["v"] != MANIFEST_VERSION:
+                raise ManifestError(
+                    f"manifest version {doc['v']} unsupported")
+            bits, mn, mx = doc["params"]
+            m = cls(
+                name=doc["name"], content_length=int(doc["size"]),
+                chunks=[Chunk(int(o), int(n), str(s))
+                        for o, n, s in doc["chunks"]],
+                params=CDCParams(mask_bits=int(bits), min_size=int(mn),
+                                 max_size=int(mx)))
+        except ManifestError:
+            raise
+        except Exception as e:
+            raise ManifestError(f"corrupt delta manifest: {e}") from e
+        m.validate()
+        return m
+
+
+def build_manifest(data: bytes, name: str = "",
+                   params: CDCParams | None = None) -> DeltaManifest:
+    """Manifest of in-memory content."""
+    ch = GearChunker(params)
+    ch.feed(data)
+    ch.finish()
+    return DeltaManifest(name=name, content_length=len(data),
+                         chunks=ch.chunks, params=ch.params)
+
+
+def manifest_from_store(store, name: str = "",
+                        params: CDCParams | None = None,
+                        span: int = 8 << 20) -> DeltaManifest:
+    """Manifest of a COMPLETED local task store: bounded pooled reads fed
+    through the streaming chunker (never the whole content in memory).
+    Runs CPU hashing — callers on an event loop wrap it in to_thread."""
+    from dragonfly2_tpu.storage.local_store import (
+        acquire_read_buffer,
+        release_read_buffer,
+    )
+
+    total = store.metadata.content_length
+    if total < 0:
+        raise ManifestError(
+            f"task {store.metadata.task_id[:16]} has unknown length")
+    ch = GearChunker(params)
+    with store:
+        buf = acquire_read_buffer(span)
+        try:
+            off = 0
+            while off < total:
+                take = min(span, total - off)
+                store.read_into(off, take, buf)
+                ch.feed(bytes(buf[:take]))
+                off += take
+        finally:
+            release_read_buffer(buf)
+    ch.finish()
+    return DeltaManifest(name=name or store.metadata.url,
+                         content_length=total, chunks=ch.chunks,
+                         params=ch.params)
+
+
+# -- gateway-cached manifest lifecycle (the .dfidx pattern) ----------------
+
+def manifest_object_key(key: str) -> str:
+    return f"{MANIFEST_PREFIX}{key}.json"
+
+
+async def fetch_or_build_manifest(store, bucket: str, key: str, *,
+                                  params: CDCParams | None = None,
+                                  publish: bool = True) -> DeltaManifest:
+    """The pod-wide manifest contract over the object gateway: try the
+    cached manifest object first (chunked once, fetched everywhere); on
+    miss, stream the object ONE pass through the chunker and publish the
+    result back (best effort; racing builders converge on identical
+    bytes). A cached manifest whose recorded size disagrees with the
+    object's current length is stale and rebuilt."""
+    from dragonfly2_tpu.client.dfstore import DfstoreError
+
+    meta = await store.stat_object(bucket, key)    # missing object raises
+    try:
+        raw = await store.get_object(bucket, manifest_object_key(key))
+        m = DeltaManifest.from_json_bytes(raw)
+        if m.content_length == meta.content_length and (
+                params is None or m.params == params):
+            MANIFEST_FETCHES.labels("hit").inc()
+            return m
+        log.info("cached delta manifest stale; rebuilding", key=key,
+                 cached=m.content_length, actual=meta.content_length)
+        MANIFEST_FETCHES.labels("stale").inc()
+    except DfstoreError:
+        pass
+    except ManifestError as e:
+        log.warning("cached delta manifest corrupt; rebuilding",
+                    key=key, error=str(e)[:200])
+        MANIFEST_FETCHES.labels("corrupt").inc()
+    ch = GearChunker(params)
+    async for chunk in await store.stream_object(bucket, key):
+        ch.feed(chunk)
+    ch.finish()
+    m = DeltaManifest(name=key, content_length=ch.consumed,
+                      chunks=ch.chunks, params=ch.params)
+    m.validate()
+    MANIFEST_FETCHES.labels("built").inc()
+    if publish:
+        try:
+            await store.put_object(bucket, manifest_object_key(key),
+                                   m.to_json_bytes())
+        except DfstoreError as e:
+            log.warning("delta manifest publish failed (non-fatal)",
+                        key=key, error=str(e)[:200])
+    return m

@@ -460,3 +460,103 @@ class HBMSink:
         return chunked_ring_all_gather(
             mesh, self.shard_to_mesh(mesh, axis_name),
             axis_name=axis_name, n_chunks=n_chunks)
+
+
+# ---------------------------------------------------------------------- #
+# Double-buffer hot-swap (checkpoint-delta plane, delta/)
+#
+# A serving process keeps the LIVE checkpoint generation on device while
+# the next one assembles in a spare buffer: reused delta chunks are
+# device-to-device slices of the live buffer (they never leave HBM, let
+# alone re-cross DCN), fetched chunks are host-staged once, and the
+# verified result replaces the live generation with ONE atomic reference
+# swap — a reader always sees a complete (generation, buffer, tensors)
+# triple, never a mix.
+# ---------------------------------------------------------------------- #
+
+def assemble_delta_u8(live_u8, parts):
+    """Assemble the next generation's uint8 content buffer.
+
+    ``parts`` is the new content in offset order, each element either
+    ``("r", src_offset, length)`` — a device-side slice of ``live_u8``
+    (a reused chunk at its OLD offset) — or ``("f", bytes)`` — a fetched
+    chunk's host bytes, staged to device here. One concatenate
+    materializes the buffer; reused bytes move HBM→HBM only."""
+    segs = []
+    for part in parts:
+        if part[0] == "r":
+            _, src, length = part
+            segs.append(live_u8[src:src + length])
+        else:
+            segs.append(jnp.asarray(
+                np.frombuffer(part[1], dtype=np.uint8)))
+    if not segs:
+        return jnp.zeros((0,), jnp.uint8)
+    return jnp.concatenate(segs) if len(segs) > 1 else segs[0]
+
+
+def verify_u8_against_host(u8, piece_size: int,
+                           host_checksums: "dict[int, tuple[int, int]]") -> None:
+    """On-device verification gate for a hot-swap flip: per-piece
+    (sum32, xor32) of the device buffer — the same checksum kernel the
+    land_and_checksum path folds — compared against host-side values
+    (checksum_numpy over the disk copy's pieces). Raises ValueError
+    naming the first mismatching piece; the flip must not happen."""
+    if piece_size % 4:
+        raise ValueError(f"piece size {piece_size} not 4-byte aligned")
+    total = int(u8.shape[0])
+    pieces = max(1, (total + piece_size - 1) // piece_size)
+    padded = pieces * piece_size
+    if padded > total:
+        u8 = jnp.concatenate(
+            [u8, jnp.zeros((padded - total,), jnp.uint8)])
+    words = jax.lax.bitcast_convert_type(
+        u8.reshape(padded // 4, 4), jnp.uint32).reshape(-1)
+    sums, xors = _chunk_checksums_xla(words, piece_size // 4)
+    sums = np.asarray(sums)
+    xors = np.asarray(xors)
+    for num, (want_s, want_x) in sorted(host_checksums.items()):
+        have = (int(sums[num]), int(xors[num]))
+        if have != (want_s, want_x):
+            raise ValueError(
+                f"piece {num} corrupt in spare buffer: "
+                f"sum {have[0]:#x}!={want_s:#x} "
+                f"xor {have[1]:#x}!={want_x:#x}")
+
+
+class DoubleBuffer:
+    """Atomic generation holder for hot-swapped device checkpoints.
+
+    Readers call ``snapshot()`` (or ``tensors()``) and get one complete
+    generation — the state is a single tuple swapped in one reference
+    assignment, so a concurrently flipping writer can never expose a
+    half-updated tensor set. Writers assemble + verify the next
+    generation OFF to the side and ``flip()`` only after the verify
+    gate passed; the previous generation's buffer is released when the
+    last reader drops its snapshot (ordinary refcounting)."""
+
+    __slots__ = ("_state",)
+
+    def __init__(self):
+        self._state: tuple = (0, None, {})
+
+    @property
+    def generation(self) -> int:
+        return self._state[0]
+
+    def snapshot(self) -> tuple:
+        """(generation, buffer_u8, tensors) — one consistent triple."""
+        return self._state
+
+    def tensors(self) -> dict:
+        return self._state[2]
+
+    def buffer(self):
+        return self._state[1]
+
+    def flip(self, buffer, tensors: dict) -> int:
+        """Install the next generation. Callers flip ONLY verified
+        buffers (verify_u8_against_host / HBMSink.verify)."""
+        gen = self._state[0] + 1
+        self._state = (gen, buffer, dict(tensors))
+        return gen

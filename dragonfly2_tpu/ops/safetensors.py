@@ -52,6 +52,28 @@ def parse_header(head: bytes) -> tuple[dict, int]:
     return header, 8 + n
 
 
+def header_metadata(header: dict) -> dict[str, str]:
+    """The checkpoint's ``__metadata__`` entry as a plain dict ({} when
+    absent). The format allows free-form string-to-string metadata
+    (producer, format tags, training step); ``tensor_views`` skips the
+    entry when building tensors, and this is the public accessor for it
+    — a malformed entry (non-object, non-string values) raises instead
+    of being silently dropped, since callers branch on it."""
+    if not isinstance(header, dict):
+        raise SafetensorsError(
+            f"header must be a JSON object, got {type(header).__name__}")
+    meta = header.get("__metadata__")
+    if meta is None:
+        return {}
+    if (not isinstance(meta, dict)
+            or not all(isinstance(k, str) and isinstance(v, str)
+                       for k, v in meta.items())):
+        raise SafetensorsError(
+            "__metadata__ must be a string-to-string object, got "
+            f"{meta!r}")
+    return dict(meta)
+
+
 def tensor_views(u8: jax.Array, header: dict, data_start: int,
                  names: list[str] | None = None) -> dict[str, jax.Array]:
     """Named device tensors as bitcast slices of the landed u8 buffer.
@@ -103,6 +125,15 @@ def tensor_views(u8: jax.Array, header: dict, data_start: int,
                 f"({total - data_start} data bytes)")
         raw = u8[data_start + begin: data_start + end]
         canon = jax.dtypes.canonicalize_dtype(dtype)
+        if count == 0:
+            # Zero-length tensors (a 0 dim, data_offsets [s, s]) are
+            # legal safetensors; there are no bytes to bitcast (and no
+            # values for the 64-bit range checks to refuse), so build
+            # the empty view directly in the canonical dtype.
+            out[name] = jnp.zeros(
+                shape, dtype=jnp.bool_ if np.dtype(canon) == np.bool_
+                else canon)
+            continue
         if np.dtype(canon) == np.bool_:
             # bitcast_convert_type refuses bool targets; BOOL is one
             # byte of 0/1 — compare instead.

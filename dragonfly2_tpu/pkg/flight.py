@@ -84,6 +84,8 @@ EV_HBM_LANDED = 21     # device-sink landing done
 EV_UPLOAD_SERVE = 22   # this daemon served a piece of the task (aux=bytes)
 EV_TASK_DONE = 23
 EV_TASK_FAILED = 24
+EV_DELTA_REUSE = 25    # delta chunk copied from the local base (aux=cost_ms)
+EV_DELTA_FETCH = 26    # delta chunk pulled as a ranged task (aux=cost_ms)
 
 EVENT_NAMES = {
     EV_REGISTER: "register", EV_SCHEDULED: "scheduled",
@@ -98,6 +100,7 @@ EVENT_NAMES = {
     EV_SOURCE_LANDED: "source_landed", EV_HBM_START: "hbm_start",
     EV_HBM_LANDED: "hbm_landed", EV_UPLOAD_SERVE: "upload_serve",
     EV_TASK_DONE: "task_done", EV_TASK_FAILED: "task_failed",
+    EV_DELTA_REUSE: "delta_reuse", EV_DELTA_FETCH: "delta_fetch",
 }
 
 # Canonical phase model. ``other`` (residual uninstrumented time) rides
@@ -356,6 +359,14 @@ def analyze(tf: TaskFlight, *, stall_ttfb_s: float = STALL_TTFB_S,
                 intervals.append((t_fb, t, phase))
             else:
                 intervals.append((t_req, t, phase))
+        elif code in (EV_DELTA_REUSE, EV_DELTA_FETCH):
+            # Delta tasks: local base copies book as store (host-local
+            # work), ranged-span pulls as dcn — so --explain separates
+            # local-copy time from wire time while the partition stays
+            # wall-time-exact (cost-backed intervals like source_landed).
+            if aux > 0:
+                phase = "store" if code == EV_DELTA_REUSE else "dcn"
+                intervals.append((max(0.0, t - aux / 1000.0), t, phase))
         elif code == EV_SOURCE_LANDED:
             intervals.append((max(0.0, t - aux / 1000.0), t, "origin"))
             row = row_for(piece)

@@ -314,7 +314,11 @@ STREAM_OPEN: dict[str, Msg] = {
         "DownloadOpen",
         url=F(str, required=True), output=F(str),
         meta=F(dict, spec=URL_META), disable_back_source=F(bool),
-        device=F(str), pod_broadcast=F(bool)),
+        device=F(str), pod_broadcast=F(bool),
+        # checkpoint-delta plane: task id of the locally-landed base
+        # version; chunks the base already holds are copied locally and
+        # only changed chunks cross the wire (dfget --delta-base)
+        delta_base=F(str)),
     "Daemon.ExportTask": Msg(
         "ExportTaskOpen",
         cache_id=F(str, required=True), output=F(str, required=True),

@@ -31,6 +31,7 @@ REQUIRED_CONFIGS = (
     "config8_flight",
     "config9_fleet",
     "config10_podlens",
+    "config11_delta",
     "ingest_micro",
 )
 
@@ -246,6 +247,34 @@ def test_pod_sim_churn_4k_shape():
     assert entry["peers_after_gc"] == 0
     assert entry["tasks_after_gc"] == 0
     assert entry["hosts_after_gc"] == 0
+
+
+def test_delta_entry_paired_shape():
+    """config11_delta is a PAIRED run: cold broadcast and delta update
+    of the same 1%-scattered-mutation checkpoint over the same pod
+    shape, order-alternating rounds. The acceptance bound: the delta
+    moves <5% of the bytes of the cold broadcast, and the byte
+    accounting (reused + fetched) sums EXACTLY to the content length —
+    reused spans never ride the wire."""
+    entry = _load()["published"]["config11_delta"]
+    assert entry["accounting_exact"] is True
+    delta, cold = entry["delta"], entry["cold"]
+    assert cold["bytes"] == entry["content_bytes"]
+    assert delta["reused_bytes"] + delta["fetched_bytes"] == \
+        entry["content_bytes"]
+    # The headline: a 1%-mutation update moves <5% of a cold broadcast.
+    assert 0 < entry["delta_bytes_ratio"] <= 0.05, entry
+    assert entry["delta_bytes_ratio"] == pytest.approx(
+        delta["fetched_bytes"] / cold["bytes"], abs=1e-4)
+    assert 0 < entry["mutation"]["frac"] <= 0.02
+    assert entry["mutation"]["sites"] >= 2, "scattered edits, not one blob"
+    # Paired shape: both modes ran the same number of rounds.
+    assert len(cold["runs_s"]) == entry["rounds"] == len(delta["runs_s"])
+    for runs in (cold["runs_s"], delta["runs_s"]):
+        assert all(w > 0 for w in runs)
+    assert delta["chunks_fetched"] > 0 and delta["chunks_reused"] > 0
+    assert entry["chunking"]["chunks"] == \
+        delta["chunks_fetched"] + delta["chunks_reused"]
 
 
 def test_stripe_sim_meets_acceptance_bounds():

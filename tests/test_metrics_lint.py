@@ -36,6 +36,8 @@ METRIC_MODULES = (
     "dragonfly2_tpu.daemon.peer.task_manager",
     "dragonfly2_tpu.daemon.peer.device_sink",
     "dragonfly2_tpu.scheduler.service",
+    "dragonfly2_tpu.delta.manifest",
+    "dragonfly2_tpu.delta.resolver",
     "dragonfly2_tpu.dataset.loader",
     "dragonfly2_tpu.dataset.shard_reader",
     "dragonfly2_tpu.dataset.tar_index",

@@ -240,6 +240,69 @@ class TestReviewRegressions:
             np.asarray(loaded["ids"]), arr.astype(np.int32))
 
 
+class TestZeroLengthAndMetadata:
+    """ISSUE 10 satellites: zero-length tensors load without raising,
+    and the ``__metadata__`` entry has a public accessor instead of
+    being silently dropped."""
+
+    def _content(self, header: dict, data: bytes = b"") -> bytes:
+        hj = json.dumps(header).encode()
+        return struct.pack("<Q", len(hj)) + hj + data
+
+    def test_zero_length_tensors_all_dtypes(self):
+        header = {
+            "f32": {"dtype": "F32", "shape": [0], "data_offsets": [0, 0]},
+            "f64": {"dtype": "F64", "shape": [0], "data_offsets": [0, 0]},
+            "i64": {"dtype": "I64", "shape": [0, 4], "data_offsets": [0, 0]},
+            "bool": {"dtype": "BOOL", "shape": [0], "data_offsets": [0, 0]},
+            "mid": {"dtype": "F32", "shape": [2], "data_offsets": [0, 8]},
+            "empty_at_end": {"dtype": "F16", "shape": [4, 0],
+                             "data_offsets": [8, 8]},
+        }
+        sink = _land(self._content(header, b"\x11" * 8), piece=256)
+        loaded = st.load_from_sink(sink)
+        assert loaded["f32"].shape == (0,)
+        assert loaded["f64"].shape == (0,)     # no x64 refusal for 0 elems
+        assert loaded["i64"].shape == (0, 4)
+        assert loaded["bool"].shape == (0,)
+        assert bool(loaded["bool"].dtype == np.bool_)
+        assert loaded["empty_at_end"].shape == (4, 0)
+        assert loaded["mid"].shape == (2,)
+
+    def test_zero_length_span_mismatch_still_rejected(self):
+        # A 0-element shape with a NON-empty span is malformed.
+        header = {"t": {"dtype": "F32", "shape": [0],
+                        "data_offsets": [0, 4]}}
+        sink = _land(self._content(header, b"\0" * 4), piece=256)
+        with pytest.raises(st.SafetensorsError, match="data span"):
+            st.load_from_sink(sink)
+
+    def test_header_metadata_accessor(self):
+        header = {"__metadata__": {"format": "pt", "step": "1234"},
+                  "w": {"dtype": "F32", "shape": [1],
+                        "data_offsets": [0, 4]}}
+        content = self._content(header, b"\0" * 4)
+        parsed, _ = st.parse_header(content)
+        assert st.header_metadata(parsed) == {"format": "pt",
+                                              "step": "1234"}
+        # tensor_views still skips it.
+        sink = _land(content, piece=256)
+        assert set(st.load_from_sink(sink)) == {"w"}
+
+    def test_header_metadata_absent_is_empty(self):
+        parsed, _ = st.parse_header(self._content(
+            {"w": {"dtype": "F32", "shape": [1], "data_offsets": [0, 4]}},
+            b"\0" * 4))
+        assert st.header_metadata(parsed) == {}
+
+    def test_header_metadata_malformed_rejected(self):
+        for bad in ([1, 2], "x", {"k": 3}, {"k": None}, {"k": ["v"]}):
+            with pytest.raises(st.SafetensorsError, match="__metadata__"):
+                st.header_metadata({"__metadata__": bad})
+        with pytest.raises(st.SafetensorsError, match="JSON object"):
+            st.header_metadata([])
+
+
 def test_pod_global_shardings_from_preheated_sink(checkpoint):
     """The north-star consumption chain: a preheat-landed checkpoint loads
     straight into tensors placed on a pod-global factored mesh —
