@@ -150,6 +150,11 @@ def _probe_backend_subprocess(timeout_s: float) -> str | None:
     return None
 
 
+def _log(msg: str) -> None:
+    """Progress to stderr (stdout stays a single JSON artifact line)."""
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
 def _init_backend_with_retry(max_attempts: int = 6,
                              probe_timeout_s: float = 45.0):
     """Backend init with bounded backoff (round-2 lesson: a single transient
@@ -157,19 +162,31 @@ def _init_backend_with_retry(max_attempts: int = 6,
     round-3 lesson: the tunnel can HANG rather than fail, so each attempt
     probes in a subprocess with a hard timeout; round-4 lesson: 4x120s
     probes burned 8+ minutes saying nothing — shorter probes, more of
-    them, each naming the frame it died in). The probe AND the in-process
-    import both run under the scrubbed device env (no inherited cpu pin).
-    Returns (jax, attempts)."""
+    them, each naming the frame it died in; round-5 lesson: the per-attempt
+    outcomes were invisible until the final artifact, so every attempt now
+    logs WHERE its probe died the moment it dies, and the inter-attempt
+    cooldown is tunable via BENCH_ATTEMPT_COOLDOWN, because the relay
+    needs tens of seconds to recycle a stuck dial and retrying into the
+    same wedge just burns the attempt budget). The probe AND the
+    in-process import both run under the scrubbed device env (no inherited
+    cpu pin). Returns (jax, attempts)."""
     if os.environ.get("BENCH_FORCE_FALLBACK"):
         raise RuntimeError("forced fallback via BENCH_FORCE_FALLBACK")
     probe_timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT",
                                            probe_timeout_s))
     max_attempts = int(os.environ.get("BENCH_MAX_ATTEMPTS", max_attempts))
-    delay = 5.0
+    # Base cooldown between attempts; doubles up to 6x base (capped 30 s
+    # historically — keep the cap unless the base pushes past it).
+    cooldown = float(os.environ.get("BENCH_ATTEMPT_COOLDOWN", "5"))
+    delay = cooldown
     last = None
     for attempt in range(1, max_attempts + 1):
+        t0 = time.perf_counter()
         last = _probe_backend_subprocess(probe_timeout_s)
+        took = time.perf_counter() - t0
         if last is None:
+            _log(f"backend init attempt {attempt}/{max_attempts}: "
+                 f"device probe OK in {took:.1f}s")
             # The probe saw a device under the scrubbed env; import with
             # the same scrub or this process would still init the cpu pin.
             env, scrubbed = _scrubbed_device_env()
@@ -178,9 +195,12 @@ def _init_backend_with_retry(max_attempts: int = 6,
             import jax
 
             return jax, attempt
-        if attempt < max_attempts:
+        _log(f"backend init attempt {attempt}/{max_attempts} failed "
+             f"after {took:.1f}s: {last}")
+        if attempt < max_attempts and delay > 0:
+            _log(f"cooling down {delay:.0f}s before attempt {attempt + 1}")
             time.sleep(delay)
-            delay = min(delay * 2, 30.0)
+            delay = min(delay * 2, max(30.0, cooldown))
     err = RuntimeError(
         f"backend init failed after {max_attempts} attempts: {last}")
     err.attempts = max_attempts
@@ -276,8 +296,12 @@ def bench_staged_transfer(jax, total_mb: int = 64, repeats: int = 4) -> float:
 
 def sink_smoke(jax) -> str:
     """Real-chip smoke of the PRODUCT path: HBMSink lands host pieces,
-    verifies on device, and round-trips the bytes exactly."""
-    from dragonfly2_tpu.ops.hbm_sink import HBMSink
+    verifies on device, round-trips the bytes exactly, AND passes the
+    hot-swap verification gate (verify_u8_against_host: the same on-device
+    checksum kernel the delta plane runs against host-side values before a
+    DoubleBuffer flip — so the round's evidence covers the swap gate, not
+    just the landing path)."""
+    from dragonfly2_tpu.ops.hbm_sink import HBMSink, verify_u8_against_host
 
     piece = 1 << 20
     rng = np.random.RandomState(7)
@@ -290,7 +314,12 @@ def sink_smoke(jax) -> str:
     if not sink.complete():
         return "incomplete"
     sink.verify()
-    out = np.asarray(sink.as_bytes_array()).tobytes()
+    u8 = sink.as_bytes_array()
+    try:
+        verify_u8_against_host(u8, piece, sink.host_checksums)
+    except ValueError as e:
+        return f"swap gate failed: {e}"
+    out = np.asarray(u8).tobytes()
     return "ok" if out == content else "bytes mismatch"
 
 

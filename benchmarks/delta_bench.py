@@ -170,7 +170,7 @@ async def _run_round(workdir: str, v1: bytes, v2: bytes, params,
 
 
 def run_bench(mb: int, rounds: int) -> dict:
-    from dragonfly2_tpu.delta.chunker import CDCParams
+    from dragonfly2_tpu.delta.chunker import CDCParams, chunker_backend
     from dragonfly2_tpu.delta.manifest import build_manifest
 
     # 16 KiB-target chunks with a 64 KiB hard max: over 24 MiB content
@@ -218,7 +218,8 @@ def run_bench(mb: int, rounds: int) -> dict:
                      "max_kib": params.max_size >> 10,
                      "chunks": m2.num_chunks,
                      "manifest_bytes": len(m2.to_json_bytes()),
-                     "chunk_mb_s": round(mb / chunk_s, 1)},
+                     "chunk_mb_s": round(mb / chunk_s, 1),
+                     "chunker_backend": chunker_backend()},
         "rounds": rounds,
         "cold": {"wall_s": med, "runs_s": cold_walls,
                  "bytes": len(mutated)},

@@ -27,6 +27,12 @@ Four phases, mirroring the daemon's ingest AND serve shapes:
   hash     the CPU crc32c verify fallback: the selected non-native
            backend (pkg/digest order: google-crc32c > python) vs the old
            pure-Python table composition, same piece geometry.
+  spans    multi-span serve: the ranged-gateway / delta-fetch shape (many
+           small disjoint spans per read_spans_into batch), PAIRED with
+           the submission ring on vs off and order-alternated; headline
+           is the median of per-round on/off ratios.
+  chunker  CDC candidate scan: native dfchunk.cc vs numpy, scan MiB/s and
+           end-to-end chunking MiB/s plus cut-point equality.
 
 Usage: python benchmarks/ingest_micro.py [--mb 256] [--runs 3] [--publish]
 Writes a JSON line to stdout; --publish records it under
@@ -300,6 +306,137 @@ def bench_hash_fallback(content: bytes) -> dict:
     }
 
 
+def bench_chunker(content: bytes) -> dict:
+    """CDC candidate-scan ladder: native dfchunk.cc vs numpy over the
+    same bytes — scan throughput (the component the native kernel owns),
+    end-to-end chunking throughput (sha256-bound; reported so the scan
+    number can't masquerade as the pipeline number), and cut-point
+    equality. Both sides take best-of-N: the box's timing variance would
+    otherwise punish whichever side ran during a noisy slice."""
+    from dragonfly2_tpu.delta import chunker as chk
+    from dragonfly2_tpu.delta.chunker import CDCParams, GearChunker
+
+    sample = content[:32 << 20]
+    mask_bits = 14
+    params = CDCParams(mask_bits=mask_bits, min_size=8 << 10,
+                       max_size=64 << 10)
+
+    def best_mbps(fn, repeats: int) -> float:
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return len(sample) / best / 1e6
+
+    def chunks_with(scan_fn):
+        old = chk._scanner
+        chk._scanner = scan_fn
+        try:
+            g = GearChunker(params)
+            g.feed(sample)
+            g.finish()
+            return [(c.offset, c.length, c.sha256) for c in g.chunks]
+        finally:
+            chk._scanner = old
+
+    numpy_scan = best_mbps(
+        lambda: chk._scan_numpy(sample, 0, mask_bits), repeats=3)
+    numpy_cuts = chunks_with(chk._scan_numpy)
+    numpy_chunk = best_mbps(lambda: chunks_with(chk._scan_numpy), repeats=2)
+    out = {
+        "backend": chk.chunker_backend(),
+        "mask_bits": mask_bits,
+        "sample_mb": len(sample) >> 20,
+        "scan": {"numpy_mbps": round(numpy_scan, 1)},
+        "chunk": {"numpy_mbps": round(numpy_chunk, 1)},
+        "cut_points_equal": True,
+    }
+    native = chk._native_scanner()
+    if native is not None:
+        native_scan = best_mbps(
+            lambda: native(sample, 0, mask_bits), repeats=5)
+        native_cuts = chunks_with(native)
+        native_chunk = best_mbps(lambda: chunks_with(native), repeats=3)
+        out["scan"]["native_mbps"] = round(native_scan, 1)
+        out["scan"]["speedup"] = round(native_scan / numpy_scan, 1)
+        out["chunk"]["native_mbps"] = round(native_chunk, 1)
+        out["chunk"]["speedup"] = round(native_chunk / numpy_chunk, 2)
+        out["cut_points_equal"] = native_cuts == numpy_cuts
+        # The scan candidates themselves, not just post-_emit cuts:
+        out["cut_points_equal"] &= (
+            native(sample[: 4 << 20], 0, mask_bits)
+            == chk._scan_numpy(sample[: 4 << 20], 0, mask_bits))
+    return out
+
+
+def bench_serve_spans(workdir: str, content: bytes) -> dict:
+    """Paired multi-span serve: the submission ring (default rung) vs the
+    ring-off serial loop through the SAME store API, order-alternating
+    inside each round so ambient drift can't favor a side; the headline
+    is the MEDIAN of per-round on/off ratios (the PR 7 estimator). Shape:
+    64 disjoint 8 KiB spans per batch — the ranged-gateway / delta-span
+    fetch pattern where per-span overhead, not bandwidth, is the cost."""
+    from dragonfly2_tpu.storage import io_ring
+
+    store = _landed_store(workdir, content, "spans")
+    n_spans, span_len = 64, 8 << 10
+    rng = random.Random(17)
+    spans = [(rng.randrange(len(content) - span_len), span_len)
+             for _ in range(n_spans)]
+    batch_bytes = n_spans * span_len
+    buf = bytearray(batch_bytes)
+    ring_on = io_ring._select_ring()
+    ring_off = io_ring.SubmissionRing("serial")
+    prev = io_ring.swap_ring(ring_off)
+    try:
+        store.read_spans_into(spans, buf)
+        ref = bytes(buf)
+        io_ring.swap_ring(ring_on)
+        store.read_spans_into(spans, buf)
+        identical = bytes(buf) == ref
+
+        iters = 1500
+
+        def side(ring) -> float:
+            io_ring.swap_ring(ring)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                store.read_spans_into(spans, buf)
+            return batch_bytes * iters / (time.perf_counter() - t0) / 1e6
+
+        on_runs, off_runs, ratios = [], [], []
+        for r in range(6):
+            if r % 2 == 0:
+                on = side(ring_on)
+                off = side(ring_off)
+            else:
+                off = side(ring_off)
+                on = side(ring_on)
+            on_runs.append(round(on, 1))
+            off_runs.append(round(off, 1))
+            ratios.append(round(on / off, 3))
+    finally:
+        io_ring.swap_ring(prev)
+        ring_off.close()
+        if ring_on is not prev:
+            ring_on.close()
+        store.destroy()
+    return {
+        "ring_backend": ring_on.backend,
+        "spans_per_batch": n_spans,
+        "span_kib": span_len >> 10,
+        "rounds": len(ratios),
+        "on_mbps": statistics.median(on_runs),
+        "off_mbps": statistics.median(off_runs),
+        "on_runs_mbps": on_runs,
+        "off_runs_mbps": off_runs,
+        "pair_ratios": ratios,
+        "ratio_median": round(statistics.median(ratios), 3),
+        "bytes_identical": identical,
+    }
+
+
 async def run_bench(total_mb: int, runs: int, workdir: str) -> dict:
     rng = random.Random(7)
     content = b"".join(rng.randbytes(16 << 20)
@@ -325,6 +462,8 @@ async def run_bench(total_mb: int, runs: int, workdir: str) -> dict:
     serve_bytes = statistics.median(serve["bytes"])
     serve_sendfile = statistics.median(serve["sendfile"])
     hash_fallback = bench_hash_fallback(content)
+    serve_spans = await asyncio.to_thread(bench_serve_spans, workdir, content)
+    chunker = await asyncio.to_thread(bench_chunker, content)
     return {
         "config": "ingest-micro",
         "content_mb": total_mb,
@@ -343,6 +482,8 @@ async def run_bench(total_mb: int, runs: int, workdir: str) -> dict:
             if serve_bytes else 0.0,
         },
         "hash_fallback": hash_fallback,
+        "serve_spans": serve_spans,
+        "chunker": chunker,
         "piece_size_mb": compute_piece_size(total_mb << 20) >> 20,
         "host_cores": os.cpu_count(),
     }

@@ -13,19 +13,36 @@ Determinism contract: the gear table is derived from SHA-256 (no process
 seed), the hash window is fixed, and ``feed()`` may split the stream
 anywhere — the emitted chunk sequence is a pure function of (content,
 params). tests/test_delta.py pins split-independence and the
-shift-resistance property.
+shift-resistance property; tests/test_chunker_oracle.py pins that every
+backend produces byte-identical cut points.
 
-The per-position hash is computed vectorized over numpy (a shifted-sum
-convolution over the window), not per byte in Python — the chunker sits
-in front of real checkpoint shards.
+The candidate scan (hash every position, report the rare ones whose top
+``mask_bits`` are zero) is the hot loop and sits behind a backend ladder
+selected the way pkg/digest picks crc32c implementations:
+
+  native  — dragonfly2_tpu/native/src/dfchunk.cc, interleaved scalar
+            recurrences (~GB/s; ships the same SHA-256 gear table down)
+  numpy   — log-doubling shifted-sum convolution (~tens of MiB/s)
+  python  — per-byte rolling hash (correctness fallback)
+
+``chunker_backend()`` reports the selection; DF_CHUNKER_BACKEND forces
+one ladder rung (benchmarks pin numpy to measure the native speedup).
+min/max/forced-cut selection (``_emit``) is shared by all backends, so a
+backend can only ever change speed, never cut points.
 """
 
 from __future__ import annotations
 
 import hashlib
+import os
 from dataclasses import dataclass
 
-import numpy as np
+try:
+    import numpy as np
+except ImportError:          # pragma: no cover - numpy is everywhere in CI
+    np = None
+
+from dragonfly2_tpu.pkg import metrics
 
 # Sliding window of the gear hash: how many bytes influence a cut
 # decision. The hash is the classic gear recurrence h = 2h + gear[b]
@@ -36,10 +53,17 @@ WINDOW = 32
 
 # Gear table: 256 deterministic 32-bit values (sha256 of the byte value;
 # NOT random.seed — two builds must always agree).
-_GEAR = np.array(
-    [int.from_bytes(hashlib.sha256(bytes([i])).digest()[:4], "little")
-     for i in range(256)],
-    dtype=np.uint32)
+_GEAR_LIST = [
+    int.from_bytes(hashlib.sha256(bytes([i])).digest()[:4], "little")
+    for i in range(256)
+]
+_GEAR = np.array(_GEAR_LIST, dtype=np.uint32) if np is not None else None
+_GEAR_BYTES = b"".join(v.to_bytes(4, "little") for v in _GEAR_LIST)
+
+CHUNKER_BACKEND_ACTIVE = metrics.gauge(
+    "delta_chunker_backend",
+    "Selected CDC candidate-scan backend (1 = active; ladder "
+    "native > numpy > python, see delta/chunker.py)", ("backend",))
 
 
 @dataclass(frozen=True)
@@ -72,7 +96,7 @@ class Chunk:
         return self.offset + self.length
 
 
-def _window_hashes(data: np.ndarray) -> np.ndarray:
+def _window_hashes(data) -> "np.ndarray":
     """H[i] = sum_{j<WINDOW} gear[data[i-j]] << j (mod 2^32), vectorized.
 
     Computed by log-doubling instead of one pass per window position:
@@ -98,6 +122,85 @@ def _window_hashes(data: np.ndarray) -> np.ndarray:
     return h
 
 
+# --------------------------------------------------------------------- #
+# Candidate-scan backends. Each takes (region, ctx, mask_bits) — region
+# is a bytes-like whose first ctx bytes are left context — and returns
+# ascending region-relative indices (>= ctx) of bytes whose gear hash
+# has its top mask_bits zero. Identical output is pinned by
+# tests/test_chunker_oracle.py; _emit turns candidates into cuts.
+# --------------------------------------------------------------------- #
+
+def _scan_python(region, ctx: int, mask_bits: int) -> list[int]:
+    limit = 1 << (32 - mask_bits)
+    gear = _GEAR_LIST
+    h = 0
+    out = []
+    for i, b in enumerate(memoryview(region)):
+        h = ((h << 1) + gear[b]) & 0xFFFFFFFF
+        if h < limit and i >= ctx:
+            out.append(i)
+    return out
+
+
+def _scan_numpy(region, ctx: int, mask_bits: int) -> list[int]:
+    data = np.frombuffer(region, dtype=np.uint8)
+    h = _window_hashes(data)[ctx:]
+    shift = np.uint32(32 - mask_bits)
+    return [ctx + int(i)
+            for i in np.nonzero((h >> shift) == np.uint32(0))[0]]
+
+
+def _native_scanner():
+    """The dfchunk.cc kernel as a scan function, or None. Self-checked
+    against the pure-python reference on a deterministic vector before
+    selection (mirrors pkg/digest's probe discipline)."""
+    try:
+        from dragonfly2_tpu.native import binding
+    except ImportError:
+        return None
+    if not hasattr(binding, "chunk_scan"):
+        return None      # stale prebuilt library without the kernel
+
+    def scan(region, ctx: int, mask_bits: int) -> list[int]:
+        return binding.chunk_scan(region, _GEAR_BYTES, mask_bits, ctx)
+
+    probe = hashlib.sha256(b"dfchunk-probe").digest() * 256   # 8 KiB
+    try:
+        if scan(probe, 5, 8) != _scan_python(probe, 5, 8):
+            return None
+    except Exception:
+        return None
+    return scan
+
+
+_scanner = None
+_backend_name = "unset"
+
+
+def _select_scanner():
+    """Pick the fastest available backend (native > numpy > python),
+    honoring DF_CHUNKER_BACKEND={native,numpy,python} to pin a rung."""
+    global _scanner, _backend_name
+    forced = os.environ.get("DF_CHUNKER_BACKEND", "").strip().lower()
+    native = None if forced in ("numpy", "python") else _native_scanner()
+    if native is not None:
+        _scanner, _backend_name = native, "native"
+    elif np is not None and forced != "python":
+        _scanner, _backend_name = _scan_numpy, "numpy"
+    else:
+        _scanner, _backend_name = _scan_python, "python"
+    CHUNKER_BACKEND_ACTIVE.labels(_backend_name).set(1)
+    return _scanner
+
+
+def chunker_backend() -> str:
+    """Which candidate-scan implementation chunking uses:
+    "native" (dfchunk.cc), "numpy", or "python"."""
+    if _scanner is None:
+        _select_scanner()
+    return _backend_name
+
+
 class GearChunker:
     """Streaming CDC chunker: ``feed()`` arbitrary byte chunks (any
     split), collect emitted ``Chunk``s from ``feed``'s return value (or
@@ -114,6 +217,8 @@ class GearChunker:
         self._cands: list[int] = []     # absolute cut positions (chunk END)
         self._ci = 0                    # consumed prefix of _cands
         self._finished = False
+        if _scanner is None:
+            _select_scanner()
 
     # -- feeding -----------------------------------------------------------
 
@@ -142,38 +247,37 @@ class GearChunker:
 
     # -- internals ---------------------------------------------------------
 
-    # One vectorized scan block: bounds the uint64 temporaries to
+    # One scan block: bounds the numpy backend's uint64 temporaries to
     # ~3 x 8 x 4 MiB regardless of how much one feed() delivers.
     _SCAN_BLOCK = 4 << 20
 
     def _scan(self) -> None:
-        """Hash the not-yet-scanned region (with WINDOW-1 bytes of left
+        """Scan the not-yet-scanned region (with WINDOW-1 bytes of left
         context so boundaries are split-independent) and append new cut
-        candidates. Processes in bounded blocks."""
-        # Cut condition: the TOP mask_bits of the hash are zero. High
-        # bits see the whole 32-byte window (bit k folds the last k+1
-        # bytes), so the boundary context does not shrink with the mask.
-        shift = np.uint32(32 - self.params.mask_bits)
-        zero = np.uint32(0)
+        candidates. Processes in bounded blocks through the selected
+        backend; the cut condition — the TOP mask_bits of the hash are
+        zero — sees the whole 32-byte window at every mask width."""
+        scan = _scanner
         while True:
             lo = self._scanned - self._tail_start   # first unscanned, tail-rel
             hi = min(len(self._tail), lo + self._SCAN_BLOCK)
             if hi <= lo:
                 return
             ctx = min(lo, WINDOW - 1)
-            region = np.frombuffer(
-                memoryview(self._tail)[lo - ctx:hi], dtype=np.uint8)
-            h = _window_hashes(region)[ctx:]
-            for i in np.nonzero((h >> shift) == zero)[0]:
+            region = memoryview(self._tail)[lo - ctx:hi]
+            for i in scan(region, ctx, self.params.mask_bits):
                 # Cut AFTER the matching byte: chunk end = position + 1.
-                self._cands.append(self._scanned + int(i) + 1)
+                self._cands.append(self._scanned + (i - ctx) + 1)
             self._scanned = self._tail_start + hi
 
     def _emit(self) -> list[Chunk]:
         p = self.params
-        out: list[Chunk] = []
+        # Decide every cut first, then materialize them off one view and
+        # trim the tail ONCE — the per-chunk `del tail[:length]` memmove
+        # was O(tail x chunks) when a feed() completed many chunks.
+        lengths: list[int] = []
+        start = self._tail_start
         while True:
-            start = self._tail_start
             # First candidate cut that respects min_size for this chunk.
             while (self._ci < len(self._cands)
                    and self._cands[self._ci] - start < p.min_size):
@@ -186,8 +290,23 @@ class GearChunker:
             if cut < 0 and self._scanned - start >= p.max_size:
                 cut = p.max_size                    # forced cut at the bound
             if cut < 0:
-                return out
-            out.append(self._cut(cut))
+                break
+            lengths.append(cut)
+            start += cut
+        if not lengths:
+            return []
+        out: list[Chunk] = []
+        mv = memoryview(self._tail)
+        off = 0
+        for length in lengths:
+            ck = Chunk(self._tail_start + off, length,
+                       hashlib.sha256(mv[off:off + length]).hexdigest())
+            out.append(ck)
+            self.chunks.append(ck)
+            off += length
+        del mv
+        del self._tail[:off]
+        self._tail_start += off
         return out
 
     def _cut(self, length: int) -> Chunk:

@@ -19,6 +19,7 @@ Cross-HANDLE concurrency is unrestricted.
 
 from __future__ import annotations
 
+import array as _array
 import ctypes
 import os
 
@@ -27,7 +28,16 @@ from dragonfly2_tpu.native import build as _build
 if os.environ.get("DF_DISABLE_NATIVE"):
     raise ImportError("native library disabled via DF_DISABLE_NATIVE")
 
-_lib = ctypes.CDLL(_build.build())
+# Import contract: failure to produce/load the library is ALWAYS a clean
+# ImportError with a one-line reason — never a CalledProcessError or OSError
+# traceback — so the backend ladders (pkg/digest, delta/chunker,
+# storage/io_ring) can catch ImportError and fall through.
+try:
+    _lib = ctypes.CDLL(_build.build())
+except _build.BuildUnavailable as e:
+    raise ImportError(f"native library unavailable: {e.reason}") from None
+except OSError as e:
+    raise ImportError(f"native library unavailable: {e}") from None
 
 _lib.df_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32]
 _lib.df_crc32c.restype = ctypes.c_uint32
@@ -119,6 +129,50 @@ _lib.df_upload_counters.restype = None
 
 _lib.df_upload_stop.argtypes = [ctypes.c_int64]
 _lib.df_upload_stop.restype = None
+
+_lib.df_chunk_scan.argtypes = [
+    ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_int32,
+    ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint32), ctypes.c_uint64,
+    ctypes.POINTER(ctypes.c_uint64),
+]
+_lib.df_chunk_scan.restype = ctypes.c_int64
+
+_lib.df_ring_create.argtypes = [ctypes.c_uint32]
+_lib.df_ring_create.restype = ctypes.c_int64
+
+_lib.df_ring_depth.argtypes = [ctypes.c_int64]
+_lib.df_ring_depth.restype = ctypes.c_int
+
+_lib.df_ring_read_batch.argtypes = [
+    ctypes.c_int64, ctypes.c_int, ctypes.c_uint64,
+    ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+    ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+]
+_lib.df_ring_read_batch.restype = ctypes.c_int64
+
+_lib.df_ring_write_batch.argtypes = [
+    ctypes.c_int64, ctypes.c_int, ctypes.c_uint64,
+    ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+    ctypes.POINTER(ctypes.c_void_p),
+]
+_lib.df_ring_write_batch.restype = ctypes.c_int64
+
+_lib.df_ring_close.argtypes = [ctypes.c_int64]
+_lib.df_ring_close.restype = None
+
+_lib.df_batch_read.argtypes = [
+    ctypes.c_int, ctypes.c_uint64,
+    ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+    ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+]
+_lib.df_batch_read.restype = ctypes.c_int64
+
+_lib.df_batch_write.argtypes = [
+    ctypes.c_int, ctypes.c_uint64,
+    ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+    ctypes.POINTER(ctypes.c_void_p),
+]
+_lib.df_batch_write.restype = ctypes.c_int64
 
 
 def _as_char_buf(data):
@@ -347,3 +401,166 @@ def upload_stop(handle: int) -> None:
     """Must be the handle owner's LAST call, never concurrent with another
     call on the same handle (see module HANDLE OWNERSHIP CONTRACT)."""
     _lib.df_upload_stop(handle)
+
+
+# -- native gear-CDC candidate scanner (src/dfchunk.cc) ----------------------
+
+_CHUNK_OUT_CAP = 65536
+_CHUNK_WINDOW = 32
+
+
+def chunk_scan(region, gear: bytes, mask_bits: int, ctx: int) -> list:
+    """Candidate cut positions in ``region`` (any bytes-like): indices of
+    bytes whose gear hash has its top ``mask_bits`` zero, skipping the first
+    ``ctx`` context bytes. ``gear`` is the 256-entry uint32 table as
+    little-endian bytes (delta/chunker owns its derivation). Matches
+    delta/chunker._window_hashes bit for bit, including partial windows at
+    region start; loops internally when the candidate buffer fills."""
+    mv = region if isinstance(region, memoryview) else memoryview(region)
+    total = mv.nbytes
+    out = (ctypes.c_uint32 * _CHUNK_OUT_CAP)()
+    consumed = ctypes.c_uint64(0)
+    results: list[int] = []
+    base = 0          # offset of the slice passed to C within region
+    cur_ctx = ctx
+    while True:
+        buf, n = _as_char_buf(mv[base:] if base else mv)
+        rc = _lib.df_chunk_scan(buf, n, gear, mask_bits, cur_ctx, out,
+                                _CHUNK_OUT_CAP, ctypes.byref(consumed))
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+        results.extend(base + out[i] for i in range(rc))
+        done = base + consumed.value
+        if done >= total:
+            return results
+        # Candidate buffer filled: resume from `done` with a fresh
+        # WINDOW-1-byte context replay (hashes only look back 32 bytes).
+        start = done - min(done, _CHUNK_WINDOW - 1)
+        cur_ctx = done - start
+        base = start
+
+
+# -- batched-IO submission ring (src/dfring.cc) ------------------------------
+
+RING_E_SHORT_READ = -200101
+
+
+class RingShortRead(OSError):
+    """A ring read hit EOF inside a requested span (same condition the
+    serial read path reports as a StorageError short read)."""
+
+    def __init__(self):
+        super().__init__(5, "ring read: EOF inside requested span")
+
+
+def ring_create(entries: int = 64) -> int:
+    """Create an io_uring submission ring; returns a handle. Raises OSError
+    (commonly ENOSYS/EPERM) when the kernel refuses io_uring — callers fall
+    back down the ladder."""
+    h = _lib.df_ring_create(entries)
+    if h < 0:
+        raise OSError(-h, os.strerror(-h))
+    return h
+
+
+def ring_depth(handle: int) -> int:
+    return _lib.df_ring_depth(handle)
+
+
+def _u64s(values) -> "_array.array":
+    """A uint64 array ctypes can pass where POINTER(c_uint64) is declared
+    (via from_buffer, no copy) — ~4x cheaper to build than a ctypes array
+    for the span-table sizes the submission ring sends per batch."""
+    return _array.array("Q", values)
+
+
+def _u64_arg(arr: "_array.array"):
+    return (ctypes.c_uint64 * len(arr)).from_buffer(arr)
+
+
+def _marshal_read(spans, buf, buf_offsets):
+    n = len(spans)
+    mv = buf if isinstance(buf, memoryview) else memoryview(buf)
+    arr = (ctypes.c_char * mv.nbytes).from_buffer(mv)
+    offs = _u64_arg(_u64s(o for o, _ in spans))
+    lens = _u64_arg(_u64s(ln for _, ln in spans))
+    boffs = _u64_arg(_u64s(buf_offsets))
+    return n, offs, lens, arr, boffs
+
+
+def _check_read_rc(rc: int) -> int:
+    if rc == RING_E_SHORT_READ:
+        raise RingShortRead()
+    if rc < 0:
+        raise OSError(-rc, os.strerror(-rc))
+    return rc
+
+
+def _marshal_write(chunks, offsets):
+    n = len(chunks)
+    # Keep the ctypes views alive for the call's duration.
+    kept = [_as_char_buf(c) for c in chunks]
+    ptrs = (ctypes.c_void_p * n)()
+    lens = (ctypes.c_uint64 * n)()
+    for i, (cb, ln) in enumerate(kept):
+        if isinstance(cb, bytes):
+            ptrs[i] = ctypes.cast(ctypes.c_char_p(cb), ctypes.c_void_p)
+        else:
+            ptrs[i] = ctypes.cast(cb, ctypes.c_void_p)
+        lens[i] = ln
+    offs = _u64_arg(_u64s(offsets))
+    return n, offs, lens, ptrs, kept
+
+
+def ring_read_batch(handle: int, fd: int, spans, buf, buf_offsets) -> int:
+    """Read ``spans`` ([(offset, length), ...]) of ``fd`` into the writable
+    buffer ``buf`` at ``buf_offsets`` with one submission per wave. Returns
+    total bytes; raises RingShortRead on EOF inside a span, OSError on IO
+    errors. The destination views stay caller-owned (pooled-buffer
+    discipline: bytes land in place, nothing is allocated here)."""
+    if not spans:
+        return 0
+    n, offs, lens, arr, boffs = _marshal_read(spans, buf, buf_offsets)
+    return _check_read_rc(
+        _lib.df_ring_read_batch(handle, fd, n, offs, lens, arr, boffs))
+
+
+def batch_read(fd: int, spans, buf, buf_offsets) -> int:
+    """Same contract as ring_read_batch, but completion is the stateless
+    syscall loop in C (df_batch_read) — no ring handle. Fast path for
+    page-cache-hot stores (see dfring.cc header)."""
+    if not spans:
+        return 0
+    n, offs, lens, arr, boffs = _marshal_read(spans, buf, buf_offsets)
+    return _check_read_rc(_lib.df_batch_read(fd, n, offs, lens, arr, boffs))
+
+
+def ring_write_batch(handle: int, fd: int, chunks, offsets) -> int:
+    """Write each bytes-like in ``chunks`` at its offset in ``fd`` with one
+    submission per wave; returns total bytes written. ``offsets`` is one
+    file offset per chunk."""
+    if not len(chunks):
+        return 0
+    n, offs, lens, ptrs, _kept = _marshal_write(chunks, offsets)
+    rc = _lib.df_ring_write_batch(handle, fd, n, offs, lens, ptrs)
+    if rc < 0:
+        raise OSError(-rc, os.strerror(-rc))
+    return rc
+
+
+def batch_write(fd: int, chunks, offsets) -> int:
+    """Same contract as ring_write_batch via the stateless syscall loop
+    (df_batch_write) — no ring handle."""
+    if not len(chunks):
+        return 0
+    n, offs, lens, ptrs, _kept = _marshal_write(chunks, offsets)
+    rc = _lib.df_batch_write(fd, n, offs, lens, ptrs)
+    if rc < 0:
+        raise OSError(-rc, os.strerror(-rc))
+    return rc
+
+
+def ring_close(handle: int) -> None:
+    """Must be the handle owner's LAST call, never concurrent with another
+    call on the same handle (see module HANDLE OWNERSHIP CONTRACT)."""
+    _lib.df_ring_close(handle)

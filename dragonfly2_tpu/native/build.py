@@ -4,6 +4,13 @@ Invoked lazily by binding.py on first import (result cached on disk next to
 the source), or explicitly: ``python -m dragonfly2_tpu.native.build``.
 A single translation unit keeps this a one-command build — no cmake needed,
 though the toolchain would support it.
+
+Boxes without a C++ toolchain degrade, never crash: ``build()`` raises
+``BuildUnavailable`` with a one-line reason, binding.py converts that into
+a clean ImportError, and every caller's backend ladder (pkg/digest,
+delta/chunker, storage/io_ring) falls through to Python. The CLI prints
+the skip reason and exits 0 for the same reason — a missing g++ is a
+degraded mode, not an error.
 """
 
 from __future__ import annotations
@@ -13,8 +20,20 @@ import subprocess
 import tempfile
 
 _SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
-_LIB_DIR = os.path.join(os.path.dirname(__file__), "_lib")
+# Overridable so tests can point at an empty cache dir and exercise the
+# no-toolchain path without touching the real build product.
+_LIB_DIR = os.environ.get("DF_NATIVE_LIB_DIR") or os.path.join(
+    os.path.dirname(__file__), "_lib")
 LIB_PATH = os.path.join(_LIB_DIR, "libdfnative.so")
+
+
+class BuildUnavailable(RuntimeError):
+    """The native library cannot be produced on this box; ``reason`` is a
+    single line suitable for a skip message."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
 
 
 def _sources() -> list[str]:
@@ -28,9 +47,16 @@ def needs_build() -> bool:
     return any(os.path.getmtime(s) > lib_mtime for s in _sources())
 
 
+def clean() -> None:
+    """Drop the cached build product (next import rebuilds or degrades)."""
+    if os.path.exists(LIB_PATH):
+        os.unlink(LIB_PATH)
+
+
 def build(quiet: bool = True) -> str:
     """Compile the shared library; atomic rename so concurrent builders are
-    safe. Raises CalledProcessError / FileNotFoundError when no toolchain."""
+    safe. Raises BuildUnavailable (one-line reason) when the toolchain is
+    missing or the compile fails."""
     os.makedirs(_LIB_DIR, exist_ok=True)
     if not needs_build():
         return LIB_PATH
@@ -45,8 +71,18 @@ def build(quiet: bool = True) -> str:
     try:
         subprocess.run(cmd, check=True,
                        stdout=subprocess.DEVNULL if quiet else None,
-                       stderr=subprocess.PIPE if quiet else None)
+                       stderr=subprocess.PIPE)
         os.replace(tmp, LIB_PATH)
+    except FileNotFoundError:
+        raise BuildUnavailable("no C++ toolchain (g++ not found)") from None
+    except subprocess.CalledProcessError as e:
+        err = (e.stderr or b"").decode(errors="replace").strip()
+        if not quiet and err:
+            import sys
+
+            print(err, file=sys.stderr)
+        detail = err.splitlines()[0] if err else f"exit {e.returncode}"
+        raise BuildUnavailable(f"g++ failed: {detail}") from None
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
@@ -54,4 +90,7 @@ def build(quiet: bool = True) -> str:
 
 
 if __name__ == "__main__":
-    print(build(quiet=False))
+    try:
+        print(build(quiet=False))
+    except BuildUnavailable as e:
+        print(f"skipping native build: {e.reason}")

@@ -16,11 +16,13 @@ import shutil
 import threading
 import time
 from dataclasses import asdict, dataclass, field
+from itertools import accumulate
 
 from dragonfly2_tpu.pkg import digest as pkgdigest
 from dragonfly2_tpu.pkg.bufpool import BufferPool
 from dragonfly2_tpu.pkg.errors import Code, StorageError
 from dragonfly2_tpu.pkg.piece import compute_piece_count
+from dragonfly2_tpu.storage import io_ring
 
 DATA_FILE = "data"
 METADATA_FILE = "metadata.json"
@@ -536,6 +538,20 @@ class LocalTaskStore:
                         num: int) -> None:
         views = [c if isinstance(c, memoryview) else memoryview(c)
                  for c in chunks if len(c)]
+        if len(views) > 1:
+            ring = io_ring.get_ring()
+            if ring.backend in ("batch", "io_uring"):
+                # One submission for the whole chunk list (the serial
+                # pwritev was already one syscall when it didn't split;
+                # the ring keeps that true for arbitrarily many chunks
+                # and absorbs partial writes natively).
+                offsets = []
+                at = offset
+                for v in views:
+                    offsets.append(at)
+                    at += len(v)
+                ring.write_chunks(fd, views, offsets)
+                return
         written = 0
         while views:
             n = os.pwritev(fd, views, offset + written)
@@ -790,16 +806,33 @@ class LocalTaskStore:
 
     def read_spans_into(self, spans, buf) -> int:
         """Pack the byte spans ``[(offset, length), ...]`` back to back into
-        ``buf``; returns the total byte count. Spans may be disjoint (each
-        is one preadv run); a short read anywhere raises StorageError with
-        nothing partial hidden. This is the batched-submission primitive:
-        adjacent landed pieces coalesce into one span before submission
-        instead of one pread per piece."""
-        total = sum(length for _, length in spans)
+        ``buf``; returns the total byte count. Spans may be disjoint; a
+        short read anywhere raises StorageError with nothing partial
+        hidden. This is the batched-submission primitive: a multi-span
+        batch goes to the submission ring (storage/io_ring.py) as ONE
+        submission — a native syscall batch (or io_uring / thread-pooled
+        preadv, per the ring's ladder) — and bytes still land directly in
+        the caller's (pooled) buffer, exactly as the serial loop landed
+        them."""
+        spans = list(spans)
+        # One pass yields both the packing offsets and (as the final
+        # accumulated value) the total byte count.
+        buf_offsets = list(accumulate((ln for _, ln in spans), initial=0))
+        total = buf_offsets.pop()
         mv = buf if isinstance(buf, memoryview) else memoryview(buf)
         if total > len(mv):
             raise StorageError(
                 f"read buffer too small: need {total}, have {len(mv)}")
+        if len(spans) > 1:
+            ring = io_ring.get_ring()
+            if ring.backend != "serial":
+                try:
+                    ring.read_spans(self._ensure_fd(), spans, mv,
+                                    buf_offsets)
+                except io_ring.ShortReadError as e:
+                    raise StorageError(str(e)) from None
+                self.touch()
+                return total
         at = 0
         for offset, length in spans:
             self.read_into(offset, length, mv, at=at)

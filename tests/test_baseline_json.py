@@ -210,6 +210,51 @@ def test_ingest_micro_serve_round_paired_shape():
     assert serve["gain_frac"] >= 0.15, serve
 
 
+def test_ingest_micro_serve_spans_paired_shape():
+    """The multi-span serve round is a PAIRED run through the same store
+    API: submission ring on vs ring off (serial), order-alternating
+    rounds, headline = median of per-round on/off ratios (the config9
+    estimator). Acceptance: the ring is >=10% faster on the many-small-
+    spans shape, and both arms landed byte-identical data."""
+    entry = _load()["published"]["ingest_micro"]
+    spans = entry["serve_spans"]
+    assert spans["bytes_identical"] is True
+    assert spans["ring_backend"] in ("batch", "io_uring", "threads")
+    assert spans["spans_per_batch"] >= 16 and spans["span_kib"] > 0
+    assert spans["on_mbps"] > 0 and spans["off_mbps"] > 0
+    ratios = spans["pair_ratios"]
+    assert len(ratios) == spans["rounds"] >= 4
+    assert len(spans["on_runs_mbps"]) == len(spans["off_runs_mbps"]) == \
+        len(ratios), "unpaired span-serve runs"
+    ordered = sorted(ratios)
+    mid = len(ordered) // 2
+    median = (ordered[mid - 1] + ordered[mid]) / 2 \
+        if len(ordered) % 2 == 0 else ordered[mid]
+    assert spans["ratio_median"] == pytest.approx(median, abs=1e-3)
+    assert spans["ratio_median"] >= 1.10, spans
+
+
+def test_ingest_micro_chunker_round():
+    """The CDC scan round: the native dfchunk.cc kernel against the numpy
+    scanner over the same bytes. Acceptance on the publishing box: native
+    scan >=1 GB/s and >=10x numpy, with byte-identical cut points (both
+    the emitted chunk sequence and the raw scan candidates). End-to-end
+    chunking (sha256-bound) is recorded alongside so the scan number
+    can't masquerade as the pipeline number."""
+    entry = _load()["published"]["ingest_micro"]
+    ch = entry["chunker"]
+    assert ch["cut_points_equal"] is True
+    assert ch["scan"]["numpy_mbps"] > 0
+    assert ch["chunk"]["numpy_mbps"] > 0
+    if ch["backend"] == "native":
+        assert ch["scan"]["native_mbps"] >= 1000.0, ch["scan"]
+        assert ch["scan"]["speedup"] >= 10.0, ch["scan"]
+        assert ch["chunk"]["native_mbps"] > ch["chunk"]["numpy_mbps"], ch
+    else:
+        # The published baseline comes from a box with the toolchain.
+        pytest.fail(f"published chunker round lacks native backend: {ch}")
+
+
 def test_ingest_micro_hash_fallback_round():
     """The CPU crc32c fallback is itself competitive: the selected
     non-native backend must beat the old pure-Python table composition by
@@ -275,6 +320,11 @@ def test_delta_entry_paired_shape():
     assert delta["chunks_fetched"] > 0 and delta["chunks_reused"] > 0
     assert entry["chunking"]["chunks"] == \
         delta["chunks_fetched"] + delta["chunks_reused"]
+    # The published manifest build ran on a real backend, named for the
+    # record (the box with the toolchain publishes native).
+    assert entry["chunking"]["chunker_backend"] in \
+        ("native", "numpy", "python")
+    assert entry["chunking"]["chunk_mb_s"] > 0
 
 
 def test_stripe_sim_meets_acceptance_bounds():

@@ -1,0 +1,192 @@
+"""Submission-ring backends: byte-equivalence, failure shapes, metrics.
+
+storage/io_ring.py promises every rung of its ladder (batch native
+syscall loop, io_uring, thread pool, serial) lands identical bytes with
+identical failure semantics behind LocalTaskStore's unchanged API. The
+benches only prove the fast rung is fast; this suite proves no rung can
+drift.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+
+import pytest
+
+from dragonfly2_tpu.pkg.errors import StorageError
+from dragonfly2_tpu.storage import io_ring
+from dragonfly2_tpu.storage.local_store import LocalTaskStore, TaskStoreMetadata
+
+
+def _available_rings():
+    """Every backend constructible on this box. serial/threads always
+    exist; batch and io_uring join when the native library (and kernel)
+    allow."""
+    rings = [io_ring.SubmissionRing("serial"),
+             io_ring.SubmissionRing("threads")]
+    batch = io_ring._probe_batch()
+    if batch is not None:
+        rings.append(batch)
+    uring = io_ring._probe_io_uring()
+    if uring is not None:
+        rings.append(uring)
+    return rings
+
+
+@pytest.fixture
+def data_fd(tmp_path):
+    data = random.Random(21).randbytes(2 << 20)
+    path = tmp_path / "blob"
+    path.write_bytes(data)
+    fd = os.open(path, os.O_RDWR)
+    yield fd, data
+    os.close(fd)
+
+
+@pytest.fixture(autouse=True)
+def restore_singleton():
+    prev = io_ring.swap_ring(None)
+    yield
+    io_ring.swap_ring(prev)
+
+
+def test_read_spans_byte_identical_across_backends(data_fd):
+    fd, data = data_fd
+    rng = random.Random(22)
+    spans = [(rng.randrange(len(data) - 9000), rng.randrange(1, 9000))
+             for _ in range(40)]
+    total = sum(ln for _, ln in spans)
+    offsets, at = [], 0
+    for _, ln in spans:
+        offsets.append(at)
+        at += ln
+    expected = b"".join(data[o:o + ln] for o, ln in spans)
+    for ring in _available_rings():
+        buf = bytearray(total)
+        got = ring.read_spans(fd, spans, buf, offsets)
+        assert got == total, ring.backend
+        assert bytes(buf) == expected, f"{ring.backend} corrupted bytes"
+        ring.close()
+
+
+def test_read_spans_batch_larger_than_ring_depth(data_fd):
+    # io_uring submits in waves of sq_entries; batches longer than the
+    # ring depth must still complete (and every other rung trivially so).
+    fd, data = data_fd
+    n = io_ring._DEPTH * 2 + 7
+    spans = [((i * 997) % (len(data) - 512), 512) for i in range(n)]
+    offsets = [i * 512 for i in range(n)]
+    expected = b"".join(data[o:o + 512] for o, _ in spans)
+    for ring in _available_rings():
+        buf = bytearray(n * 512)
+        ring.read_spans(fd, spans, buf, offsets)
+        assert bytes(buf) == expected, ring.backend
+        ring.close()
+
+
+def test_zero_length_spans_skipped(data_fd):
+    fd, data = data_fd
+    spans = [(0, 100), (500, 0), (1000, 50)]
+    offsets = [0, 100, 100]
+    for ring in _available_rings():
+        buf = bytearray(150)
+        got = ring.read_spans(fd, spans, buf, offsets)
+        assert got == 150
+        assert bytes(buf) == data[:100] + data[1000:1050], ring.backend
+        ring.close()
+
+
+def test_short_read_same_error_every_backend(data_fd):
+    fd, data = data_fd
+    spans = [(0, 1024), (len(data) - 100, 1024)]   # second runs past EOF
+    offsets = [0, 1024]
+    for ring in _available_rings():
+        buf = bytearray(2048)
+        with pytest.raises(io_ring.ShortReadError):
+            ring.read_spans(fd, spans, buf, offsets)
+        ring.close()
+
+
+def test_write_chunks_byte_identical_across_backends(tmp_path):
+    chunks = [random.Random(23 + i).randbytes(random.Random(i).randrange(1, 5000))
+              for i in range(30)]
+    offsets, at = [], 0
+    for c in chunks:
+        offsets.append(at)
+        at += len(c)
+    expected = b"".join(chunks)
+    for ring in _available_rings():
+        path = tmp_path / f"w-{ring.backend}"
+        fd = os.open(path, os.O_RDWR | os.O_CREAT)
+        try:
+            total = ring.write_chunks(fd, chunks, offsets)
+            assert total == len(expected)
+            assert path.read_bytes() == expected, ring.backend
+        finally:
+            os.close(fd)
+            ring.close()
+
+
+def test_store_read_spans_translates_short_read(tmp_path):
+    store = LocalTaskStore(
+        str(tmp_path / "s"),
+        TaskStoreMetadata(task_id="ring-t", piece_size=1 << 16))
+    with open(os.path.join(str(tmp_path / "s"), "data"), "wb") as f:
+        f.write(b"x" * 4096)
+    buf = bytearray(8192)
+    # Multi-span batches route through the ring; a span past EOF must be
+    # the same StorageError the serial path raises.
+    with pytest.raises(StorageError):
+        store.read_spans_into([(0, 1024), (3800, 1024)], buf)
+
+
+def test_store_read_spans_matches_serial(tmp_path):
+    data = random.Random(29).randbytes(1 << 20)
+    store = LocalTaskStore(
+        str(tmp_path / "s"),
+        TaskStoreMetadata(task_id="ring-t", piece_size=1 << 18))
+    with open(os.path.join(str(tmp_path / "s"), "data"), "wb") as f:
+        f.write(data)
+    rng = random.Random(31)
+    spans = [(rng.randrange(len(data) - 8192), rng.randrange(1, 8192))
+             for _ in range(25)]
+    total = sum(ln for _, ln in spans)
+    ref = bytearray(total)
+    io_ring.swap_ring(io_ring.SubmissionRing("serial"))
+    store.read_spans_into(spans, ref)
+    for ring in _available_rings():
+        io_ring.swap_ring(ring)
+        buf = bytearray(total)
+        got = store.read_spans_into(spans, buf)
+        assert got == total
+        assert buf == ref, f"{ring.backend} diverged from serial store path"
+
+
+def test_ring_metrics_flow(data_fd):
+    fd, data = data_fd
+    ring = io_ring.get_ring()
+    sub = io_ring.RING_SUBMISSIONS.labels(ring.backend)
+    spans = io_ring.RING_SPANS.labels("read")
+    sub0, spans0 = sub._value.get(), spans._value.get()
+    buf = bytearray(2048)
+    ring.read_spans(fd, [(0, 1024), (4096, 1024)], buf, [0, 1024])
+    assert sub._value.get() == sub0 + 1
+    assert spans._value.get() == spans0 + 2
+
+
+def test_env_pins_rung(monkeypatch):
+    monkeypatch.setenv("DF_RING_BACKEND", "serial")
+    assert io_ring._select_ring().backend == "serial"
+    monkeypatch.setenv("DF_RING_BACKEND", "off")
+    assert io_ring._select_ring().backend == "serial"
+    monkeypatch.setenv("DF_RING_BACKEND", "threads")
+    assert io_ring._select_ring().backend == "threads"
+    monkeypatch.delenv("DF_RING_BACKEND")
+    auto = io_ring._select_ring()
+    assert auto.backend in ("batch", "threads")
+    auto.close()
+    monkeypatch.setenv("DF_RING_BACKEND", "io_uring")
+    pinned = io_ring._select_ring()
+    assert pinned.backend in ("io_uring", "threads")   # threads = degrade
+    pinned.close()

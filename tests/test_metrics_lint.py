@@ -36,8 +36,10 @@ METRIC_MODULES = (
     "dragonfly2_tpu.daemon.peer.task_manager",
     "dragonfly2_tpu.daemon.peer.device_sink",
     "dragonfly2_tpu.scheduler.service",
+    "dragonfly2_tpu.delta.chunker",
     "dragonfly2_tpu.delta.manifest",
     "dragonfly2_tpu.delta.resolver",
+    "dragonfly2_tpu.storage.io_ring",
     "dragonfly2_tpu.dataset.loader",
     "dragonfly2_tpu.dataset.shard_reader",
     "dragonfly2_tpu.dataset.tar_index",
@@ -46,9 +48,9 @@ METRIC_MODULES = (
 
 # The documented component vocabulary (docs/OBSERVABILITY.md "Metric
 # families"). Adding a component means documenting it there first.
-COMPONENTS = ("bufpool", "chaos", "dataset", "device_sink", "fleet",
-              "objectstorage", "peer", "proxy", "scheduler", "tracing",
-              "upload")
+COMPONENTS = ("bufpool", "chaos", "dataset", "delta", "device_sink",
+              "fleet", "objectstorage", "peer", "proxy", "scheduler",
+              "storage", "tracing", "upload")
 
 # Histogram families must name their unit; counters use _total; gauges
 # may end in a unit but never _total.

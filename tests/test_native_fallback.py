@@ -1,0 +1,83 @@
+"""A box with no C++ toolchain and no prebuilt library must degrade,
+never crash: importing dragonfly2_tpu.native.binding raises a clean
+ImportError (nothing else — no OSError, no BuildUnavailable leaking),
+and every backend ladder that prefers the native library (pkg/digest,
+delta/chunker, storage/io_ring) falls through and still works.
+
+Run in a subprocess so the simulated bare box (empty PATH, empty native
+lib cache dir) can't poison this process's already-imported binding.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PROBE = r"""
+import sys
+
+try:
+    from dragonfly2_tpu.native import binding          # noqa: F401
+except ImportError as e:
+    msg = str(e)
+    assert "native library unavailable" in msg, f"opaque reason: {msg!r}"
+except BaseException as e:                             # noqa: BLE001
+    sys.exit(f"import raised {type(e).__name__}, not ImportError: {e}")
+else:
+    sys.exit("binding imported despite no toolchain and empty lib dir")
+
+from dragonfly2_tpu.pkg import digest
+assert digest.crc32c(b"123456789") == 0xE3069283, "digest ladder broke"
+
+from dragonfly2_tpu.delta import chunker
+backend = chunker.chunker_backend()
+assert backend in ("numpy", "python"), backend
+chunks = chunker.chunk_bytes(
+    b"q" * 300_000,
+    chunker.CDCParams(mask_bits=10, min_size=2048, max_size=16384))
+assert sum(c.length for c in chunks) == 300_000
+
+from dragonfly2_tpu.storage import io_ring
+ring = io_ring.ring_backend()
+assert ring in ("threads", "serial"), ring
+
+print("FALLBACK-OK", backend, ring)
+"""
+
+
+def test_binding_import_fails_clean_and_ladders_degrade(tmp_path):
+    env = {
+        # Empty PATH: g++ can't be found, so build() must raise
+        # BuildUnavailable -> binding converts to ImportError.
+        "PATH": "",
+        # Empty cache dir: no prebuilt libdfnative.so to fall back on.
+        "DF_NATIVE_LIB_DIR": str(tmp_path / "empty-lib"),
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        "HOME": os.environ.get("HOME", "/tmp"),
+    }
+    proc = subprocess.run(
+        [sys.executable, "-c", _PROBE],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert proc.returncode == 0, (
+        f"bare-box probe failed\nstdout: {proc.stdout}\nstderr: {proc.stderr}")
+    assert "FALLBACK-OK" in proc.stdout
+
+
+def test_build_cli_skips_gracefully_without_toolchain(tmp_path):
+    env = {
+        "PATH": "",
+        "DF_NATIVE_LIB_DIR": str(tmp_path / "empty-lib"),
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        "HOME": os.environ.get("HOME", "/tmp"),
+    }
+    proc = subprocess.run(
+        [sys.executable, "-m", "dragonfly2_tpu.native.build"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+    assert "skipping native build" in proc.stdout
+    assert "g++ not found" in proc.stdout
