@@ -99,7 +99,7 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
                   gc_ttl_s: float = 1.0, fleet: bool = True,
                   report_batch: int = 1, podlens: bool = False,
                   ship_digests: "bool | None" = None,
-                  restart: bool = False) -> dict:
+                  restart: bool = False, prof: bool = False) -> dict:
     """``churn=True`` kills whole slices mid-fan-out (their peers' streams
     drop after a few pieces, no finish) and sends straggler waves into the
     SAME slices late — ``churn_waves`` slices die at staggered times, so
@@ -389,6 +389,16 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
 
     gc.collect()
     gc.freeze()
+    # ``prof=True`` is prof_bench's paired treatment arm: the full runtime
+    # observatory (sampler thread + loop-lag probe + GC callbacks) armed
+    # for the storm, so its CPU cost lands inside the cpu_s window below.
+    prof_obs = prof_probe = None
+    prof_stats = None
+    if prof:
+        from dragonfly2_tpu.pkg import prof as proflib
+
+        prof_obs = proflib.install()
+        prof_probe = prof_obs.arm_loop("sim")
     hb = asyncio.ensure_future(heartbeat())
     t0 = time.perf_counter()
     cpu0 = time.process_time()
@@ -458,6 +468,16 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
     finally:
         hb.cancel()
         gc.unfreeze()
+        if prof_obs is not None:
+            from dragonfly2_tpu.pkg import prof as proflib
+
+            smp = prof_obs.sampler
+            prof_stats = {"samples": smp.samples, "nodes": smp.nodes,
+                          "truncated": smp.truncated,
+                          "loop_slow_ticks": prof_probe.slow_ticks}
+            prof_probe.disarm()
+            prof_obs.probes.pop(prof_probe.name, None)
+            proflib.release(prof_obs)
         if snapshot_path:
             try:
                 os.unlink(snapshot_path)
@@ -563,6 +583,8 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
         "fleet": fleet_stats,
         "podlens_enabled": podlens,
         "podlens": podlens_stats,
+        "prof_enabled": prof,
+        "prof": prof_stats,
         "restart_enabled": restart,
         "restart": {
             "rebuild_s": round(max(0.0, restart_info["rebuild_done_at"]

@@ -1,0 +1,231 @@
+"""BASELINE config #12: runtime-observatory (pkg/prof) overhead.
+
+The runtime observatory is ALWAYS ON in every role — sampler thread at
+``hz``, gc.callbacks pause clock, a heartbeat per probed loop — so, like
+the flight recorder (config8), fleet observatory (config9) and pod lens
+(config10), its cost must be provably negligible. Two paired rounds,
+both order-alternating with the PR-7 estimator (median of adjacent
+paired CPU ratios; per-side aggregates are biased under this box's
+monotonic drift):
+
+  1. ``ingest`` — the scheduler-side hot path under the microscope: the
+     shipped-digest storm through the real ``_note_shipped_flight``
+     ingest (podlens_bench round 2's workload), with the observatory
+     installed (sampler + GC callbacks live) vs not. The sampler walks
+     every live thread 19x/s while the storm runs; its cost lands in
+     ``time.process_time`` (process-wide CPU) either way.
+  2. ``churn_sim`` — the REAL yardstick: the 1024-host DES churn sim
+     (config5 machinery) with the FULL observatory armed inside the
+     measured window (``run_sim(prof=True)`` installs the sampler + GC
+     clock and arms a loop-lag probe on the sim loop) vs off.
+
+Acceptance budget: <= 3% on BOTH rounds (tests/test_baseline_json.py
+re-derives the medians and holds the budget).
+
+Usage:
+  python benchmarks/prof_bench.py [--hosts 1024] [--rounds 4]
+                                  [--quick] [--publish]
+
+Publishes BASELINE.json["published"]["config12_prof"].
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from dragonfly2_tpu.pkg import flight as fl  # noqa: E402
+from dragonfly2_tpu.pkg import prof as proflib  # noqa: E402
+from dragonfly2_tpu.scheduler.config import SchedulerConfig  # noqa: E402
+from dragonfly2_tpu.scheduler.service import SchedulerService  # noqa: E402
+
+from benchmarks.pod_sim_bench import (  # noqa: E402
+    check_churn_behavior,
+    run_sim,
+)
+from benchmarks.podlens_bench import _shape_flight  # noqa: E402
+
+
+def _median(vals: list) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2.0
+
+
+# --------------------------------------------------------------------- #
+# Round 1: ingest storm, observatory installed vs not
+# --------------------------------------------------------------------- #
+
+def _ingest_pass(prof_on: bool, tasks: int, hosts: int, d: dict) -> float:
+    """One measured storm. The pod lens stays ON in both modes (it is
+    the production configuration and a constant here); the toggle is the
+    observatory — installed before the clock starts, released after it
+    stops, so setup/teardown stay out of the window while the sampler's
+    steady-state burn lands inside it."""
+    obs = None
+    if prof_on:
+        obs = proflib.install()
+    try:
+        cfg = SchedulerConfig()
+        svc = SchedulerService(cfg)
+        mk = lambda i: {  # noqa: E731
+            "host": {"id": f"h{i}", "hostname": f"h{i}", "ip": "10.0.0.1",
+                     "port": 1, "upload_port": 2},
+            "peer_id": f"p{i}", "task_id": "bench-task", "url": "http://o/f"}
+        peers = [svc._resolve(mk(i))[2] for i in range(hosts)]
+        task = svc.tasks.load("bench-task")
+        msg = {"type": "download_finished", "flight": d}
+        t0 = time.process_time()
+        for i in range(tasks):
+            svc._note_shipped_flight(msg, task, peers[i % hosts])
+        return time.process_time() - t0
+    finally:
+        if obs is not None:
+            proflib.release(obs)
+
+
+def run_ingest_paired(rounds: int, tasks: int = 16384,
+                      hosts: int = 256) -> dict:
+    """``tasks`` sizes the measured window: at 4096 the storm runs
+    ~50 ms and one cyclic-GC pass landing on either side swamps the
+    ratio; 16384 gives the sampler a dozen passes inside the window and
+    the pair ratio a denominator the noise can't flip."""
+    tf = _shape_flight(16)
+    now = fl.anchored_wall()
+    d = fl.digest(tf, clock_samples=[(now - 0.002, now, now - 0.001)])
+    if rounds % 2:
+        rounds += 1               # even rounds: each side leads equally
+    on, off, ratios = [], [], []
+    _ingest_pass(True, tasks, hosts, d)     # warm-up discarded
+    for i in range(rounds):
+        first = bool(i % 2)
+        a = _ingest_pass(first, tasks, hosts, d)
+        b = _ingest_pass(not first, tasks, hosts, d)
+        t_on, t_off = (a, b) if first else (b, a)
+        on.append(t_on)
+        off.append(t_off)
+        ratios.append(t_on / max(t_off, 1e-9))
+    return {
+        "tasks": tasks,
+        "hosts": hosts,
+        "rounds": rounds,
+        "on_us_per_task": round(min(on) / tasks * 1e6, 2),
+        "off_us_per_task": round(min(off) / tasks * 1e6, 2),
+        "runs_cpu_s": {"on": [round(v, 4) for v in sorted(on)],
+                       "off": [round(v, 4) for v in sorted(off)]},
+        "pair_ratios": [round(r, 4) for r in ratios],
+        "cpu_overhead_frac": round(_median(ratios) - 1.0, 4),
+    }
+
+
+# --------------------------------------------------------------------- #
+# Round 2: paired DES churn sim (the acceptance budget)
+# --------------------------------------------------------------------- #
+
+def _sim_pass(hosts: int, prof_on: bool) -> dict:
+    result = asyncio.run(run_sim(
+        hosts, churn=True, churn_waves=3, report_batch=8, prof=prof_on))
+    check_churn_behavior(result)
+    return {
+        "wall_s": result["wall_s"],
+        "cpu_s": result["cpu_s"],
+        "rss_peak_mb": result["rss_peak_mb"],
+        "max_loop_lag_ms": result["max_loop_lag_ms"],
+        "prof": result["prof"],
+    }
+
+
+def run_churn_paired(hosts: int, rounds: int) -> dict:
+    on, off, ratios = [], [], []
+    _sim_pass(hosts, True)        # warm-up discarded
+    if rounds % 2:
+        rounds += 1               # even rounds: each side leads equally
+    for i in range(rounds):
+        first = bool(i % 2)
+        a = _sim_pass(hosts, first)
+        b = _sim_pass(hosts, not first)
+        r_on, r_off = (a, b) if first else (b, a)
+        on.append(r_on)
+        off.append(r_off)
+        ratios.append(r_on["cpu_s"] / r_off["cpu_s"])
+    on.sort(key=lambda r: r["cpu_s"])
+    off.sort(key=lambda r: r["cpu_s"])
+    prof_stats = on[0]["prof"] or {}
+    return {
+        "hosts": hosts,
+        "rounds": rounds,
+        "on": {k: v for k, v in on[0].items() if k != "prof"},
+        "off": {k: v for k, v in off[0].items() if k != "prof"},
+        "runs_cpu_s": {"on": [r["cpu_s"] for r in on],
+                       "off": [r["cpu_s"] for r in off]},
+        "pair_ratios": [round(r, 4) for r in ratios],
+        "cpu_overhead_frac": round(_median(ratios) - 1.0, 4),
+        "sampler_samples": prof_stats.get("samples", 0),
+        "sampler_nodes": prof_stats.get("nodes", 0),
+        "sampler_truncated": prof_stats.get("truncated", 0),
+        "loop_slow_ticks": prof_stats.get("loop_slow_ticks", 0),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hosts", type=int, default=1024)
+    ap.add_argument("--rounds", type=int, default=4)
+    ap.add_argument("--quick", action="store_true",
+                    help="256 hosts instead of 1024")
+    ap.add_argument("--publish", action="store_true")
+    args = ap.parse_args()
+
+    hosts = 256 if args.quick else args.hosts
+
+    ingest = run_ingest_paired(args.rounds)
+    print(json.dumps({"ingest": ingest}), flush=True)
+    churn = run_churn_paired(hosts, args.rounds)
+    print(json.dumps({"churn_sim": churn}), flush=True)
+
+    result = {
+        "ingest": ingest,
+        "churn_sim": churn,
+        "note": ("runtime-observatory overhead, paired: ingest = the "
+                 "scheduler's _note_shipped_flight storm with the "
+                 "observatory (sampler thread + gc.callbacks) installed "
+                 "vs not; churn_sim = the 1024-host DES churn sim with "
+                 "the FULL observatory (sampler + GC clock + loop-lag "
+                 "probe on the sim loop) armed inside the measured "
+                 "window vs off. Both report the MEDIAN of adjacent "
+                 "paired CPU ratios over order-alternating rounds "
+                 "(config9 estimator), <= 3% acceptance budget each"),
+    }
+    print(json.dumps(result))
+
+    failed = False
+    for name, block in (("ingest", ingest), ("churn_sim", churn)):
+        if block["cpu_overhead_frac"] > 0.03:
+            print(f"FAIL: observatory {name} overhead "
+                  f"{block['cpu_overhead_frac']:.2%} exceeds the 3% "
+                  f"budget", file=sys.stderr)
+            failed = True
+    if failed:
+        return 1
+
+    if args.publish:
+        path = os.path.join(REPO, "BASELINE.json")
+        doc = json.load(open(path))
+        doc.setdefault("published", {})["config12_prof"] = result
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,6 +14,7 @@ from dataclasses import dataclass, field
 import yaml
 
 from dragonfly2_tpu.pkg.dfpath import Dfpath
+from dragonfly2_tpu.pkg.prof import ProfConfig
 from dragonfly2_tpu.pkg.types import HostType, parse_size
 
 
@@ -147,6 +148,10 @@ class DaemonConfig:
     object_storage: ObjectStorageOption = field(default_factory=ObjectStorageOption)
     pex: PexOption = field(default_factory=PexOption)
     tpu_sink: TPUSinkOption = field(default_factory=TPUSinkOption)
+    # Runtime observatory (pkg/prof): always-on sampling profiler +
+    # loop-lag probe + GC observatory behind /debug/prof*, plus the
+    # daemon-side loop_lag SLO at /debug/slo.
+    prof: ProfConfig = field(default_factory=ProfConfig)
     work_home: str = ""
     host_type: str = "normal"       # normal|super|strong|weak (seed tiers)
     alive_time: float = 0.0         # 0 = forever

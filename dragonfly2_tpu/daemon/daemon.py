@@ -123,6 +123,9 @@ class Daemon:
         self.dynconfig = None  # manager-source scheduler resolution
         self.pex = None        # gossip peer exchange (started in start())
         self.metrics = None    # Prometheus + /debug endpoint
+        self.prof_obs = None   # runtime observatory (pkg/prof)
+        self._prof_probe = None
+        self._runtime_slo = None
         self._started = False
         self._peer_port = 0
         self.gc = GC(log)
@@ -299,12 +302,34 @@ class Daemon:
         recorder.keep_bundles = self.config.flight_keep_bundles
         if self.config.clock_offset_s:
             recorder.wall_offset = self.config.clock_offset_s
+        if self.config.prof.enabled:
+            # Runtime observatory: always-on sampler + loop-lag probe +
+            # GC observatory (pkg/prof; paired cost published as
+            # config12_prof). Slow ticks/pauses stamp typed events into
+            # every running flight; the probe feeds a daemon-side
+            # loop_lag SLO engine at /debug/slo.
+            from dataclasses import replace as _dc_replace
+
+            from dragonfly2_tpu.pkg import prof as proflib
+            from dragonfly2_tpu.pkg import slo as slolib
+
+            self.prof_obs = proflib.install(self.config.prof,
+                                            recorder=recorder)
+            self._prof_probe = self.prof_obs.arm_loop("daemon")
+            recorder.runtime = self.prof_obs
+            self._runtime_slo = slolib.SLOEngine(
+                specs=tuple(
+                    _dc_replace(s, threshold=self.config.prof.lag_slow_s)
+                    for s in slolib.RUNTIME_SLOS),
+                probes=self.prof_obs.slo_probes())
         if self.config.metrics_port >= 0:
             from dragonfly2_tpu.pkg.metrics_server import MetricsServer
 
             # Loopback by default: /debug exposes live stacks; operators
             # who want network scraping front it deliberately.
-            self.metrics = MetricsServer(flight=recorder)
+            self.metrics = MetricsServer(flight=recorder,
+                                         prof=self.prof_obs,
+                                         slo=self._runtime_slo)
             await self.metrics.serve("127.0.0.1", self.config.metrics_port)
         await self.rpc.serve_download(NetAddr.unix(self.config.unix_sock))
         if self.config.download.peer_port >= 0:  # -1 disables the peer service
@@ -371,6 +396,15 @@ class Daemon:
             await self.pex.stop()
         if self.metrics is not None:
             await self.metrics.close()
+        if self.prof_obs is not None:
+            from dragonfly2_tpu.pkg import prof as proflib
+
+            if self._prof_probe is not None:
+                self._prof_probe.disarm()
+                self.prof_obs.probes.pop(self._prof_probe.name, None)
+            self.task_manager.flight.runtime = None
+            proflib.release(self.prof_obs)
+            self.prof_obs = None
         if self.dynconfig is not None:
             await self.dynconfig.stop()
         if self.announcer is not None:

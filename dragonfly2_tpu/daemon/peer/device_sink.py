@@ -156,7 +156,7 @@ class DeviceSinkManager:
         # Single worker: serializes sink mutation (HBMSink is not
         # thread-safe) and keeps device copies off the event loop.
         self._exec = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="device-sink")
+            max_workers=1, thread_name_prefix="df-device-sink")
 
     def close(self) -> None:
         self._exec.shutdown(wait=False, cancel_futures=True)

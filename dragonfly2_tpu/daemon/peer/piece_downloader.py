@@ -137,7 +137,7 @@ def _native_executor() -> concurrent.futures.ThreadPoolExecutor:
     if _NATIVE_EXECUTOR is None:
         _NATIVE_EXECUTOR = concurrent.futures.ThreadPoolExecutor(
             max_workers=max(32, (os.cpu_count() or 1) * 4),
-            thread_name_prefix="dfnative-io")
+            thread_name_prefix="df-native-io")
     return _NATIVE_EXECUTOR
 
 

@@ -86,6 +86,8 @@ EV_TASK_DONE = 23
 EV_TASK_FAILED = 24
 EV_DELTA_REUSE = 25    # delta chunk copied from the local base (aux=cost_ms)
 EV_DELTA_FETCH = 26    # delta chunk pulled as a ranged task (aux=cost_ms)
+EV_LOOP_LAG = 27       # event loop wedged during this task (aux=lag_s)
+EV_GC_PAUSE = 28       # slow cyclic-GC pause during this task (aux=pause_s)
 
 EVENT_NAMES = {
     EV_REGISTER: "register", EV_SCHEDULED: "scheduled",
@@ -101,7 +103,13 @@ EVENT_NAMES = {
     EV_HBM_LANDED: "hbm_landed", EV_UPLOAD_SERVE: "upload_serve",
     EV_TASK_DONE: "task_done", EV_TASK_FAILED: "task_failed",
     EV_DELTA_REUSE: "delta_reuse", EV_DELTA_FETCH: "delta_fetch",
+    EV_LOOP_LAG: "loop_lag", EV_GC_PAUSE: "gc_pause",
 }
+
+# Runtime-interference events (pkg/prof stamps them into every RUNNING
+# flight): not phase markers — the analyzer summarizes them separately
+# so --explain can say the LOOP was wedged, not just "nothing happened".
+_RUNTIME_EVENTS = (EV_LOOP_LAG, EV_GC_PAUSE)
 
 # Canonical phase model. ``other`` (residual uninstrumented time) rides
 # alongside so the fold partitions wall time exactly.
@@ -412,9 +420,21 @@ def analyze(tf: TaskFlight, *, stall_ttfb_s: float = STALL_TTFB_S,
     ordered = [rows[k] for k in sorted(rows)]
     truncated = len(ordered) > max_waterfall
     counts: dict = {}
-    for _t, code, _p, _a, _n in events:
+    runtime: dict = {}
+    for _t, code, _p, aux, _n in events:
         name = EVENT_NAMES.get(code, str(code))
         counts[name] = counts.get(name, 0) + 1
+        if code in _RUNTIME_EVENTS:
+            r = runtime.get(name)
+            if r is None:
+                r = runtime[name] = {"count": 0, "max_s": 0.0, "total_s": 0.0}
+            r["count"] += 1
+            r["total_s"] += aux
+            if aux > r["max_s"]:
+                r["max_s"] = aux
+    for r in runtime.values():
+        r["max_s"] = round(r["max_s"], 4)
+        r["total_s"] = round(r["total_s"], 4)
     return {
         "task_id": tf.task_id,
         "state": tf.state,
@@ -430,9 +450,31 @@ def analyze(tf: TaskFlight, *, stall_ttfb_s: float = STALL_TTFB_S,
         "events": tf.events_total,
         "events_dropped": tf.events_dropped,
         "event_counts": counts,
+        "runtime": runtime,
         "pieces": ordered[:max_waterfall],
         "pieces_truncated": truncated,
     }
+
+
+def runtime_advisory(report: dict) -> str:
+    """One-line loop-lag/GC advisory from an ``analyze()`` report's
+    runtime-interference events, or "" when the runtime stayed quiet.
+    Rendered under the --explain waterfall so a stall phase caused by a
+    wedged loop or a GC storm names its culprit."""
+    rt = report.get("runtime") or {}
+    parts = []
+    ll = rt.get("loop_lag")
+    if ll:
+        parts.append(f"event loop wedged {ll['count']}x "
+                     f"(max {ll['max_s']:.2f}s, {ll['total_s']:.2f}s total)")
+    gp = rt.get("gc_pause")
+    if gp:
+        parts.append(f"gc paused {gp['count']}x "
+                     f"(max {gp['max_s']:.2f}s, {gp['total_s']:.2f}s total)")
+    if not parts:
+        return ""
+    return ("runtime interference: " + ", ".join(parts) +
+            " during this task — see /debug/prof")
 
 
 def render_waterfall(report: dict) -> str:
@@ -452,6 +494,9 @@ def render_waterfall(report: dict) -> str:
     for ph, v in entries:
         bar = "#" * int(round(width * v / wall))
         lines.append(f"  {ph:<10} {v:8.3f}s {100 * v / wall:5.1f}% {bar}")
+    advisory = runtime_advisory(report)
+    if advisory:
+        lines.append(advisory)
     pieces = report.get("pieces") or []
     suffix = " (truncated)" if report.get("pieces_truncated") else ""
     lines.append(f"pieces: {len(pieces)}{suffix}")
@@ -582,6 +627,11 @@ class FlightRecorder:
         # bundles so a failure autopsy carries the subject host's
         # fleet-wide standing at failure time.
         self.scorecard_snapshot: dict = {}
+        # Runtime observatory (pkg/prof), when this role armed one: its
+        # pruned snapshot rides along in post-mortem bundles so a failed
+        # task's autopsy shows what the PROCESS was doing, not just what
+        # the task saw.
+        self.runtime = None
         self._tasks: "OrderedDict[str, TaskFlight]" = OrderedDict()
         self._lock = threading.Lock()
 
@@ -608,6 +658,15 @@ class FlightRecorder:
 
     def get(self, task_id: str) -> "TaskFlight | None":
         return self._tasks.get(task_id)
+
+    def stamp_running(self, code: int, aux: float = 0.0,
+                      note: str = "") -> None:
+        """Record one event into EVERY running flight — how pkg/prof
+        stamps runtime interference (a wedged loop, a slow GC pause)
+        into the task windows it overlapped. Bounded by max_tasks."""
+        for tf in list(self._tasks.values()):
+            if tf.state == "running":
+                tf.record(code, -1, aux, note)
 
     def summary(self) -> list:
         return [{"task_id": tf.task_id, "state": tf.state,
@@ -656,6 +715,14 @@ class FlightRecorder:
             }
             if self.scorecard_snapshot:
                 bundle["scorecard"] = dict(self.scorecard_snapshot)
+            if self.runtime is not None:
+                # Pruned prof snapshot + loop-lag/GC summary: best-effort
+                # like the rest of the dump path.
+                try:
+                    bundle["runtime"] = self.runtime.postmortem()
+                except Exception:
+                    log.warning("runtime snapshot for bundle failed",
+                                exc_info=True)
             with gzip.open(path, "wt") as f:
                 json.dump(bundle, f)
             log.info("flight post-mortem dumped", task=tf.task_id[:16],

@@ -20,6 +20,12 @@ Routes: GET /metrics (Prometheus text; OpenMetrics via Accept),
         merged cross-host broadcast timeline, clock-aligned, slowest
         host + dominant phase named, alignment error bound printed),
         GET /debug/slo (the continuous SLO / burn-rate engine's state),
+        GET /debug/prof (runtime observatory: top-N self-time per thread
+        from the always-on sampling profiler),
+        GET /debug/prof/flame?format=folded (flamegraph-ready folded
+        stacks from the same trie),
+        GET /debug/prof/runtime (event-loop lag histograms, GC pauses,
+        /proc gauges),
         GET /debug/fleet[?window=seconds] (cluster health time-series),
         GET /debug/fleet/hosts (cross-task host scorecards + straggler
         flags), GET /debug/fleet/decisions?host=|task=|kind=|n=|since=|
@@ -87,6 +93,9 @@ class MetricsServer:
         ("/debug/pod/{task_id}", "_pod_task"),
         ("/debug/pod/{task_id}/timeline", "_pod_timeline"),
         ("/debug/slo", "_slo"),
+        ("/debug/prof", "_prof"),
+        ("/debug/prof/flame", "_prof_flame"),
+        ("/debug/prof/runtime", "_prof_runtime"),
         ("/debug/fleet", "_fleet_snapshot"),
         ("/debug/fleet/hosts", "_fleet_hosts"),
         ("/debug/fleet/decisions", "_fleet_decisions"),
@@ -95,17 +104,19 @@ class MetricsServer:
 
     def __init__(self, *, flight: "flightlib.FlightRecorder | None" = None,
                  pod_flight: "flightlib.PodAggregator | None" = None,
-                 fleet=None, slo=None, pod_timeline=None):
+                 fleet=None, slo=None, pod_timeline=None, prof=None):
         # Optional providers: the daemon passes its flight recorder, the
         # scheduler its pod aggregator + fleet observatory + SLO engine
         # + pod-timeline assembler (an async callable task_id -> report,
         # so the on-demand FlightReport pulls stay in the scheduler);
-        # endpoints 404 without one.
+        # BOTH pass the runtime observatory (pkg/prof) behind the
+        # /debug/prof* family; endpoints 404 without one.
         self._flight = flight
         self._pod_flight = pod_flight
         self._fleet = fleet
         self._slo_engine = slo
         self._pod_timeline_provider = pod_timeline
+        self._prof_obs = prof
         self._runner: web.AppRunner | None = None
         self._port = 0
         self._profiling = False
@@ -242,12 +253,48 @@ class MetricsServer:
         return web.json_response(report)
 
     async def _slo(self, request: web.Request) -> web.Response:
-        """The continuous SLO / burn-rate engine (scheduler binary):
-        every declared SLO's per-window burn rates and states."""
+        """The continuous SLO / burn-rate engine: the scheduler serves
+        the full spec set; a daemon serves its runtime-only engine
+        (loop_lag) when the observatory is armed."""
         if self._slo_engine is None:
             raise web.HTTPNotFound(
-                text="no SLO engine on this binary (scheduler-only)\n")
+                text="no SLO engine on this binary\n")
         return web.json_response(self._slo_engine.report())
+
+    def _need_prof(self):
+        if self._prof_obs is None:
+            raise web.HTTPNotFound(
+                text="no runtime observatory on this binary "
+                     "(prof.enabled=false?)\n")
+        return self._prof_obs
+
+    async def _prof(self, request: web.Request) -> web.Response:
+        """Runtime observatory (pkg/prof): the always-on sampling
+        profiler's top-N self-time frames per thread. ``?topn=`` bounds
+        the per-thread list (default 20, cap 200)."""
+        obs = self._need_prof()
+        try:
+            topn = min(max(int(request.query.get("topn", "20")), 1), 200)
+        except ValueError:
+            return web.Response(text="bad topn value\n", status=400)
+        return web.json_response(obs.profile_report(topn))
+
+    async def _prof_flame(self, request: web.Request) -> web.Response:
+        """Flamegraph-ready folded stacks (``thread;frame;frame count``
+        per line) from the sampler's bounded trie — pipe straight into
+        flamegraph.pl / speedscope. ``format=folded`` is the only
+        format."""
+        obs = self._need_prof()
+        if request.query.get("format", "folded") != "folded":
+            return web.Response(text="only format=folded is supported\n",
+                                status=400)
+        return web.Response(text=obs.folded())
+
+    async def _prof_runtime(self, request: web.Request) -> web.Response:
+        """Loop-lag histograms per probed loop, GC pause/collection
+        summary, and /proc/self gauges (RSS, fds, threads, ctx
+        switches) — refreshed at scrape time."""
+        return web.json_response(self._need_prof().runtime_report())
 
     def _need_fleet(self):
         if self._fleet is None:

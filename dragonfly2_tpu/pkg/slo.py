@@ -9,7 +9,7 @@ standard SRE multi-window burn-rate formulation:
     burn_rate  = error_rate / (1 - objective)       (1.0 = budget pace)
     state      = breach when burn_rate >= the window's threshold
 
-Three SLI kinds, all reduced to a good/bad fraction over a window so one
+Four SLI kinds, all reduced to a good/bad fraction over a window so one
 burn formula serves everything:
 
   * ``completion`` — per-task-completion values (broadcast makespan,
@@ -20,6 +20,14 @@ burn formula serves everything:
     (e.g. back-to-source demotions per registration).
   * ``gauge`` — fraction of time-series buckets where a sampled gauge
     exceeded the threshold (e.g. flagged straggler hosts).
+  * ``probe`` — a callable ``(window, threshold) -> (bad, total)``
+    registered under the spec's field (``probes=`` at construction or
+    ``engine.probes[...]`` later). The runtime observatory (pkg/prof)
+    feeds ``loop_lag`` this way: wedged wall-seconds over observed
+    wall-seconds, so a wedged event loop burns budget in proportion to
+    the wall time it stole — immune to dilution by healthy heartbeat
+    ticks. Both the scheduler AND the daemon evaluate it (the daemon
+    runs a runtime-only engine at its own /debug/slo).
 
 Served at ``GET /debug/slo`` and exported as
 ``scheduler_slo_burn_rate{slo,window}`` /
@@ -64,7 +72,7 @@ class SLOSpec:
     per-event/per-bucket good/bad cut for completion and gauge kinds."""
 
     name: str
-    kind: str                  # "completion" | "ratio" | "gauge"
+    kind: str                  # "completion" | "ratio" | "gauge" | "probe"
     description: str = ""
     field: str = ""            # completion value / gauge column
     bad_col: str = ""          # ratio: numerator counter column
@@ -106,7 +114,17 @@ DEFAULT_SLOS = (
             threshold=0.0, objective=0.9, burn_thresholds=(8.0, 4.0),
             description="no host is flagged a fleet-wide straggler in "
                         "90% of sampled buckets"),
+    SLOSpec("loop_lag", "probe", field="loop_lag", threshold=0.25,
+            objective=0.99,
+            description="event-loop wedged time (heartbeat lag above "
+                        "250 ms) stays under 1% of observed wall time — "
+                        "the runtime observatory's loop probe feeds it; "
+                        "no_data until pkg/prof is armed"),
 )
+
+# The daemon-side runtime engine evaluates just this subset (the rest
+# need a scheduler's fleet series / completion feed).
+RUNTIME_SLOS = tuple(s for s in DEFAULT_SLOS if s.kind == "probe")
 
 
 @dataclass
@@ -125,7 +143,7 @@ class SLOEngine:
     # Continuous means "every few seconds", not "every completion": the
     # windows are 5 m / 1 h, so a 5 s tick loses nothing while keeping
     # the engine invisible on the ingest path (podlens_bench pairs it).
-    def __init__(self, specs=DEFAULT_SLOS, *, series=None,
+    def __init__(self, specs=DEFAULT_SLOS, *, series=None, probes=None,
                  max_completions: int = 4096,
                  min_eval_interval_s: float = 5.0,
                  clock=time.monotonic):
@@ -143,6 +161,10 @@ class SLOEngine:
                     f"SLO {spec.name!r}: windows and burn_thresholds "
                     f"must align positionally")
         self.series = series
+        # kind="probe" feeds: field -> callable(window, threshold) ->
+        # (bad, total). Wired at construction or later (the scheduler
+        # attaches the runtime observatory's probes when prof arms).
+        self.probes: dict = dict(probes or {})
         self.max_completions = max_completions
         self.min_eval_interval_s = min_eval_interval_s
         self._clock = clock
@@ -217,6 +239,20 @@ class SLOEngine:
         bad = sum(1.0 for v in values if v > spec.threshold)
         return bad, float(len(values))
 
+    def _probe_counts(self, spec: SLOSpec,
+                      window: float) -> "tuple[float, float]":
+        fn = self.probes.get(spec.field or spec.name)
+        if fn is None:
+            return 0.0, 0.0
+        try:
+            bad, total = fn(window, spec.threshold)
+        except Exception:
+            log.warning("slo probe failed", slo=spec.name, exc_info=True)
+            return 0.0, 0.0
+        # Clamp: burn must never exceed the total-outage ceiling because
+        # a probe returned bad > total.
+        return min(float(bad), float(total)), float(total)
+
     def evaluate(self, now: "float | None" = None) -> dict:
         """Recompute every (slo, window) burn rate, update the exported
         gauges, edge-trigger breach counters, and cache the report."""
@@ -232,6 +268,8 @@ class SLOEngine:
                                               spec.burn_thresholds):
                 if spec.kind == "completion":
                     bad, total = self._completion_counts(spec, window, now)
+                elif spec.kind == "probe":
+                    bad, total = self._probe_counts(spec, window)
                 else:
                     bad, total = self._series_counts(spec, window)
                 if total < spec.min_events:

@@ -11,6 +11,8 @@ from dataclasses import dataclass, field
 
 import yaml
 
+from dragonfly2_tpu.pkg.prof import ProfConfig
+
 # Reference scheduler/config/constants.go values.
 SEED_PEER_CONCURRENT_UPLOAD_LIMIT = 2000   # :26-28
 PEER_CONCURRENT_UPLOAD_LIMIT = 200         # :29-31
@@ -139,6 +141,9 @@ class SchedulerConfig:
     fleet: FleetConfig = field(default_factory=FleetConfig)
     podlens: PodLensConfig = field(default_factory=PodLensConfig)
     ha: HAConfig = field(default_factory=HAConfig)
+    # Runtime observatory (pkg/prof): /debug/prof* on the scheduler's
+    # metrics server + the loop_lag SLO probe wired into the engine.
+    prof: ProfConfig = field(default_factory=ProfConfig)
     manager_addr: str = ""                 # manager drpc for registration
     cluster_id: int = 1
     # Durable persistent-cache state (reference: Redis-backed

@@ -37,6 +37,8 @@ class SchedulerServer:
         self.dynconfig = None       # manager-fed cluster config + seed peers
         self.job_worker = None      # manager job-queue consumer (preheat etc.)
         self.metrics = None         # Prometheus + /debug endpoint
+        self.prof_obs = None        # runtime observatory (pkg/prof)
+        self._prof_probe = None     # its scheduler-loop lag probe
         self._manager_retry: asyncio.Task | None = None
         self._stopped = asyncio.Event()
 
@@ -84,19 +86,30 @@ class SchedulerServer:
     async def start(self) -> None:
         """Non-blocking variant for embedding in tests."""
         await self.rpc.serve(NetAddr.tcp(self.config.server.host, self.config.server.port))
+        if self.config.prof.enabled:
+            from dragonfly2_tpu.pkg import prof as proflib
+
+            self.prof_obs = proflib.install(self.config.prof)
+            self._prof_probe = self.prof_obs.arm_loop("scheduler")
+            if self.service.slo is not None:
+                # loop_lag joins the pod SLO engine: scheduler wedge time
+                # burns against the same /debug/slo surface as the
+                # broadcast SLIs.
+                self.service.slo.probes.update(self.prof_obs.slo_probes())
         if self.config.metrics_port >= 0:
             from dragonfly2_tpu.pkg.metrics_server import MetricsServer
 
             # Loopback by default — /debug exposes live stacks; the pod
             # aggregator adds /debug/pod/<task_id> straggler attribution,
             # the fleet observatory the /debug/fleet* family, the pod
-            # lens /debug/pod/<task_id>/timeline, and the SLO engine
-            # /debug/slo.
+            # lens /debug/pod/<task_id>/timeline, the SLO engine
+            # /debug/slo, and the runtime observatory /debug/prof*.
             self.metrics = MetricsServer(
                 pod_flight=self.service.pod_flight,
                 fleet=self.service.fleet,
                 slo=self.service.slo,
-                pod_timeline=self.service.pod_timeline_report)
+                pod_timeline=self.service.pod_timeline_report,
+                prof=self.prof_obs)
             await self.metrics.serve("127.0.0.1", self.config.metrics_port)
         self.gc.serve()
         if self.config.manager_addr:
@@ -187,5 +200,16 @@ class SchedulerServer:
         await self.service.seed_clients.close()
         if self.metrics is not None:
             await self.metrics.close()
+        if self.prof_obs is not None:
+            from dragonfly2_tpu.pkg import prof as proflib
+
+            if self._prof_probe is not None:
+                self._prof_probe.disarm()
+                self.prof_obs.probes.pop(self._prof_probe.name, None)
+                self._prof_probe = None
+            if self.service.slo is not None:
+                self.service.slo.probes.pop("loop_lag", None)
+            proflib.release(self.prof_obs)
+            self.prof_obs = None
         await self.rpc.close()
         self._stopped.set()

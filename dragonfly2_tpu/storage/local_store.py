@@ -176,7 +176,7 @@ class _PrefixHasher:
         self.disk_reads = 0   # pieces the background thread pread (telemetry)
         self._thread = threading.Thread(
             target=self._run, daemon=True,
-            name=f"prefix-hash-{store.metadata.task_id[:12]}")
+            name=f"df-prefix-hash-{store.metadata.task_id[:12]}")
         self._thread.start()
 
     # Called from _commit_piece_record (under the store's _meta_lock; lock

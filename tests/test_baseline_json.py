@@ -32,6 +32,7 @@ REQUIRED_CONFIGS = (
     "config9_fleet",
     "config10_podlens",
     "config11_delta",
+    "config12_prof",
     "ingest_micro",
 )
 
@@ -190,6 +191,43 @@ def test_podlens_entry_paired_shape():
     # The scheduler-side ingest price stays sane: well under a
     # millisecond per completed task.
     assert ingest["on_us_per_task"] < 200, ingest
+
+
+def test_prof_entry_paired_shape():
+    """config12_prof is a PAIRED overhead run for the always-on runtime
+    observatory: the shipped-digest ingest storm (sampler + GC callbacks
+    installed vs not) AND the 1024-host DES churn sim (full observatory
+    armed inside the measured window vs off). Both rounds are
+    order-alternating with the config9 estimator — recompute the median
+    from the published per-pair ratios — and both hold the <=3% budget
+    independently."""
+    entry = _load()["published"]["config12_prof"]
+    for name, bound_hosts in (("ingest", None), ("churn_sim", 1024)):
+        block = entry[name]
+        ratios = sorted(block["pair_ratios"])
+        assert len(ratios) == block["rounds"], name
+        assert len(ratios) % 2 == 0, f"{name}: odd round count"
+        median = (ratios[len(ratios) // 2 - 1]
+                  + ratios[len(ratios) // 2]) / 2
+        assert block["cpu_overhead_frac"] == pytest.approx(
+            median - 1.0, abs=1e-3), name
+        assert block["cpu_overhead_frac"] <= 0.03, (
+            name, block["cpu_overhead_frac"])
+        runs = block["runs_cpu_s"]
+        assert len(runs["on"]) == len(runs["off"]) == block["rounds"], name
+        assert all(v > 0 for v in runs["on"] + runs["off"]), name
+        if bound_hosts:
+            assert block["hosts"] >= bound_hosts, name
+    churn = entry["churn_sim"]
+    on, off = churn["on"], churn["off"]
+    for run in (on, off):
+        assert run["cpu_s"] > 0 and run["wall_s"] > 0
+    # The treated arm actually sampled (a zero-sample pair measures
+    # nothing) inside a bounded trie.
+    assert churn["sampler_samples"] > 0, churn
+    assert churn["sampler_nodes"] > 0, churn
+    ingest = entry["ingest"]
+    assert ingest["on_us_per_task"] > 0 and ingest["off_us_per_task"] > 0
 
 
 def test_ingest_micro_serve_round_paired_shape():

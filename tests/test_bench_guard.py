@@ -32,6 +32,13 @@ def test_fallback_output_is_structured():
     assert fb["cpu_crc32c_backend"] in ("native", "google-crc32c", "python")
     # Human-readable note rides along for round summaries.
     assert "device path unavailable" in out["note"]
+    # Runtime snapshot (pkg/prof): the fallback says what the PROCESS
+    # was doing, even unarmed (gauges always; frames when armed).
+    rt = out["runtime"]
+    assert rt["rss_mb"] > 0
+    assert rt["threads"] >= 1
+    assert isinstance(rt["top_self"], list)
+    assert "max_loop_lag_ms" in rt and "gc_collections" in rt
 
 
 def test_fallback_output_never_empty_reason():
@@ -53,6 +60,10 @@ def test_main_fallback_path_emits_structured_reason(monkeypatch):
     assert out["fallback"]["stage"] == "backend_init"
     assert "BENCH_FORCE_FALLBACK" in out["fallback"]["reason"]
     assert out["value"] > 0
+    # main() armed the observatory before the probe, so the snapshot
+    # carries real sampler evidence, not just gauges.
+    assert out["runtime"]["samples"] >= 0
+    assert out["runtime"]["rss_mb"] > 0
 
 
 def test_scrubbed_device_env_drops_cpu_pins(monkeypatch):

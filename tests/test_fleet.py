@@ -438,12 +438,19 @@ class TestScrapeUnderLoad:
 
         from dragonfly2_tpu.pkg.metrics_server import MetricsServer
 
+        from dragonfly2_tpu.pkg import prof as proflib
+
         async def body():
             cfg = SchedulerConfig()
             cfg.seed_peer_enabled = False
             cfg.scheduling.retry_interval = 0.05
             svc = SchedulerService(cfg)
-            srv = MetricsServer(pod_flight=svc.pod_flight, fleet=svc.fleet)
+            # Armed observatory: the /debug/prof* endpoints must answer
+            # mid-broadcast with the sampler LIVE, same 1s bound.
+            obs = proflib.install()
+            probe = obs.arm_loop("scrape-test")
+            srv = MetricsServer(pod_flight=svc.pod_flight, fleet=svc.fleet,
+                                prof=obs)
             port = await srv.serve("127.0.0.1", 0)
             base = f"http://127.0.0.1:{port}"
 
@@ -490,21 +497,24 @@ class TestScrapeUnderLoad:
             await asyncio.sleep(0.1)    # mid-flight: pieces streaming
             try:
                 async with aiohttp.ClientSession() as sess:
-                    for path, is_json in (
-                            ("/metrics", False),
-                            ("/debug/fleet?window=60", True),
-                            ("/debug/fleet/hosts", True),
-                            ("/debug/fleet/decisions", True),
-                            ("/debug/fleet/info", True)):
+                    for path, kind in (
+                            ("/metrics", "prom"),
+                            ("/debug/fleet?window=60", "json"),
+                            ("/debug/fleet/hosts", "json"),
+                            ("/debug/fleet/decisions", "json"),
+                            ("/debug/fleet/info", "json"),
+                            ("/debug/prof?topn=10", "json"),
+                            ("/debug/prof/runtime", "json"),
+                            ("/debug/prof/flame?format=folded", "text")):
                         t0 = time_mod.perf_counter()
                         async with sess.get(base + path) as r:
                             assert r.status == 200, path
                             raw = await r.read()
                         dt = time_mod.perf_counter() - t0
                         assert dt < 1.0, f"{path} took {dt:.2f}s under load"
-                        if is_json:
+                        if kind == "json":
                             json.loads(raw)     # valid JSON
-                        else:
+                        elif kind == "prom":
                             assert b"dragonfly_tpu" in raw
                     # Mid-flight sanity: the observatory saw the storm.
                     async with sess.get(
@@ -518,5 +528,8 @@ class TestScrapeUnderLoad:
                     asyncio.gather(*peers, return_exceptions=True),
                     timeout=120)
                 await srv.close()
+                probe.disarm()
+                obs.probes.pop(probe.name, None)
+                proflib.release(obs)
 
         run_async(body(), timeout=180)

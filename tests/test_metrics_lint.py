@@ -27,6 +27,7 @@ METRIC_MODULES = (
     "dragonfly2_tpu.pkg.chaos",
     "dragonfly2_tpu.pkg.flight",
     "dragonfly2_tpu.pkg.fleet",
+    "dragonfly2_tpu.pkg.prof",
     "dragonfly2_tpu.pkg.slo",
     "dragonfly2_tpu.pkg.tracing",
     "dragonfly2_tpu.daemon.proxy",
@@ -49,8 +50,8 @@ METRIC_MODULES = (
 # The documented component vocabulary (docs/OBSERVABILITY.md "Metric
 # families"). Adding a component means documenting it there first.
 COMPONENTS = ("bufpool", "chaos", "dataset", "delta", "device_sink",
-              "fleet", "objectstorage", "peer", "proxy", "scheduler",
-              "storage", "tracing", "upload")
+              "fleet", "objectstorage", "peer", "proxy", "runtime",
+              "scheduler", "storage", "tracing", "upload")
 
 # Histogram families must name their unit; counters use _total; gauges
 # may end in a unit but never _total.
