@@ -47,10 +47,10 @@ def _load_history() -> list:
 
 
 def _make_device_entry(jax, device_bps: float, cpu_bps: float,
-                       smoke: str) -> dict:
+                       smoke: str, swap_bps: float = 0.0) -> dict:
     """The one history-entry shape, shared by bench.main and
     benchmarks/device_evidence.py so the rolling record never forks."""
-    return {
+    entry = {
         "ts": time.time(),
         "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "gbps": round(device_bps / 1e9, 3),
@@ -58,6 +58,9 @@ def _make_device_entry(jax, device_bps: float, cpu_bps: float,
         "backend": jax.default_backend(),
         "sink_smoke": smoke,
     }
+    if swap_bps > 0:
+        entry["swap_verify_gbps"] = round(swap_bps / 1e9, 3)
+    return entry
 
 
 def _record_device_result(entry: dict) -> None:
@@ -294,6 +297,52 @@ def bench_staged_transfer(jax, total_mb: int = 64, repeats: int = 4) -> float:
     return slopes[len(slopes) // 2]
 
 
+def bench_swap_verify(jax, total_mb: int = 256, piece_mb: int = 4) -> float:
+    """Hot-swap gate GB/s: verify_u8_against_host over a resident uint8
+    content buffer — the on-device per-piece checksum fold plus the host
+    compare a DoubleBuffer flip pays per checkpoint byte before the next
+    generation goes live (the delta plane's last on-chip gap: the gate
+    had smoke coverage but no throughput number). Each call fetches the
+    per-piece checksum vectors to host (np.asarray inside the gate), so
+    every iteration carries its own hard completion barrier; the slope
+    over two iteration counts cancels the fixed fetch cost like the
+    sink measurement above."""
+    import jax.numpy as jnp
+
+    from dragonfly2_tpu.ops.hbm_sink import (
+        checksum_numpy,
+        verify_u8_against_host,
+    )
+
+    piece = piece_mb << 20
+    total = total_mb << 20
+    host = np.random.RandomState(3).bytes(total)
+    u8 = jnp.asarray(np.frombuffer(host, dtype=np.uint8))
+    jax.block_until_ready(u8)
+    checks = {n: checksum_numpy(host[n * piece:(n + 1) * piece])
+              for n in range(total // piece)}
+
+    def run(iters: int) -> float:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            verify_u8_against_host(u8, piece, checks)
+        return time.perf_counter() - t0
+
+    run(1)   # compile
+    run(2)   # warm
+    n1, n2 = 2, 6
+    slopes = []
+    for _ in range(3):
+        t1 = run(n1)
+        t2 = run(n2)
+        if t2 > t1:
+            slopes.append((n2 - n1) * total / (t2 - t1))
+    if not slopes:
+        return total * n2 / run(n2)
+    slopes.sort()
+    return slopes[len(slopes) // 2]
+
+
 def sink_smoke(jax) -> str:
     """Real-chip smoke of the PRODUCT path: HBMSink lands host pieces,
     verifies on device, round-trips the bytes exactly, AND passes the
@@ -421,6 +470,15 @@ def _bench_main() -> int:
         staged_bps = bench_staged_transfer(jax)
     except Exception:
         staged_bps = 0.0
+    # Swap-verify gate: reported per-stage so a verify-only failure
+    # degrades THIS row (with its reason, self-diagnosing like
+    # fallback_output) without discarding the round's sink number.
+    swap_error = ""
+    try:
+        swap_bps = bench_swap_verify(jax)
+    except Exception as e:
+        swap_bps = 0.0
+        swap_error = str(e)[:300] or "unknown"
     try:
         smoke = sink_smoke(jax)
     except Exception as e:
@@ -428,13 +486,16 @@ def _bench_main() -> int:
     faulthandler.cancel_dump_traceback_later()
     if smoke == "ok":
         # Only verified runs may ever be cited as "last known-good".
-        _record_device_result(_make_device_entry(jax, device_bps, cpu_bps, smoke))
+        _record_device_result(_make_device_entry(
+            jax, device_bps, cpu_bps, smoke, swap_bps))
     print(json.dumps({
         "metric": "verify_and_land_throughput",
         "value": round(device_bps / 1e9, 3),
         "unit": "GB/s",
         "vs_baseline": round(device_bps / cpu_bps, 3),
         "staged_host_to_hbm_gbps": round(staged_bps / 1e9, 3),
+        "swap_verify_gbps": round(swap_bps / 1e9, 3),
+        **({"swap_verify_error": swap_error} if swap_error else {}),
         "cpu_sha256_gbps": round(cpu_bps / 1e9, 3),
         "backend_init_attempts": attempts,
         "sink_smoke": smoke,

@@ -31,6 +31,14 @@ def _add_dfget(sub: argparse._SubParsersAction) -> None:
                         "HBM sink (requires tpu_sink.enabled in the daemon)")
     p.add_argument("--tag", default="", help="task isolation tag")
     p.add_argument("--application", default="")
+    p.add_argument("--tenant", default="",
+                   help="QoS attribution tag: every byte this download "
+                        "moves is accounted (and rate-shared) under this "
+                        "tenant; burning tenants get deprioritized")
+    p.add_argument("--priority", type=int, default=3,
+                   help="QoS priority 0-6 (>=5 interactive, 3-4 normal, "
+                        "<=2 background) — sets the weighted-fair "
+                        "dispatch class on every daemon on the path")
     p.add_argument("--digest", default="", help="expected digest algo:hex")
     p.add_argument("--filter", default="", help="'&'-separated query params to ignore")
     p.add_argument("--range", dest="range_", default="", help="byte range a-b")
@@ -81,7 +89,8 @@ def _run_dfget(args: argparse.Namespace) -> int:
         header[k.strip()] = v.strip()
     meta = UrlMeta(digest=args.digest, tag=args.tag, filter=args.filter,
                    application=args.application, header=header,
-                   range=args.range_)
+                   range=args.range_, priority=args.priority,
+                   tenant=args.tenant)
     cfg = dfget_lib.DfgetConfig(
         url=args.url,
         output=args.output,
@@ -141,6 +150,12 @@ def _run_dfget(args: argparse.Namespace) -> int:
         )
         flight_info = result.get("flight") or {}
         if args.explain and flight_info.get("text"):
+            from dragonfly2_tpu import qos
+
+            sys.stderr.write(
+                f"qos: tenant={qos.normalize_tenant(args.tenant)} "
+                f"class={qos.class_of(args.priority)} "
+                f"(priority={args.priority})\n")
             sys.stderr.write(flight_info["text"] + "\n")
         pod_info = result.get("pod") or {}
         if args.pod and pod_info.get("text"):

@@ -125,6 +125,23 @@ class PexOption:
 
 
 @dataclass
+class QoSOption:
+    """Tenant QoS plane (dragonfly2_tpu/qos): weighted-fair piece
+    dispatch across concurrent tasks + per-tenant upload buckets under
+    the daemon-wide cap. Off by default — with it on, piece serving
+    stays on the aiohttp path (per-tenant accounting and limiting live
+    there, same posture as ``upload.rate_limit > 0``)."""
+
+    enabled: bool = False
+    # WFQ gate slots shared by ALL tasks' piece workers; 0 = 2x
+    # download.parent_concurrency, so a single task never feels the gate.
+    dispatch_capacity: int = 0
+    # Floor share of upload.rate_limit any one tenant keeps when many
+    # are active (the traffic shaper's MIN_SHARE_FRACTION idiom).
+    upload_min_share_fraction: float = 0.1
+
+
+@dataclass
 class TPUSinkOption:
     """--device=tpu sink: land verified pieces into TPU HBM as they
     verify (daemon/peer/device_sink.DeviceSinkManager; no reference
@@ -148,6 +165,7 @@ class DaemonConfig:
     object_storage: ObjectStorageOption = field(default_factory=ObjectStorageOption)
     pex: PexOption = field(default_factory=PexOption)
     tpu_sink: TPUSinkOption = field(default_factory=TPUSinkOption)
+    qos: QoSOption = field(default_factory=QoSOption)
     # Runtime observatory (pkg/prof): always-on sampling profiler +
     # loop-lag probe + GC observatory behind /debug/prof*, plus the
     # daemon-side loop_lag SLO at /debug/slo.

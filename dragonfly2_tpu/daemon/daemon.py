@@ -65,7 +65,25 @@ class Daemon:
         if config.scheduler.addrs:
             self.scheduler_client = SchedulerClient(config.scheduler.addrs)
 
-        self.upload = UploadManager(self.storage, rate_limit=config.upload.rate_limit)
+        # Tenant QoS plane (dragonfly2_tpu/qos): one DWRR dispatch gate
+        # shared by every conductor's piece workers + per-tenant upload
+        # buckets under the daemon-wide cap. Gated off by default; with
+        # it on, piece serving stays on the aiohttp path (attribution
+        # and per-tenant limiting live there).
+        self.qos_gate = None
+        qos_buckets = None
+        if config.qos.enabled:
+            from dragonfly2_tpu import qos as qoslib
+
+            capacity = config.qos.dispatch_capacity or (
+                2 * max(1, config.download.parent_concurrency))
+            self.qos_gate = qoslib.WFQGate(capacity)
+            qos_buckets = qoslib.TenantBuckets(
+                float(config.upload.rate_limit),
+                min_share_fraction=config.qos.upload_min_share_fraction)
+        self.upload = UploadManager(self.storage,
+                                    rate_limit=config.upload.rate_limit,
+                                    qos_buckets=qos_buckets)
         device_sinks = None
         if config.tpu_sink.enabled:
             from dragonfly2_tpu.daemon.peer.device_sink import DeviceSinkManager
@@ -181,6 +199,7 @@ class Daemon:
             "filters": request.meta.filter.split("&") if request.meta.filter else [],
             "header": dict(request.meta.header),
             "priority": request.meta.priority,
+            "tenant": request.meta.tenant,
             "range": request.meta.range,
             "pod_broadcast": getattr(request, "pod_broadcast", False),
         }
@@ -199,6 +218,7 @@ class Daemon:
             piece_parallelism=self.config.download.parent_concurrency,
             limiter=limiter if limiter is not None else self.task_manager.limiter,
             on_piece=on_piece,
+            wfq=self.qos_gate,
             disable_back_source=disable_back_source,
             local_range_source=(
                 lambda s, cb, _req=request:

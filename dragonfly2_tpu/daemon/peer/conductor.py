@@ -38,6 +38,7 @@ from dragonfly2_tpu.pkg import retry as retrylib
 from dragonfly2_tpu.pkg.errors import Code, DfError
 from dragonfly2_tpu.pkg.piece import PieceInfo, Range, compute_piece_count
 from dragonfly2_tpu.pkg.ratelimit import Limiter
+from dragonfly2_tpu import qos as qoslib
 from dragonfly2_tpu.storage.local_store import LocalTaskStore
 
 log = dflog.get("peer.conductor")
@@ -92,6 +93,7 @@ class PeerTaskConductor:
         local_range_source=None,
         quarantine=None,
         flight=None,
+        wfq=None,
     ):
         self.task_id = task_id
         self.peer_id = peer_id
@@ -140,6 +142,14 @@ class PeerTaskConductor:
         self.dispatcher = PieceDispatcher(quarantine=quarantine,
                                           flight=self.flight)
         self.downloader = PieceDownloader()
+        # Tenant QoS plane (dragonfly2_tpu/qos): the daemon-wide WFQ
+        # dispatch gate shared across conductors (None = ungated), plus
+        # this task's attribution identity. The normalized tenant rides
+        # every upstream piece request as a query param so the serving
+        # peer can account and rate-split per tenant.
+        self.wfq = wfq
+        self.tenant = qoslib.normalize_tenant(self.meta.get("tenant"))
+        self._qos_priority = int(self.meta.get("priority", 3) or 3)
         self.synchronizer: PieceTaskSynchronizer | None = None
         # Striped slice broadcast: this host's ICI domain, and the bytes
         # pulled per parent locality (intra = same slice / ICI, cross =
@@ -191,6 +201,7 @@ class PeerTaskConductor:
             "filters": self.meta.get("filters") or [],
             "header": self.meta.get("header") or {},
             "priority": self.meta.get("priority", 3),
+            "tenant": self.meta.get("tenant", ""),
             "range": self.meta.get("range", ""),
             "is_seed": self.is_seed,
             "disable_back_source": self.disable_back_source,
@@ -852,13 +863,29 @@ class PeerTaskConductor:
                     return
                 continue
             run = self.dispatcher.extend_run(assignment, self.SPAN_MAX_PIECES)
-            if len(run) > 1 and await self._download_run(run):
+            if self.wfq is None:
+                await self._dispatch_assignment(assignment, run)
                 continue
-            for extra in run[1:]:
-                # Span path ineligible: hand the reservations back and pull
-                # the head piece the per-piece way.
-                self.dispatcher.release_assignment(extra)
-            await self._download_one(assignment)
+            # QoS gate: the assignment (a per-task reservation) is held
+            # while this worker waits its DWRR turn, so cross-task piece
+            # ISSUE order follows class weights while per-task dispatcher
+            # state stays untouched. Acquired after dispatcher.get() so a
+            # parked worker never pins a slot through starvation waits.
+            await self.wfq.acquire(self._qos_priority)
+            try:
+                await self._dispatch_assignment(assignment, run)
+            finally:
+                self.wfq.release()
+
+    async def _dispatch_assignment(self, assignment: PieceAssignment,
+                                   run: list[PieceAssignment]) -> None:
+        if len(run) > 1 and await self._download_run(run):
+            return
+        for extra in run[1:]:
+            # Span path ineligible: hand the reservations back and pull
+            # the head piece the per-piece way.
+            self.dispatcher.release_assignment(extra)
+        await self._download_one(assignment)
 
     async def _download_run(self, run: list[PieceAssignment]) -> bool:
         """One coalesced ranged fetch; returns False when the downloader
@@ -911,7 +938,7 @@ class PeerTaskConductor:
         return await self.downloader.download_span_to_store(
             p.ip, p.upload_port, self.task_id, run, self.store,
             src_peer_id=self.peer_id, limiter=self.limiter,
-            on_result=on_result)
+            on_result=on_result, tenant=self.tenant)
 
     async def _download_one(self, assignment: PieceAssignment) -> None:
         from dragonfly2_tpu.daemon.peer.piece_downloader import (
@@ -923,7 +950,8 @@ class PeerTaskConductor:
         try:
             rec = await pull_one_piece(
                 self.downloader, self.store, self.dispatcher, assignment,
-                task_id=self.task_id, peer_id=self.peer_id, limiter=self.limiter)
+                task_id=self.task_id, peer_id=self.peer_id,
+                limiter=self.limiter, tenant=self.tenant)
             self.dispatcher.report_success(assignment, rec.cost_ms)
             PIECE_DOWNLOAD_COUNT.labels("ok").inc()
             self._note_piece_bytes(p, rec.size)

@@ -27,6 +27,7 @@ from dragonfly2_tpu.pkg import flight as flightlib
 from dragonfly2_tpu.pkg import retry as retrylib
 from dragonfly2_tpu.pkg import tracing
 from dragonfly2_tpu.pkg.errors import Code, DfError
+from dragonfly2_tpu import qos as qoslib
 from dragonfly2_tpu.storage.local_store import _native
 
 log = dflog.get("peer.piece_downloader")
@@ -304,7 +305,8 @@ class PieceDownloader:
     async def download_piece(self, parent_ip: str, parent_upload_port: int,
                              task_id: str, piece_num: int, *, src_peer_id: str = "",
                              expected_size: int = -1,
-                             expected_digest: str = "") -> tuple[list, int, int, str]:
+                             expected_digest: str = "",
+                             tenant: str = "") -> tuple[list, int, int, str]:
         """Fetch one piece; returns (chunks, size, cost_ms, digest_str) —
         the body as wire chunks plus the streaming digest (see
         assemble_piece). Land with store.write_piece_chunks, which
@@ -330,13 +332,17 @@ class PieceDownloader:
                                f"chaos {fault.kind}", "refused")
         start = time.monotonic()
         sess = await self._sess()
+        params = {"peerId": src_peer_id, "pieceNum": str(piece_num)}
+        if tenant:
+            # QoS attribution: the serving daemon accounts and
+            # rate-splits by this tag (upload.py → qos.TenantBuckets).
+            params["tenant"] = qoslib.normalize_tenant(tenant)
         try:
             # The piece HTTP hop carries the caller's trace context so the
             # serving daemon's span joins the SAME trace (upload.py
             # extracts) — without it every pod download is N disconnected
             # traces, one per daemon.
-            async with sess.get(url, params={"peerId": src_peer_id,
-                                             "pieceNum": str(piece_num)},
+            async with sess.get(url, params=params,
                                 headers=tracing.inject()) as resp:
                 status_err = _upload_status_error(
                     resp.status, parent, f"piece {piece_num}")
@@ -375,7 +381,8 @@ class PieceDownloader:
                                       piece_num: int, store, *,
                                       src_peer_id: str = "",
                                       expected_size: int,
-                                      expected_digest: str = "") -> "object | None":
+                                      expected_digest: str = "",
+                                      tenant: str = "") -> "object | None":
         """Native fast path: land the piece straight into the store's data
         file (socket→crc32c→pwrite, GIL-free) and commit its record.
         Returns the PieceRecord, or None when this piece is ineligible (no
@@ -407,9 +414,13 @@ class PieceDownloader:
 
         if _unsafe_request_ids(task_id, src_peer_id):
             return None  # the aiohttp path quotes them safely
+        # normalize_tenant clamps to a splice-safe identifier charset —
+        # the tenant tag never widens the raw-head injection surface.
+        tenant_q = (f"&tenant={qoslib.normalize_tenant(tenant)}"
+                    if tenant else "")
         head = (
             f"GET /download/{task_id[:3]}/{task_id}"
-            f"?peerId={src_peer_id}&pieceNum={piece_num} HTTP/1.1\r\n"
+            f"?peerId={src_peer_id}&pieceNum={piece_num}{tenant_q} HTTP/1.1\r\n"
             f"Host: {parent_ip}:{parent_upload_port}\r\n"
             f"{_traceparent_line()}"
             "Accept-Encoding: identity\r\nConnection: keep-alive\r\n\r\n"
@@ -479,7 +490,8 @@ class PieceDownloader:
                                      run: list, store, *,
                                      src_peer_id: str = "",
                                      limiter=None,
-                                     on_result=None) -> "bool":
+                                     on_result=None,
+                                     tenant: str = "") -> "bool":
         """Coalesced native fast path: fetch a CONTIGUOUS run of pieces
         from one parent as a single ranged GET, the body streaming
         socket→crc32c→pwrite per piece on one connection — one request
@@ -526,9 +538,11 @@ class PieceDownloader:
 
         start = run[0].piece_num * piece_size
         total = sum(a.expected_size for a in run)
+        tenant_q = (f"&tenant={qoslib.normalize_tenant(tenant)}"
+                    if tenant else "")
         head = (
             f"GET /download/{task_id[:3]}/{task_id}"
-            f"?peerId={src_peer_id} HTTP/1.1\r\n"
+            f"?peerId={src_peer_id}{tenant_q} HTTP/1.1\r\n"
             f"Host: {parent_ip}:{parent_upload_port}\r\n"
             f"Range: bytes={start}-{start + total - 1}\r\n"
             f"{_traceparent_line()}"
@@ -659,7 +673,7 @@ def is_parent_gone(e: DfError) -> bool:
 
 async def pull_one_piece(downloader: PieceDownloader, store, dispatcher,
                          assignment, *, task_id: str, peer_id: str,
-                         limiter) -> "object":
+                         limiter, tenant: str = "") -> "object":
     """The shared piece-pull step: backfill store geometry from the
     dispatcher, rate-limit, fetch from the assigned parent, verify+write.
     Returns the PieceRecord; raises DfError on failure WITHOUT reporting to
@@ -681,14 +695,14 @@ async def pull_one_piece(downloader: PieceDownloader, store, dispatcher,
         assignment.parent.ip, assignment.parent.upload_port,
         task_id, assignment.piece_num, store,
         src_peer_id=peer_id, expected_size=assignment.expected_size,
-        expected_digest=assignment.digest)
+        expected_digest=assignment.digest, tenant=tenant)
     if rec is not None:
         return rec
     chunks, _size, cost_ms, received_digest = await downloader.download_piece(
         assignment.parent.ip, assignment.parent.upload_port,
         task_id, assignment.piece_num,
         src_peer_id=peer_id, expected_size=assignment.expected_size,
-        expected_digest=assignment.digest)
+        expected_digest=assignment.digest, tenant=tenant)
     # Thread offload: the write blocks on disk; inline it would stall the
     # event loop (and this daemon's own upload serving) per 4 MiB piece.
     # The chunks land via one pwritev (crc fused into the write, or

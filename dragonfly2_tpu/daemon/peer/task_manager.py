@@ -692,6 +692,11 @@ class TaskManager:
             header=spec.get("header") or {},
             filter="&".join(spec.get("filters") or []),
             range=norm_range,
+            # QoS: a triggered preheat keeps the triggering caller's
+            # tenant/priority so its pieces dispatch and account like
+            # any other pull of that tenant's.
+            priority=int(spec.get("priority", 3) or 3),
+            tenant=spec.get("tenant", ""),
         )
         # seed=False: run as a normal peer (persistent-cache replication —
         # the scheduler wants this host to PULL from peers, not re-seed from

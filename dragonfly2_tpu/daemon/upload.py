@@ -96,11 +96,17 @@ class _PieceFileResponse(web.FileResponse):
 
 class UploadManager:
     def __init__(self, storage: StorageManager, *, rate_limit: int = 0,
-                 concurrent_limit: int = 0, ssl_context=None):
+                 concurrent_limit: int = 0, ssl_context=None,
+                 qos_buckets=None):
         self.storage = storage
         self._ssl = ssl_context   # optional (m)TLS — reference WithTLS/certify
         self._rate_limit = rate_limit
         self.limiter = Limiter(rate_limit if rate_limit > 0 else float("inf"))
+        # Tenant QoS plane (dragonfly2_tpu/qos.TenantBuckets): when set,
+        # serve admission debits the requesting tenant's bucket instead
+        # of the flat daemon limiter, and every served byte lands in
+        # peer_upload_bytes_total{tenant}.
+        self.qos_buckets = qos_buckets
         self.concurrent_limit = concurrent_limit
         self.concurrent = 0
         self._runner: web.AppRunner | None = None
@@ -109,11 +115,13 @@ class UploadManager:
 
     def _native_eligible(self, host: str):
         """The C++ server (native/src/dfupload.cc) serves plaintext HTTP
-        only and has no token-bucket limiter: (m)TLS and rate-limited
-        configs stay on the aiohttp path. Returns the binding or None."""
+        only and has no token-bucket limiter: (m)TLS, rate-limited and
+        tenant-QoS configs stay on the aiohttp path (per-tenant limiting
+        and byte attribution live there). Returns the binding or None."""
         import ipaddress
 
-        if self._ssl is not None or self._rate_limit > 0:
+        if (self._ssl is not None or self._rate_limit > 0
+                or self.qos_buckets is not None):
             return None
         try:
             ipaddress.IPv4Address(host)
@@ -241,6 +249,12 @@ class UploadManager:
                     UPLOAD_REQUESTS.labels("piece_missing").inc()
                     raise web.HTTPRequestRangeNotSatisfiable()
                 start, length = rng.start, rng.length
+            if self.qos_buckets is not None:
+                # Per-tenant serve admission: the tenant's split of the
+                # daemon cap, plus byte attribution. The flat limiter
+                # still applies as the aggregate ceiling.
+                await self.qos_buckets.wait(
+                    request.query.get("tenant", ""), length)
             await self.limiter.wait(length)
             UPLOAD_BYTES.inc(length)
             UPLOAD_REQUESTS.labels("ok").inc()

@@ -87,14 +87,20 @@ class ManagerClient:
     # -- keepalive ---------------------------------------------------------
 
     def start_keepalive(self, *, source_type: str, hostname: str, ip: str,
-                        cluster_id: int, interval: float = 5.0) -> None:
+                        cluster_id: int, interval: float = 5.0,
+                        payload=None) -> None:
+        """``payload`` is an optional zero-arg callable whose dict return is
+        merged into every keepalive message — how schedulers piggyback the
+        per-tenant burn snapshot (dragonfly2_tpu/qos) without a second
+        stream or RPC."""
         if self._keepalive_task is None or self._keepalive_task.done():
             self._keepalive_task = asyncio.create_task(self._keepalive_loop(
                 source_type=source_type, hostname=hostname, ip=ip,
-                cluster_id=cluster_id, interval=interval))
+                cluster_id=cluster_id, interval=interval, payload=payload))
 
     async def _keepalive_loop(self, *, source_type: str, hostname: str, ip: str,
-                              cluster_id: int, interval: float) -> None:
+                              cluster_id: int, interval: float,
+                              payload=None) -> None:
         while True:
             try:
                 stream = await self._client.open_stream("Manager.KeepAlive", {
@@ -103,7 +109,16 @@ class ManagerClient:
                 try:
                     while True:
                         await asyncio.sleep(interval)
-                        await stream.send({"ts": asyncio.get_event_loop().time()})
+                        msg = {"ts": asyncio.get_event_loop().time()}
+                        if payload is not None:
+                            try:
+                                extra = payload()
+                                if isinstance(extra, dict):
+                                    msg.update(extra)
+                            except Exception as e:
+                                log.warning("keepalive payload provider "
+                                            "failed", error=str(e))
+                        await stream.send(msg)
                 finally:
                     await stream.close()
             except asyncio.CancelledError:

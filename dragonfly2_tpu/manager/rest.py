@@ -347,6 +347,24 @@ class RestServer:
                 {"message": f"malformed scheduler_cluster_ids: "
                             f"{body.get('scheduler_cluster_ids')!r}"},
                 status=400)
+        # Tenant burn-rate admission (dragonfly2_tpu/qos): a tenant whose
+        # completion SLOs are burning gets pushed back BEFORE debiting the
+        # shared job buckets — its surge degrades to client-side queueing
+        # instead of starving well-behaved tenants' budgets. No/stale burn
+        # data admits (fail open).
+        tenant = str(body.get("tenant") or args.get("tenant") or "")
+        admitted, qos_retry_after, detail = self.service.check_admission(tenant)
+        if not admitted:
+            import math
+
+            return web.json_response(
+                {"message": "tenant over burn-rate budget",
+                 "tenant": detail.get("tenant", tenant),
+                 "burn": detail.get("burn", 0.0),
+                 "retry_after_s": round(qos_retry_after, 3)},
+                status=429,
+                headers={"Retry-After":
+                         str(max(1, math.ceil(qos_retry_after)))})
         # Per-cluster job rate limit (reference
         # manager/middlewares/ratelimiter.go CreateJobRateLimiter → 429).
         # BEFORE the preheat expansion: image preheats fetch registry

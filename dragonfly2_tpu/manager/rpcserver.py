@@ -116,6 +116,10 @@ class ManagerRpcServer:
                 if msg is None:
                     break
                 self.service.keepalive(source_type, hostname, ip, cluster_id)
+                if isinstance(msg, dict) and msg.get("tenant_burn"):
+                    # Scheduler-piggybacked per-tenant burn snapshot
+                    # (dragonfly2_tpu/qos) feeding job admission.
+                    self.service.ingest_tenant_burn(msg["tenant_burn"])
         finally:
             self.service.mark_inactive(source_type, hostname, ip, cluster_id,
                                        gen=gen)

@@ -58,6 +58,14 @@ class ManagerService:
         # the way the reference's Redis bucket bounds its manager
         # replicas'. Keyed (rate, Limiter) so a config change rebuilds.
         self._job_limiters: dict[int, tuple[float, "Limiter"]] = {}
+        # Tenant burn-rate admission (dragonfly2_tpu/qos): schedulers
+        # piggyback their per-tenant burn snapshots on keepalives; job
+        # submission consults the merged view and 429s a burning tenant
+        # with a Retry-After. Stale views fail OPEN — a dead scheduler
+        # link must not become a job-submission outage.
+        from dragonfly2_tpu.qos import AdmissionController
+
+        self.admission = AdmissionController()
         self._ensure_defaults()
 
     def _ensure_defaults(self) -> None:
@@ -287,6 +295,25 @@ class ManagerService:
         row = self.db.find(table, hostname=hostname, ip=ip, **{key: cluster_id})
         if row:
             self.db.update(table, row["id"], {"state": INACTIVE})
+
+    # -- tenant QoS admission (dragonfly2_tpu/qos) ------------------------
+
+    def ingest_tenant_burn(self, snapshot: Any) -> int:
+        """Fold a scheduler's keepalive-piggybacked per-tenant burn
+        snapshot into the admission controller's merged view. Returns the
+        number of tenant entries applied (0 for malformed payloads —
+        keepalives keep flowing regardless)."""
+        if not isinstance(snapshot, dict):
+            return 0
+        try:
+            return self.admission.ingest(snapshot)
+        except Exception:
+            return 0
+
+    def check_admission(self, tenant: str) -> tuple[bool, float, dict]:
+        """(admitted, retry_after_s, detail) for a job submission by
+        ``tenant``. Fails open on no/stale data."""
+        return self.admission.check(tenant)
 
     def expire_stale(self) -> int:
         """Flip rows whose keepalive lapsed to inactive (GC task)."""

@@ -105,7 +105,8 @@ DECISION_COUNT = metrics.counter(
     "scheduler_decisions_total",
     "Scheduling decisions recorded in the fleet audit log, by kind "
     "(handout / quarantine / back_source / stripe_handout / "
-    "stripe_reshuffle / straggler_filter / schedule_failed)", ("kind",))
+    "stripe_reshuffle / straggler_filter / schedule_failed / "
+    "admission / throttle)", ("kind",))
 
 STRAGGLER_GAUGE = metrics.gauge(
     "fleet_straggler_hosts",
@@ -810,6 +811,27 @@ class FleetObservatory:
                              reason: str) -> None:
         self.decisions.record("schedule_failed", task=task, host=host,
                               peer=peer, reason=reason)
+
+    # -- tenant QoS plane (dragonfly2_tpu/qos) ----------------------------
+
+    def note_admission(self, tenant: str, *, decision: str,
+                       burn: float = 0.0, retry_after_s: float = 0.0,
+                       source: str = "") -> None:
+        """QoS admission verdict with the TENANT as subject (the ``host``
+        column — decision queries filter on it like any host id)."""
+        self.decisions.record(
+            "admission", host=tenant, peer=source,
+            reason=f"{decision} (burn={burn:.2f}"
+                   + (f", retry_after={retry_after_s:.1f}s" if retry_after_s
+                      else "") + ")")
+
+    def note_throttle(self, tenant: str, *, task_id: str = "",
+                      host_id: str = "", reason: str = "",
+                      limit: int = 0) -> None:
+        """QoS handout deprioritization of a budget-burning tenant."""
+        self.decisions.record(
+            "throttle", task=task_id, host=tenant, peer=host_id,
+            reason=reason + (f" (candidate_limit={limit})" if limit else ""))
 
     # -- read side ---------------------------------------------------------
 

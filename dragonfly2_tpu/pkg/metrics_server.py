@@ -326,7 +326,8 @@ class MetricsServer:
         """The scheduling decision audit log, newest first, filterable by
         ?host= / ?task= / ?kind= (handout, quarantine, back_source,
         stripe_handout, stripe_reshuffle, straggler_filter,
-        schedule_failed) and bounded in time by ?since=/?before= (wall
+        schedule_failed, admission, throttle — the QoS kinds carry the
+        TENANT as subject) and bounded in time by ?since=/?before= (wall
         seconds, half-open [since, before)). ?n= caps the page (hard cap
         4096); a page that hit the cap with more matching entries behind
         it carries ``truncated: true`` — page back with

@@ -126,6 +126,26 @@ DEFAULT_SLOS = (
 # need a scheduler's fleet series / completion feed).
 RUNTIME_SLOS = tuple(s for s in DEFAULT_SLOS if s.kind == "probe")
 
+# Per-tenant admission specs (qos.TenantBurnBook): the completion SLIs
+# re-cut per tenant with a fast window tuned to admission latency — a
+# tenant burning its budget should be throttled within a minute, not an
+# hour. Deployments override via TenantBurnBook(specs=...).
+TENANT_SLOS = (
+    SLOSpec("tenant_makespan", "completion", field="makespan_s",
+            threshold=60.0, objective=0.95,
+            windows=(60.0, 300.0), burn_thresholds=(14.4, 6.0),
+            description="per-tenant task completion wall time stays "
+                        "under 60 s for 95% of the tenant's completions "
+                        "— the admission ladder's primary signal"),
+    SLOSpec("tenant_stall", "completion", field="stall_frac",
+            threshold=0.25, objective=0.90,
+            windows=(60.0, 300.0), burn_thresholds=(8.0, 4.0),
+            description="per-tenant stall fraction stays under 25% of "
+                        "task wall for 90% of the tenant's completions "
+                        "(a tenant thrashing its parents burns here "
+                        "before it hurts makespan)"),
+)
+
 
 @dataclass
 class _WindowState:

@@ -20,6 +20,8 @@ class UrlMeta:
     header: dict[str, str] = field(default_factory=dict)
     application: str = ""
     priority: int = int(Priority.LEVEL3)
+    tenant: str = ""                   # QoS attribution tag (qos plane);
+                                       # NOT part of task identity
 
     def to_wire(self) -> dict[str, Any]:
         return {
@@ -30,6 +32,7 @@ class UrlMeta:
             "header": self.header,
             "application": self.application,
             "priority": self.priority,
+            "tenant": self.tenant,
         }
 
     @classmethod
@@ -43,6 +46,7 @@ class UrlMeta:
             header=d.get("header", {}) or {},
             application=d.get("application", ""),
             priority=d.get("priority", int(Priority.LEVEL3)),
+            tenant=d.get("tenant", ""),
         )
 
 

@@ -108,6 +108,10 @@ URL_META = Msg(
     "UrlMeta",
     digest=F(str), tag=F(str), range=F(str), filter=F(str),
     header=F(dict), application=F(str), priority=F(int),
+    # QoS attribution tag (dragonfly2_tpu/qos): rides with the request
+    # but stays OUT of task identity — two tenants pulling the same
+    # content share one task.
+    tenant=F(str),
 )
 
 PIECE = Msg(
@@ -249,7 +253,11 @@ UNARY: dict[str, Msg] = {
         range=F(str),
         # pod-wide preheat: register the triggered pull as a striped
         # slice broadcast (scheduler answers with a stripe plan)
-        pod_broadcast=F(bool)),
+        pod_broadcast=F(bool),
+        # QoS plane: the triggering caller's tenant tag + priority class
+        # carry into the seed task so preheats are attributable and
+        # dispatched fairly like any other pull
+        tenant=F(str), priority=F(int)),
     "Peer.StatTask": Msg("PeerStatTask", task_id=F(str, required=True)),
     "Peer.DeleteTask": Msg("PeerDeleteTask", task_id=F(str, required=True)),
 
@@ -306,6 +314,9 @@ STREAM_OPEN: dict[str, Msg] = {
         peer_id=F(str, required=True), task_id=F(str, required=True),
         url=F(str), tag=F(str), application=F(str), digest=F(str),
         filters=F(list, item=F(str)), header=F(dict), priority=F(int),
+        # QoS attribution tag — carried into the scheduler's Task so
+        # completions feed the per-tenant burn book (qos/admission)
+        tenant=F(str),
         range=F(str), is_seed=F(bool), disable_back_source=F(bool),
         # striped slice broadcast: the task fans to >=2 same-slice hosts;
         # the scheduler answers with a stripe plan (piece%S ownership)

@@ -1,0 +1,104 @@
+"""Tenant QoS plane: priority classes, weighted-fair dispatch, admission.
+
+ROADMAP item 2. The wire already carries ``application``/``priority``
+(proto/wire.py); this package gives those fields teeth:
+
+  * class semantics — the ``Priority`` ladder (pkg/types) folds into
+    three dispatch classes with DWRR weights (``class_of``/``weight_of``)
+    so an interactive checkpoint pull preempts a bulk dataset sweep
+    without starving it;
+  * ``qos/wfq.py`` — deficit-weighted-round-robin dispatch gate adopted
+    by the daemon's piece workers, plus per-tenant token buckets under
+    the daemon-wide upload cap;
+  * ``qos/admission.py`` — per-tenant burn-rate bookkeeping (specs in
+    ``pkg/slo.TENANT_SLOS``) feeding manager-side admission control:
+    a tenant burning its error budget is 429'd with Retry-After at job
+    submission and deprioritized at handout, so surge load degrades to
+    queueing, never collapse.
+
+Tenant identity rides the wire as a ``tenant`` tag on ``Daemon.Download``
+/ ``Peer.TriggerDownloadTask`` meta and the announce open body, and as a
+``tenant=`` query param on piece upstream requests so every served byte
+is attributable (``peer_upload_bytes_total{tenant}``).
+"""
+
+from __future__ import annotations
+
+import re
+
+from dragonfly2_tpu.pkg.types import Priority
+
+# The anonymous tenant every un-tagged request folds into. Keeping it a
+# real label (not "") means metrics and decision logs always have a
+# subject.
+DEFAULT_TENANT = "default"
+
+# Tenant tags splice into native HTTP request heads verbatim
+# (daemon/peer/piece_downloader raw-head fast path), so the charset is
+# restricted the same way _unsafe_request_ids treats ids: no CR/LF, no
+# separators, nothing outside a boring identifier alphabet.
+_TENANT_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+_TENANT_STRIP = re.compile(r"[^A-Za-z0-9._-]+")
+
+# Dispatch classes, highest weight first — the DWRR visit order. The
+# 16:4:1 ratio keeps background flows live (no starvation) while an
+# interactive pull sees ~3/4 of contended dispatch capacity.
+CLASSES = ("interactive", "normal", "background")
+WEIGHTS = {"interactive": 16, "normal": 4, "background": 1}
+
+
+def normalize_tenant(tenant: str | None) -> str:
+    """Clamp a wire-supplied tenant tag to the safe identifier charset.
+
+    Empty/None folds to DEFAULT_TENANT; tags with unsafe characters are
+    stripped to their safe subset (and fold to DEFAULT_TENANT when
+    nothing survives) rather than rejected — attribution should degrade,
+    not drop bytes on the floor.
+    """
+    if not tenant:
+        return DEFAULT_TENANT
+    if _TENANT_RE.match(tenant):
+        return tenant
+    cleaned = _TENANT_STRIP.sub("", tenant)[:64].lstrip("._-")
+    return cleaned or DEFAULT_TENANT
+
+
+def class_of(priority: int) -> str:
+    """Fold the 0-6 Priority ladder into a dispatch class.
+
+    LEVEL5/6 -> interactive, LEVEL3/4 -> normal, everything at or below
+    LEVEL2 (including the forbidden/unknown floor) -> background.
+    """
+    try:
+        p = int(priority)
+    except (TypeError, ValueError):
+        p = int(Priority.LEVEL3)
+    if p >= int(Priority.LEVEL5):
+        return "interactive"
+    if p >= int(Priority.LEVEL3):
+        return "normal"
+    return "background"
+
+
+def weight_of(priority: int) -> int:
+    return WEIGHTS[class_of(priority)]
+
+
+from dragonfly2_tpu.qos.admission import (  # noqa: E402
+    AdmissionController,
+    TenantBurnBook,
+)
+from dragonfly2_tpu.qos.wfq import TenantBuckets, WFQGate  # noqa: E402
+
+__all__ = [
+    "AdmissionController",
+    "CLASSES",
+    "DEFAULT_TENANT",
+    "TenantBuckets",
+    "TenantBurnBook",
+    "WEIGHTS",
+    "WFQGate",
+    "class_of",
+    "normalize_tenant",
+    "weight_of",
+]

@@ -1,0 +1,190 @@
+"""Deficit-weighted-round-robin dispatch and per-tenant upload buckets.
+
+Two mechanisms, both built on the package's class semantics:
+
+``WFQGate`` — a capacity-bounded async admission gate the daemon's piece
+workers pass through before issuing a piece request. Under a single task
+the gate never binds (capacity defaults to 2x the per-task parent
+concurrency); when several tasks contend, freed slots are handed out in
+deficit-weighted-round-robin order across the three dispatch classes, so
+an interactive pull's requests jump the line ahead of a background
+sweep's without starving it (DWRR: Shreedhar & Varghese '95 — each
+class accrues ``quantum * weight`` credit per visit and dequeues while
+its deficit covers the next item's cost; unit cost here, one slot per
+piece request).
+
+``TenantBuckets`` — the serve-side counterpart: the daemon-wide upload
+rate cap split into per-tenant token buckets (the traffic shaper's
+re-split idiom, ``MIN_SHARE_FRACTION`` floor), so one tenant's bulk
+serve cannot monopolize the cap. With an unlimited cap the buckets
+degrade to pure accounting — ``peer_upload_bytes_total{tenant}`` — which
+is what makes every served byte attributable.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import deque
+
+from dragonfly2_tpu.pkg import metrics
+from dragonfly2_tpu.pkg.ratelimit import INF, Limiter
+from dragonfly2_tpu import qos
+
+QUEUE_DEPTH = metrics.gauge(
+    "peer_qos_queue_depth",
+    "Piece-dispatch requests queued behind the WFQ gate per dispatch "
+    "class (nonzero only under cross-task contention)",
+    ("class",))
+
+GRANTS = metrics.counter(
+    "qos_wfq_grants_total",
+    "Dispatch slots granted by the WFQ gate per dispatch class",
+    ("class",))
+
+TENANT_UPLOAD_BYTES = metrics.counter(
+    "peer_upload_bytes_total",
+    "Piece bytes served to other peers, attributed to the requesting "
+    "tenant (the qos TenantBuckets accounting plane)",
+    ("tenant",))
+
+
+class WFQGate:
+    """Async DWRR admission gate over dispatch classes.
+
+    ``acquire(priority)`` takes one of ``capacity`` slots, blocking in
+    class-fair order when all are busy; ``release()`` frees the slot and
+    wakes the next waiter per DWRR. Cancellation-safe: a cancelled
+    waiter leaves the queue (or re-releases if the grant raced the
+    cancel), mirroring Limiter's reservation-return discipline.
+    """
+
+    def __init__(self, capacity: int = 8, *, quantum: float = 1.0):
+        self.capacity = max(1, int(capacity))
+        self.quantum = float(quantum)
+        self._active = 0
+        self._queues: dict[str, deque] = {c: deque() for c in qos.CLASSES}
+        self._deficit: dict[str, float] = {c: 0.0 for c in qos.CLASSES}
+        self._grants = {c: GRANTS.labels(c) for c in qos.CLASSES}
+        self._depth = {c: QUEUE_DEPTH.labels(c) for c in qos.CLASSES}
+
+    @property
+    def active(self) -> int:
+        return self._active
+
+    def queued(self) -> dict[str, int]:
+        return {c: len(q) for c, q in self._queues.items()}
+
+    async def acquire(self, priority: int) -> None:
+        cls = qos.class_of(priority)
+        if self._active < self.capacity and not any(
+                self._queues[c] for c in qos.CLASSES):
+            self._active += 1
+            self._grants[cls].inc()
+            return
+        fut = asyncio.get_event_loop().create_future()
+        self._queues[cls].append(fut)
+        self._depth[cls].set(len(self._queues[cls]))
+        try:
+            await fut
+        except asyncio.CancelledError:
+            if fut.cancelled() or not fut.done():
+                try:
+                    self._queues[cls].remove(fut)
+                except ValueError:
+                    pass
+                self._depth[cls].set(len(self._queues[cls]))
+            else:
+                # Grant landed before the cancel did: hand the slot on.
+                self.release()
+            raise
+        self._grants[cls].inc()
+
+    def release(self) -> None:
+        self._active = max(0, self._active - 1)
+        self._dispatch()
+
+    def _dispatch(self) -> None:
+        # One DWRR sweep per free slot batch: visit classes highest
+        # weight first, credit quantum*weight, dequeue while the deficit
+        # covers unit cost. An emptied class forfeits leftover credit
+        # (standard DWRR — idle classes must not bank priority).
+        while self._active < self.capacity:
+            granted = False
+            for cls in qos.CLASSES:
+                q = self._queues[cls]
+                if not q:
+                    self._deficit[cls] = 0.0
+                    continue
+                self._deficit[cls] += self.quantum * qos.WEIGHTS[cls]
+                while (q and self._deficit[cls] >= 1.0
+                       and self._active < self.capacity):
+                    fut = q.popleft()
+                    if fut.done():        # cancelled while queued
+                        continue
+                    self._deficit[cls] -= 1.0
+                    self._active += 1
+                    fut.set_result(None)
+                    granted = True
+                self._depth[cls].set(len(q))
+                if not q:
+                    self._deficit[cls] = 0.0
+            if not granted:
+                break
+
+
+class TenantBuckets:
+    """Per-tenant token buckets re-split under one daemon-wide cap.
+
+    Every tenant's first serve allocates its bucket and re-splits the
+    cap evenly across active tenants, floored at ``min_share_fraction``
+    of the total (the traffic shaper's per-task idiom). ``wait`` debits
+    the tenant's bucket and attributes the bytes to
+    ``peer_upload_bytes_total{tenant}``.
+    """
+
+    def __init__(self, total_rate: float = INF, *,
+                 min_share_fraction: float = 0.1, max_tenants: int = 256):
+        self.total_rate = total_rate if total_rate and total_rate > 0 else INF
+        self.min_share_fraction = min_share_fraction
+        self.max_tenants = max_tenants
+        self._buckets: dict[str, Limiter] = {}
+        self._bytes = {}
+
+    def _resplit(self) -> None:
+        if not self._buckets:
+            return
+        if self.total_rate == INF:
+            share = INF
+        else:
+            share = max(self.total_rate / len(self._buckets),
+                        self.total_rate * self.min_share_fraction)
+        for bucket in self._buckets.values():
+            bucket.set_limit(share)
+
+    def bucket(self, tenant: str) -> Limiter:
+        t = qos.normalize_tenant(tenant)
+        b = self._buckets.get(t)
+        if b is None:
+            if len(self._buckets) >= self.max_tenants:
+                # Cardinality backstop: overflow tenants share the
+                # default bucket rather than growing without bound.
+                t = qos.DEFAULT_TENANT
+                b = self._buckets.get(t)
+                if b is not None:
+                    return b
+            b = self._buckets[t] = Limiter(INF)
+            self._resplit()
+        return b
+
+    async def wait(self, tenant: str, n: int) -> float:
+        t = qos.normalize_tenant(tenant)
+        waited = await self.bucket(t).wait(n)
+        counter = self._bytes.get(t)
+        if counter is None:
+            counter = self._bytes[t] = TENANT_UPLOAD_BYTES.labels(t)
+        counter.inc(n)
+        return waited
+
+    def shares(self) -> dict[str, float]:
+        """Current per-tenant rate allocation (debug/tests)."""
+        return {t: b.limit for t, b in self._buckets.items()}

@@ -18,7 +18,8 @@ log = dflog.get("scheduler.announcer")
 class SchedulerAnnouncer:
     def __init__(self, manager_addr: str, *, cluster_id: int, port: int,
                  ip: str = "", hostname: str = "", idc: str = "",
-                 location: str = "", keepalive_interval: float = 5.0):
+                 location: str = "", keepalive_interval: float = 5.0,
+                 qos_payload=None):
         host, _, mport = manager_addr.rpartition(":")
         self.client = ManagerClient(NetAddr.tcp(host, int(mport)))
         self.cluster_id = cluster_id
@@ -28,6 +29,10 @@ class SchedulerAnnouncer:
         self.idc = idc
         self.location = location
         self.keepalive_interval = keepalive_interval
+        # Zero-arg callable returning {"tenant_burn": {...}} (or any dict)
+        # to piggyback on keepalives — the scheduler passes the tenant
+        # burn-book snapshot so manager job admission sees fresh burn.
+        self.qos_payload = qos_payload
         self.registered: dict | None = None
 
     async def start(self) -> dict:
@@ -38,7 +43,7 @@ class SchedulerAnnouncer:
         self.client.start_keepalive(
             source_type="scheduler", hostname=self.hostname, ip=self.ip,
             cluster_id=self.registered["scheduler_cluster_id"],
-            interval=self.keepalive_interval)
+            interval=self.keepalive_interval, payload=self.qos_payload)
         log.info("registered with manager", id=self.registered["id"],
                  cluster=self.registered["scheduler_cluster_id"])
         return self.registered

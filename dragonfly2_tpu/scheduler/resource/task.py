@@ -37,11 +37,16 @@ class Task:
     def __init__(self, task_id: str, url: str = "", *, tag: str = "", application: str = "",
                  digest: str = "", filtered_query_params: list[str] | None = None,
                  header: dict | None = None, back_to_source_limit: int = 200,
-                 range_header: str = ""):
+                 range_header: str = "", tenant: str = ""):
         self.id = task_id
         self.url = url
         self.tag = tag
         self.application = application
+        # QoS attribution tag (dragonfly2_tpu/qos): who this content is
+        # being pulled FOR. Not part of task identity — two tenants
+        # pulling the same content share the task; the first registrant's
+        # tenant wins attribution (later ones backfill an empty tag).
+        self.tenant = tenant
         self.digest = digest
         self.filtered_query_params = filtered_query_params or []
         self.header = header or {}
@@ -206,6 +211,7 @@ class Task:
             "url": self.url,
             "tag": self.tag,
             "application": self.application,
+            "tenant": self.tenant,
             "state": self.state,
             "content_length": self.content_length,
             "piece_size": self.piece_size,

@@ -57,11 +57,20 @@ class Scheduling:
         self._scorecards = None
         self._stragglers: "set[str] | None" = None
         self._recompute_tick = 63   # first attempt after wiring recomputes
+        # QoS admission hook (dragonfly2_tpu/qos): callable returning the
+        # set of tenants currently burning past their error budget. A
+        # throttled tenant's handouts shrink to half the candidate limit
+        # (min 1) — it keeps making progress but stops fanning wide while
+        # it burns. Wired by the service alongside the burn book.
+        self._throttled_tenants = None
 
     def wire_fleet(self, fleet) -> None:
         self.fleet = fleet
         self._scorecards = fleet.scorecards
         self._stragglers = fleet.scorecards._stragglers
+
+    def wire_qos(self, throttled_fn) -> None:
+        self._throttled_tenants = throttled_fn
 
     # -- v2-style scheduling (reference :85-213) ---------------------------
 
@@ -185,7 +194,19 @@ class Scheduling:
             # builds the broadcast tree — ~1 DCN ingress per slice, ICI
             # fan-out inside — that the pod-sim's intra_slice_frac gauges.
             ranked.sort(key=lambda p: p.host.tpu_slice != my_slice)
-        out = ranked[: self.config.candidate_parent_limit]
+        limit = self.config.candidate_parent_limit
+        if self._throttled_tenants is not None and task.tenant:
+            throttled = self._throttled_tenants()
+            if throttled and task.tenant in throttled:
+                # Burn-rate deprioritization: the throttled tenant's
+                # handouts narrow instead of vanishing — admission at the
+                # manager stops NEW work, this bounds in-flight fan-out.
+                limit = max(1, limit // 2)
+                if self.fleet is not None:
+                    self.fleet.note_throttle(
+                        task.tenant, task_id=task.id, host_id=peer.host.id,
+                        reason="burn_rate_handout", limit=limit)
+        out = ranked[:limit]
         # A handout must contain ≥1 parent that serves NOW (succeeded,
         # piece-holding, or back-sourcing). Warming slice-mates may fill
         # the list in a registration storm, and a handout of only those
@@ -194,7 +215,7 @@ class Scheduling:
         if out and all(p.fsm.current == PeerState.RUNNING
                        and p.finished_piece_count() == 0 for p in out):
             serving = next(
-                (p for p in ranked[self.config.candidate_parent_limit:]
+                (p for p in ranked[limit:]
                  if p.fsm.current != PeerState.RUNNING
                  or p.finished_piece_count() > 0), None)
             if serving is not None:

@@ -150,7 +150,8 @@ class SchedulerServer:
 
         self.announcer = SchedulerAnnouncer(
             self.config.manager_addr, cluster_id=self.config.cluster_id,
-            port=self.port(), ip=self.config.server.advertise_ip or "127.0.0.1")
+            port=self.port(), ip=self.config.server.advertise_ip or "127.0.0.1",
+            qos_payload=self.service.tenant_burn_payload)
         await self.announcer.start()
         self.dynconfig = SchedulerDynconfig(
             self.announcer.client,

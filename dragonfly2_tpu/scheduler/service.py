@@ -158,6 +158,16 @@ class SchedulerService:
             self.slo = slolib.SLOEngine(
                 series=self.fleet.series if self.fleet else None,
                 max_completions=plc.max_completions)
+        # Tenant QoS plane (dragonfly2_tpu/qos): per-tenant burn-rate book
+        # fed from shipped flights; its snapshot rides the manager
+        # keepalive so job admission can push back on a burning tenant.
+        # Always on — it is a handful of bounded deques, and handout
+        # deprioritization should not depend on the pod lens being up.
+        from dragonfly2_tpu.qos import TenantBurnBook
+
+        self.tenant_burn = TenantBurnBook()
+        self.scheduling.wire_qos(self.tenant_burn.throttled)
+        self._tenant_admission_state: dict[str, str] = {}
         # Scheduler HA (crash recovery): durable bounded snapshot of live
         # task/peer/host state, restored at boot so a restarted scheduler
         # serves correct parent sets and stripe plans before every host
@@ -175,6 +185,27 @@ class SchedulerService:
             restored = self.restore_from_snapshot()
             if restored:
                 log.info("state restored from snapshot", **restored)
+
+    def tenant_burn_payload(self) -> dict:
+        """Keepalive piggyback for the manager's admission controller:
+        {"tenant_burn": {tenant: {burn, state, completions}}}. Breach
+        transitions (either direction) are recorded in the fleet decision
+        log as ``admission`` decisions with the TENANT as subject —
+        transition-only, so the log stays bounded while /debug/fleet/
+        decisions?kind=admission shows when and why each tenant's jobs
+        started (and stopped) being pushed back."""
+        snap = self.tenant_burn.snapshot()
+        for tenant, info in snap.items():
+            prev = self._tenant_admission_state.get(tenant)
+            state = info["state"]
+            if state != prev and "breach" in (state, prev):
+                if self.fleet is not None:
+                    self.fleet.note_admission(
+                        tenant,
+                        decision="deny" if state == "breach" else "restore",
+                        burn=info["burn"], source="burn_book")
+            self._tenant_admission_state[tenant] = state
+        return {"tenant_burn": snap}
 
     def _fleet_gauges(self) -> dict:
         """Gauge sample for the fleet time-series. O(hosts+peers+tasks)
@@ -314,6 +345,11 @@ class SchedulerService:
             # Backfill: a later registrant may know the content digest the
             # first one didn't — it guards the tiny inline-content cache.
             task_for_digest.digest = open_body["digest"]
+        if (task_for_digest is not None and not task_for_digest.tenant
+                and open_body.get("tenant")):
+            # Same backfill posture for the QoS attribution tag: the first
+            # registrant's tenant wins, later ones fill an empty slot.
+            task_for_digest.tenant = open_body["tenant"]
 
         task = self.tasks.load_or_store(
             Task(
@@ -326,6 +362,7 @@ class SchedulerService:
                 header=open_body.get("header") or {},
                 back_to_source_limit=self.config.scheduling.back_to_source_count,
                 range_header=open_body.get("range", ""),
+                tenant=open_body.get("tenant", ""),
             )
         )
         stale = self.peers.load(open_body["peer_id"])
@@ -808,6 +845,8 @@ class SchedulerService:
                 "filters": task.filtered_query_params,
                 "header": task.header,
                 "range": task.range_header,
+                "tenant": task.tenant,
+                "priority": requesting_peer.priority,
             },
         )
         if ok:
@@ -1008,14 +1047,20 @@ class SchedulerService:
         if self.pod_lens is not None:
             self.pod_lens.note_flight(task.id, peer.host.id, fl,
                                       peer_id=peer.id)
-        if self.slo is not None and fl.get("state") != "failed" \
+        if fl.get("state") != "failed" \
                 and msg.get("type", "download_finished") \
                 != "download_failed":
             makespan, ttfb, stall_frac = podlenslib.completion_stats(fl)
             if makespan > 0:
-                self.slo.note_completion(peer.host.id, makespan,
-                                         ttfb_s=ttfb,
-                                         stall_frac=stall_frac)
+                if self.slo is not None:
+                    self.slo.note_completion(peer.host.id, makespan,
+                                             ttfb_s=ttfb,
+                                             stall_frac=stall_frac)
+                # Per-tenant burn book: same completion, attributed to the
+                # task's tenant instead of the host.
+                self.tenant_burn.note_completion(task.tenant, makespan,
+                                                ttfb_s=ttfb,
+                                                stall_frac=stall_frac)
 
     def _handle_download_finished(self, msg: dict, task: Task, peer: Peer) -> None:
         self._note_shipped_flight(msg, task, peer)

@@ -33,6 +33,7 @@ REQUIRED_CONFIGS = (
     "config10_podlens",
     "config11_delta",
     "config12_prof",
+    "config13_qos",
     "ingest_micro",
 )
 
@@ -363,6 +364,53 @@ def test_delta_entry_paired_shape():
     assert entry["chunking"]["chunker_backend"] in \
         ("native", "numpy", "python")
     assert entry["chunking"]["chunk_mb_s"] > 0
+
+
+def test_qos_entry_paired_shape():
+    """config13_qos is the QoS plane's three-round evidence: wfq is a
+    PAIRED run (interactive pull p99 contended vs uncontended through
+    the DWRR gate, order-alternating rounds, headline = median of
+    per-pair ratios, bound <= 1.2x) with the background sweep provably
+    not starved; surge pins bounded queueing under a 10x admission
+    surge with zero collateral denials and completion 1.0; the upload
+    round pins EXACT per-tenant byte accounting."""
+    entry = _load()["published"]["config13_qos"]
+    wfq = entry["wfq"]
+    assert wfq["contended_p99_ms"] > 0 and wfq["uncontended_p99_ms"] > 0
+    assert wfq["bg_workers"] > wfq["gate_capacity"], \
+        "the sweep must oversubscribe the gate or nothing contends"
+    assert wfq["bg_queue_peak"] > 0, "contention never materialized"
+    # Recompute the headline from the published per-pair ratios — the
+    # config9 estimator (order-alternating rounds, even count).
+    ratios = sorted(wfq["pair_ratios"])
+    assert len(ratios) == wfq["rounds"] and len(ratios) % 2 == 0
+    median = (ratios[len(ratios) // 2 - 1] + ratios[len(ratios) // 2]) / 2
+    assert wfq["p99_ratio"] == pytest.approx(median, abs=1e-3)
+    assert wfq["p99_ratio"] <= 1.2, wfq
+    # Work conservation: isolation must not come from starving the
+    # background class (a priority mutex would also pass the p99 bound).
+    assert wfq["bg_pieces_per_s"] > 0
+
+    surge = entry["surge"]
+    assert surge["surge_x"] >= 10
+    assert surge["denied_429"] > 0, "the surge never tripped admission"
+    assert surge["well_behaved_denied"] == 0, surge
+    assert surge["max_queue_admission_on"] <= \
+        0.5 * surge["max_queue_admission_off"], surge
+    assert surge["queue_bound_frac"] == pytest.approx(
+        surge["max_queue_admission_on"]
+        / surge["max_queue_admission_off"], abs=1e-3)
+    assert surge["completion_rate"] == 1.0
+    lo, hi = surge["retry_after_range_s"]
+    assert 0 < lo <= hi <= 30.0, "Retry-After outside the ladder's cap"
+
+    acct = entry["upload_accounting"]
+    assert acct["exact"] is True
+    assert set(acct["expected_bytes"]) == set(acct["metric_bytes"])
+    assert len(acct["expected_bytes"]) >= 2, "need >=2 tenants to prove split"
+    for tenant, want in acct["expected_bytes"].items():
+        assert want > 0
+        assert acct["metric_bytes"][tenant] == want, (tenant, acct)
 
 
 def test_stripe_sim_meets_acceptance_bounds():

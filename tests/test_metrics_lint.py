@@ -37,6 +37,8 @@ METRIC_MODULES = (
     "dragonfly2_tpu.daemon.peer.task_manager",
     "dragonfly2_tpu.daemon.peer.device_sink",
     "dragonfly2_tpu.scheduler.service",
+    "dragonfly2_tpu.qos.wfq",
+    "dragonfly2_tpu.qos.admission",
     "dragonfly2_tpu.delta.chunker",
     "dragonfly2_tpu.delta.manifest",
     "dragonfly2_tpu.delta.resolver",
@@ -50,7 +52,7 @@ METRIC_MODULES = (
 # The documented component vocabulary (docs/OBSERVABILITY.md "Metric
 # families"). Adding a component means documenting it there first.
 COMPONENTS = ("bufpool", "chaos", "dataset", "delta", "device_sink",
-              "fleet", "objectstorage", "peer", "proxy", "runtime",
+              "fleet", "objectstorage", "peer", "proxy", "qos", "runtime",
               "scheduler", "storage", "tracing", "upload")
 
 # Histogram families must name their unit; counters use _total; gauges

@@ -1,0 +1,153 @@
+"""pkg/ratelimit Limiter edge cases: unlimited/zero rates, burst
+exhaustion + refill timing, oversized requests, cancellation refunds,
+and FIFO fairness under concurrent acquires.
+
+The reservation model under test (mirrors golang.org/x/time/rate):
+tokens go NEGATIVE when a waiter reserves ahead of refill, the lock is
+held through the maturation sleep (that is what makes waiters FIFO),
+and a cancelled waiter returns its reservation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from dragonfly2_tpu.pkg.ratelimit import INF, Limiter
+
+
+# -- unlimited -------------------------------------------------------------
+
+def test_unlimited_never_waits(run_async):
+    async def body():
+        lim = Limiter(INF)
+        assert lim.limit == INF
+        for n in (1, 1 << 40):
+            assert await lim.wait(n) == 0.0
+            assert lim.allow(n)
+
+    run_async(body())
+
+
+def test_unlimited_can_allow_is_non_mutating():
+    lim = Limiter(INF)
+    for _ in range(3):
+        assert lim.can_allow(1 << 50)
+    assert lim.allow(1 << 50)  # nothing was debited by the checks
+
+
+# -- zero limit: park until resumed ---------------------------------------
+
+def test_zero_limit_parks_until_set_limit_resumes(run_async):
+    async def body():
+        lim = Limiter(0.0, burst=4)
+        woke = asyncio.Event()
+
+        async def waiter():
+            await lim.wait(1)
+            woke.set()
+
+        t = asyncio.create_task(waiter())
+        await asyncio.sleep(0.05)
+        assert not woke.is_set(), "limit=0 must park the waiter"
+        lim.set_limit(1000.0)
+        await asyncio.wait_for(woke.wait(), 2.0)
+        await t
+
+    run_async(body())
+
+
+def test_zero_limit_allow_denies_after_burst_drains():
+    # allow() still spends the initial burst; refill rate 0 never tops up.
+    lim = Limiter(0.0, burst=2)
+    assert lim.allow(2)
+    assert not lim.allow(1)
+    assert not lim.can_allow(1)
+
+
+# -- burst exhaustion + refill timing --------------------------------------
+
+def test_burst_exhaustion_then_timed_refill(run_async):
+    async def body():
+        # 100 tokens/s, bucket 10: draining the bucket is free; the next
+        # 10-token take must wait ~0.1s for the refill.
+        lim = Limiter(100.0, burst=10)
+        assert await lim.wait(10) == pytest.approx(0.0, abs=1e-3)
+        waited = await lim.wait(10)
+        assert 0.05 <= waited <= 0.5, f"expected ~0.1s refill, got {waited}"
+
+    run_async(body())
+
+
+def test_allow_recovers_after_refill_interval(run_async):
+    async def body():
+        lim = Limiter(200.0, burst=10)
+        assert lim.allow(10)
+        assert not lim.allow(10)
+        await asyncio.sleep(0.1)  # 200/s * 0.1s = 20 >= bucket (10): full
+        assert lim.allow(10)
+
+    run_async(body())
+
+
+def test_wait_larger_than_burst_chunks_instead_of_deadlocking(run_async):
+    async def body():
+        # n > burst would never fit the bucket at once: wait() pays across
+        # multiple fills. 30 tokens at 300/s from a 10-bucket ~= 20/300s.
+        lim = Limiter(300.0, burst=10)
+        waited = await asyncio.wait_for(lim.wait(30), 5.0)
+        assert waited >= 0.03
+
+    run_async(body())
+
+
+def test_cancelled_waiter_returns_reservation(run_async):
+    async def body():
+        lim = Limiter(10.0, burst=10)
+        assert lim.allow(10)  # drain
+
+        t = asyncio.create_task(lim.wait(10))  # reserve -> tokens negative
+        await asyncio.sleep(0.05)
+        t.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await t
+        # The refund plus ~1s of refill must make 10 tokens available in
+        # ~1s; without the refund this would take ~2s.
+        waited = await asyncio.wait_for(lim.wait(10), 5.0)
+        assert waited <= 1.5
+
+    run_async(body())
+
+
+# -- concurrent-acquire fairness -------------------------------------------
+
+def test_concurrent_waiters_complete_fifo(run_async):
+    async def body():
+        # Lock-held-through-sleep means grant order == arrival order even
+        # though every reservation matures at a different instant.
+        lim = Limiter(200.0, burst=10)
+        assert lim.allow(10)  # start everyone from an empty bucket
+        order: list[int] = []
+
+        async def worker(i: int) -> None:
+            await lim.wait(5)
+            order.append(i)
+
+        tasks = []
+        for i in range(6):
+            tasks.append(asyncio.create_task(worker(i)))
+            await asyncio.sleep(0.005)  # deterministic arrival order
+        await asyncio.wait_for(asyncio.gather(*tasks), 10.0)
+        assert order == sorted(order), f"grants out of order: {order}"
+
+    run_async(body())
+
+
+def test_set_limit_rescales_bucket_and_clamps_tokens():
+    lim = Limiter(1000.0, burst=100)
+    # Shrink: tokens must clamp to the new bucket, denying a burst the
+    # old bucket would have allowed.
+    lim.set_limit(10.0)
+    assert not lim.allow(50)
+    assert lim.allow(10)
