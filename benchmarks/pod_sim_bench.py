@@ -43,6 +43,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from dragonfly2_tpu.proto import reportcodec  # noqa: E402
 from dragonfly2_tpu.scheduler.config import SchedulerConfig  # noqa: E402
 from dragonfly2_tpu.scheduler.service import SchedulerService  # noqa: E402
 
@@ -94,12 +95,13 @@ def _open_body(i: int) -> dict:
 
 
 async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
-                  arrival_window_s: float = 1.0,
+                  arrival_window_s: "float | None" = None,
                   churn: bool = False, churn_waves: int = 1,
                   gc_ttl_s: float = 1.0, fleet: bool = True,
                   report_batch: int = 1, podlens: bool = False,
                   ship_digests: "bool | None" = None,
-                  restart: bool = False, prof: bool = False) -> dict:
+                  restart: bool = False, prof: bool = False,
+                  packed_wire: bool = False) -> dict:
     """``churn=True`` kills whole slices mid-fan-out (their peers' streams
     drop after a few pieces, no finish) and sends straggler waves into the
     SAME slices late — ``churn_waves`` slices die at staggered times, so
@@ -108,6 +110,8 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
     pieces), no straggler is handed a dead parent, ICI locality holds on
     the healthy slices, and after the run the TTL GC drains every
     registry."""
+    if arrival_window_s is None:
+        arrival_window_s = 1.0
     rng = random.Random(11)
     cfg = SchedulerConfig()
     cfg.scheduling.retry_interval = 0.05
@@ -166,6 +170,7 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
     killed_slice_names = {f"slice-{k}" for k in killed_slice_ids}
 
     origin_fetches = 0
+    sched_client_retries = 0
     schedule_lat: list[float] = []
     parent_picks = {"intra": 0, "cross": 0}
     healthy_picks = {"intra": 0, "cross": 0}
@@ -186,6 +191,15 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
     rss_start = _rss_mb()
 
     lag_samples: list[float] = []
+    # (monotonic stamp, observed elapsed, lag) per heartbeat tick — the
+    # feed for the loop_lag SLO probe below (pkg/slo kind="probe":
+    # wedged wall-seconds over observed wall-seconds in a window).
+    slo_ticks: list[tuple] = []
+    # Announce-plane ingest events: every message a peer puts on the
+    # wire toward the scheduler (registers, piece reports, terminals).
+    # cpu_s / events is the flat-per-event scaling metric the 16k run
+    # is held to (<= 1.15x the 4k run's per-event cost).
+    events = 0
 
     async def heartbeat():
         nonlocal max_lag
@@ -196,10 +210,41 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
             lag = loop.time() - t0 - 0.01
             max_lag = max(max_lag, lag)
             lag_samples.append(lag)
+            slo_ticks.append((loop.time(), 0.01 + lag, lag))
+
+    def loop_lag_probe(window: float, threshold: float):
+        """pkg/slo probe: (wedged seconds, observed seconds) within the
+        trailing window — heartbeat-fed, same contract as the runtime
+        observatory's prof probe."""
+        now = slo_ticks[-1][0] if slo_ticks else 0.0
+        bad = total = 0.0
+        for t, elapsed, lag in reversed(slo_ticks):
+            if now - t > window:
+                break
+            total += elapsed
+            if lag > threshold:
+                bad += lag
+        return bad, total
+
+    async def put(stream, msg):
+        nonlocal events
+        events += 1
+        await stream.to_sched.put(msg)
+
+    def batch_wire(pending: list) -> dict:
+        """The coalesced report message: the packed columnar form when
+        ``packed_wire`` (what a conductor sends after negotiating
+        ``packed_reports``), else the legacy dict list."""
+        if packed_wire:
+            packed = reportcodec.encode_reports(pending)
+            if packed is not None:
+                return {"type": "pieces_finished", "packed": packed}
+        return {"type": "pieces_finished", "pieces": pending}
 
     async def peer(i: int, *, die_after: int = -1,
                    straggler_into: int = -1):
-        nonlocal origin_fetches, straggler_dead_picks, \
+        nonlocal origin_fetches, sched_client_retries, \
+            straggler_dead_picks, \
             straggler_stale_ghost_picks, straggler_pick_count
         my_slice = f"slice-{(i // HOSTS_PER_SLICE) % n_slices}"
         body = _open_body(i)
@@ -214,12 +259,40 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
         server = asyncio.ensure_future(_serve(svc_box["svc"], stream))
         my_gen = svc_box["gen"]
         killed_here = False
+        base_peer_id = body["peer_id"]
         try:
-            t_reg = time.perf_counter()
-            await stream.to_sched.put({"type": "register"})
-            msg = await asyncio.wait_for(stream.to_peer.get(), timeout=300)
-            schedule_lat.append(time.perf_counter() - t_reg)
-            kind = msg.get("type")
+            sched_attempt = 0
+            while True:
+                t_reg = time.perf_counter()
+                await put(stream, {"type": "register"})
+                msg = await asyncio.wait_for(stream.to_peer.get(),
+                                             timeout=300)
+                schedule_lat.append(time.perf_counter() - t_reg)
+                kind = msg.get("type")
+                if kind != "schedule_failed":
+                    break
+                # The dfget model: a schedule_failed peer is failed BY
+                # DESIGN (retry budget burned while the pod warms up, or
+                # the bounded back-source budget is full) and the CLIENT
+                # retries the download with a fresh peer — the scheduler
+                # never resurrects a failed FSM. Bounded and counted:
+                # completion 1.0 still requires every retry to land.
+                sched_attempt += 1
+                if sched_attempt > 8:
+                    raise AssertionError(
+                        f"peer {i} schedule_failed {sched_attempt}x "
+                        f"(reason={msg.get('reason')!r} slice={my_slice})")
+                sched_client_retries += 1
+                await stream.to_sched.put(None)
+                await asyncio.wait_for(server, timeout=300)
+                await asyncio.sleep(
+                    rng.uniform(0.2, 0.6) * sched_attempt)
+                body = dict(body)
+                body["peer_id"] = f"{base_peer_id}-r{sched_attempt}"
+                stream = FakeStream(body)
+                server = asyncio.ensure_future(
+                    _serve(svc_box["svc"], stream))
+                my_gen = svc_box["gen"]
             if kind == "need_back_source":
                 origin_fetches += 1
             elif kind == "normal_task":
@@ -267,16 +340,18 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
                                 straggler_stale_ghost_picks += 1
             elif kind == "small_task":
                 finished.add(i)
-                await stream.to_sched.put(
-                    {"type": "download_finished",
-                     "content_length": N_PIECES * PIECE_SIZE,
-                     "piece_size": PIECE_SIZE,
-                     "total_piece_count": N_PIECES})
+                await put(stream,
+                          {"type": "download_finished",
+                           "content_length": N_PIECES * PIECE_SIZE,
+                           "piece_size": PIECE_SIZE,
+                           "total_piece_count": N_PIECES})
                 return
             else:
-                raise AssertionError(f"peer {i} got {kind}")
+                raise AssertionError(
+                    f"peer {i} got {kind} "
+                    f"(reason={msg.get('reason')!r} slice={my_slice})")
 
-            await stream.to_sched.put({
+            await put(stream, {
                 "type": "download_started",
                 "content_length": N_PIECES * PIECE_SIZE,
                 "piece_size": PIECE_SIZE,
@@ -306,12 +381,19 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
                     server = asyncio.ensure_future(
                         _serve(svc_box["svc"], stream))
                     done_nums = list(range(n))
-                    await stream.to_sched.put({
-                        "type": "register",
-                        "resume": {"piece_nums": done_nums,
-                                   "content_length": N_PIECES * PIECE_SIZE,
-                                   "piece_size": PIECE_SIZE,
-                                   "total_piece_count": N_PIECES}})
+                    resume = {"piece_nums": done_nums,
+                              "content_length": N_PIECES * PIECE_SIZE,
+                              "piece_size": PIECE_SIZE,
+                              "total_piece_count": N_PIECES}
+                    if packed_wire and len(done_nums) >= 16:
+                        # The negotiated bitmap form (same density gate
+                        # as the conductor's _resume_state).
+                        bitmap = reportcodec.nums_to_bitmap(done_nums)
+                        if len(bitmap) <= 2 * len(done_nums):
+                            resume["piece_bitmap"] = bitmap
+                            resume["piece_nums"] = []
+                    await put(stream, {"type": "register",
+                                       "resume": resume})
                     ans = await asyncio.wait_for(stream.to_peer.get(),
                                                  timeout=300)
                     kind2 = ans.get("type")
@@ -345,19 +427,17 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
                               "dst_peer_id": ""}
                 if report_batch <= 1:
                     # Classic config5 wire: one report per piece.
-                    await stream.to_sched.put({"type": "piece_finished",
-                                               "piece": wire_piece})
+                    await put(stream, {"type": "piece_finished",
+                                       "piece": wire_piece})
                     continue
                 # Coalesced wire (what real daemons send — conductor
                 # flushes report batches; fleet_bench measures this path).
                 pending.append(wire_piece)
                 if len(pending) >= report_batch:
-                    await stream.to_sched.put({"type": "pieces_finished",
-                                               "pieces": pending})
+                    await put(stream, batch_wire(pending))
                     pending = []
             if pending:
-                await stream.to_sched.put({"type": "pieces_finished",
-                                           "pieces": pending})
+                await put(stream, batch_wire(pending))
             finish_msg = {
                 "type": "download_finished",
                 "content_length": N_PIECES * PIECE_SIZE,
@@ -369,7 +449,7 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
                 finish_msg["flight"] = flight_mod.digest(
                     tf, clock_samples=[(now - 0.002, now, now - 0.001)])
                 digest_bytes.append(finish_msg["flight"]["bytes"])
-            await stream.to_sched.put(finish_msg)
+            await put(stream, finish_msg)
             finished.add(i)
         finally:
             await stream.to_sched.put(None)
@@ -492,6 +572,24 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
     cpu_s = time.process_time() - cpu0
     rss_peak = _resource.getrusage(_resource.RUSAGE_SELF).ru_maxrss / 1024
 
+    # loop_lag SLO verdict over the whole storm: the runtime probe specs
+    # (pkg/slo RUNTIME_SLOS) fed by the heartbeat above. The 16k churn
+    # acceptance pins ``breached == []`` — a scale regression that wedges
+    # the loop mid-sim fails here even when the run still completes.
+    from dragonfly2_tpu.pkg import slo as slolib
+
+    slo_engine = slolib.SLOEngine(slolib.RUNTIME_SLOS,
+                                  probes={"loop_lag": loop_lag_probe})
+    slo_report = slo_engine.evaluate()
+    slo_stats = {
+        "breached": slo_report["breached"],
+        "loop_lag_windows": [
+            {"window_s": w["window_s"], "burn_rate": w["burn_rate"],
+             "state": w["state"]}
+            for s in slo_report["slos"] if s["name"] == "loop_lag"
+            for w in s["windows"]],
+    }
+
     # TTL sweep: a pod-scale run must not leave registry residue. All
     # peers are terminal (finished or stream-gone); once the TTL passes,
     # one gc() round drains peers → tasks (peerless+stale) → hosts.
@@ -499,7 +597,13 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
         "peers": len(svc.peers.all()), "tasks": len(svc.tasks.all()),
         "hosts": len(svc.hosts.all()),
     }
-    await asyncio.sleep(cfg.gc.peer_ttl + 0.3)
+    # With host-count-scaled arrival pacing the configured TTL can be
+    # minutes; the sweep proves the stale-entry DRAIN logic, not the wall
+    # wait, so age the registries by shrinking their TTLs to the floor
+    # instead of sleeping out the arrival window again.
+    sweep_ttl = max(gc_ttl_s, 1.0)
+    svc.peers._ttl = svc.tasks._ttl = svc.hosts._ttl = sweep_ttl
+    await asyncio.sleep(sweep_ttl + 0.3)
     svc.peers.gc()
     svc.tasks.gc()
     svc.hosts.gc()
@@ -543,6 +647,7 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
         "finished": len(finished),
         "expected_finishers": expected_finishers,
         "origin_fetches": origin_fetches,
+        "schedule_client_retries": sched_client_retries,
         "intra_slice_frac": round(parent_picks["intra"] / total_picks, 3)
         if total_picks else 0.0,
         "healthy_intra_slice_frac": round(
@@ -572,8 +677,15 @@ async def run_sim(n_hosts: int, piece_latency_s: float = 0.002,
         "loop_lag_p50_ms": round(
             (statistics.median(lag_samples) if lag_samples else 0.0) * 1000,
             2),
+        "arrival_window_s": round(arrival_window_s, 1),
         "wall_s": round(wall, 2),
         "cpu_s": round(cpu_s, 3),
+        "events": events,
+        "cpu_per_event_us": round(cpu_s / events * 1e6, 3) if events else 0.0,
+        "report_batch": report_batch,
+        "packed_wire": packed_wire,
+        "report_backend": reportcodec.report_backend(),
+        "slo": slo_stats,
         "rss_start_mb": round(rss_start, 1),
         "rss_peak_mb": round(rss_peak, 1),
         "registry_peak": registry_sizes,
@@ -703,6 +815,25 @@ def check_restart_behavior(result: dict) -> None:
     assert r["rebuild_s"] >= 0, r
 
 
+def check_scale_pair(result: dict, pair: dict,
+                     max_ratio: float = 1.15) -> None:
+    """Flat per-event ingest cost: the big run's cpu-per-announce-event
+    stays within ``max_ratio`` of its paired smaller fresh run from the
+    same process — superlinear registry/DAG work shows up here long
+    before completion breaks. Plus: the loop_lag SLO never breached
+    mid-sim (the storm may stall the loop briefly; a burn past the
+    fast-window threshold means seconds-long wedges)."""
+    assert result["completion_rate"] == 1.0, result
+    assert result["slo"]["breached"] == [], result["slo"]
+    r_big = result["cpu_per_event_us"]
+    r_small = pair["cpu_per_event_us"]
+    assert r_small > 0, pair
+    assert r_big <= max_ratio * r_small, (
+        f"per-event ingest cost not flat: {r_big:.3f}us at "
+        f"{result['hosts']} hosts vs {r_small:.3f}us at "
+        f"{pair['hosts']} hosts ({r_big / r_small:.2f}x > {max_ratio}x)")
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--hosts", type=int, default=256)
@@ -714,13 +845,59 @@ def main() -> int:
                     help="kill + snapshot-restore the scheduler mid-sim "
                          "(crash-recovery drill)")
     ap.add_argument("--piece-latency", type=float, default=0.002)
+    ap.add_argument("--arrival-window", type=float, default=None,
+                    help="register-storm arrival spread in seconds "
+                         "(default: scaled to ~80 arrivals/s)")
+    ap.add_argument("--report-batch", type=int, default=1,
+                    help="coalesce piece reports into batches of N "
+                         "(1 = classic per-piece wire)")
+    ap.add_argument("--packed-wire", action="store_true",
+                    help="send coalesced reports in the packed columnar "
+                         "form (proto/reportcodec) + resume bitmaps")
     ap.add_argument("--publish", action="store_true")
     args = ap.parse_args()
 
-    result = asyncio.run(run_sim(args.hosts, churn=args.churn,
-                                 churn_waves=args.churn_waves,
-                                 piece_latency_s=args.piece_latency,
-                                 restart=args.restart))
+    def _arrival_window(n_hosts: int) -> float:
+        # Offered-load pacing: a pod's hosts take tens of seconds to storm
+        # back (boot + dfdaemon start jitter), and the DES must not
+        # oversubscribe its own host either — 16384 arrivals inside one
+        # wall-second on one core wedge the LOOP ITSELF, and every budget
+        # in play (scheduler retry, loop-lag SLO) burns against wall time.
+        # ~80 arrivals/s keeps per-host offered load constant across
+        # scales, so the 4k/16k per-event pair compares like with like.
+        return max(1.0, n_hosts / 80.0)
+
+    window = (args.arrival_window if args.arrival_window is not None
+              else _arrival_window(args.hosts))
+    sim_kwargs = dict(churn=args.churn, churn_waves=args.churn_waves,
+                      piece_latency_s=args.piece_latency,
+                      arrival_window_s=window,
+                      restart=args.restart, report_batch=args.report_batch,
+                      packed_wire=args.packed_wire)
+    result = asyncio.run(run_sim(args.hosts, **sim_kwargs))
+    pair = None
+    if args.hosts >= 16384:
+        # The 16k acceptance is a PAIR: a fresh 4k run in this same
+        # process (same interpreter state, same wire options) anchors
+        # the per-event cost ratio — flat cost means the 16k storm pays
+        # <= 1.15x per announce event.
+        pair_kwargs = dict(sim_kwargs)
+        if args.arrival_window is None:
+            pair_kwargs["arrival_window_s"] = _arrival_window(4096)
+        pair = asyncio.run(run_sim(4096, **pair_kwargs))
+        result["pair_4k"] = {
+            "hosts": pair["hosts"],
+            "events": pair["events"],
+            "cpu_s": pair["cpu_s"],
+            "cpu_per_event_us": pair["cpu_per_event_us"],
+            "completion_rate": pair["completion_rate"],
+        }
+        result["per_event_ratio_vs_4k"] = round(
+            result["cpu_per_event_us"] / pair["cpu_per_event_us"], 3)
+    # Numbers first, verdicts second: a failed gate must still leave the
+    # full result on stdout for diagnosis.
+    print(json.dumps(result))
+
     if args.restart:
         # Restart runs assert BEHAVIOR only: the in-process crash window
         # (synchronous snapshot restore + the whole fleet re-registering
@@ -731,13 +908,16 @@ def main() -> int:
         check_restart_behavior(result)
     else:
         (check_churn if args.churn else check)(result)
-    print(json.dumps(result))
+    if pair is not None:
+        check_scale_pair(result, pair)
 
     if args.publish:
         path = os.path.join(REPO, "BASELINE.json")
         doc = json.load(open(path))
         key = "config5_pod_sim_churn" if args.churn else "config5_pod_sim"
-        if args.hosts >= 4096:
+        if args.hosts >= 16384:
+            key += "_16k"
+        elif args.hosts >= 4096:
             key += "_4k"
         elif args.hosts >= 1024:
             key += "_1024"

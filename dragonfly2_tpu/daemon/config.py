@@ -59,6 +59,11 @@ class DownloadOption:
     calculate_digest: bool = True
     prefetch: bool = False          # prefetch whole task on ranged requests
     concurrent_min_length: int = 32 << 20
+    # Max pieces per coalesced pieces_finished announce message. The cap
+    # is adaptive at the conductor: idle traffic still flushes single
+    # reports immediately (latency path), backlog grows batches toward
+    # this knob and recovery re-reports drain in knob-sized messages.
+    report_batch: int = 32
 
 
 @dataclass

@@ -216,6 +216,7 @@ class Daemon:
             quarantine=self.task_manager.quarantine,
             is_seed=is_seed or self.config.seed_peer,
             piece_parallelism=self.config.download.parent_concurrency,
+            report_batch=self.config.download.report_batch,
             limiter=limiter if limiter is not None else self.task_manager.limiter,
             on_piece=on_piece,
             wfq=self.qos_gate,

@@ -20,6 +20,9 @@ from __future__ import annotations
 
 import asyncio
 import os
+from collections import deque
+
+import msgpack
 
 from dragonfly2_tpu.daemon.peer.piece_dispatcher import (
     PieceAssignment,
@@ -38,6 +41,7 @@ from dragonfly2_tpu.pkg import retry as retrylib
 from dragonfly2_tpu.pkg.errors import Code, DfError
 from dragonfly2_tpu.pkg.piece import PieceInfo, Range, compute_piece_count
 from dragonfly2_tpu.pkg.ratelimit import Limiter
+from dragonfly2_tpu.proto import reportcodec
 from dragonfly2_tpu import qos as qoslib
 from dragonfly2_tpu.storage.local_store import LocalTaskStore
 
@@ -69,6 +73,14 @@ PIECE_BYTES = metrics.counter(
     "peer_piece_bytes_total",
     "P2P piece bytes downloaded, by parent ICI locality",
     ("locality",))
+# Announce-wire weight: serialized msgpack bytes this daemon exchanged
+# with the scheduler over announce streams. The packed-report encoding
+# exists to shrink ``sent`` — ingest_wire_bench publishes the ratio.
+ANNOUNCE_BYTES = metrics.counter(
+    "peer_announce_bytes_total",
+    "Serialized announce-stream traffic with the scheduler, by direction "
+    "(sent = reports/registers, recv = schedule pushes and answers)",
+    ("direction",))
 
 MAX_RESCHEDULES = 8
 
@@ -94,6 +106,7 @@ class PeerTaskConductor:
         quarantine=None,
         flight=None,
         wfq=None,
+        report_batch: int = 32,
     ):
         self.task_id = task_id
         self.peer_id = peer_id
@@ -170,10 +183,20 @@ class PeerTaskConductor:
         # flush window ride one message. Peer-to-peer piece DISCOVERY does
         # not ride these reports at all (the synchronizer syncs piece maps
         # parent-direct), so batching costs scheduling metadata freshness
-        # only, bounded by the window.
-        self._pending_reports: list[dict] = []
+        # only, bounded by the window. The cap is adaptive by
+        # construction: idle traffic flushes singles (wait <= 0 on the
+        # first report), backlog grows batches toward ``report_batch``
+        # (DaemonConfig download.report_batch) and a recovery re-report
+        # drains in report_batch-sized messages instead of one giant one.
+        self.report_batch = max(1, int(report_batch))
+        self._pending_reports: deque = deque()
         self._flush_task: asyncio.Task | None = None
         self._last_flush = 0.0
+        # Wire capability learned from stamped scheduler answers: packed
+        # piece-report batches + resume bitmaps (proto/reportcodec).
+        # Refreshed on every register/reconnect answer so failover to an
+        # older scheduler downgrades the encoding.
+        self._packed_ok = False
         # Mid-download announce-stream recovery state: the register body
         # (saved for re-registration), the serialized-reconnect lock, and
         # the terminal flag that stops recovery racing teardown.
@@ -549,6 +572,11 @@ class PeerTaskConductor:
         legs were. Ships inside the terminal flight digest."""
         if not msg:
             return
+        self._note_recv(msg)
+        # Capability negotiation rides the same stamped answers: refresh
+        # on EVERY register/reconnect answer (not just the first) so a
+        # failover to an older scheduler drops back to the dict wire.
+        self._packed_ok = bool(msg.get("packed_reports"))
         echo = msg.get("sched_wall")
         if not isinstance(echo, (int, float)) or echo <= 0:
             return
@@ -659,6 +687,7 @@ class PeerTaskConductor:
                         continue
                     self._degrade_after_scheduler_loss()
                     return
+                self._note_recv(msg)
                 kind = msg.get("type")
                 self.flight.record(flightlib.EV_SCHED_PUSH, -1, 0.0,
                                    str(kind))
@@ -698,14 +727,23 @@ class PeerTaskConductor:
         A failover ring member — or a restarted scheduler — rebuilds its
         Task/Peer FSMs from this instead of treating us as fresh."""
         m = self.store.metadata
+        nums = sorted(m.pieces.keys())
         resume: dict = {
-            "piece_nums": sorted(m.pieces.keys()),
+            "piece_nums": nums,
             "content_length": m.content_length,
             "piece_size": m.piece_size,
             "total_piece_count": m.total_piece_count,
             "prefix_digest": m.digest or "",
             "pod_broadcast": bool(self.meta.get("pod_broadcast")),
         }
+        if self._packed_ok and len(nums) >= 16:
+            # Negotiated bitmap form: a restart storm re-registers with
+            # one bit per piece instead of a msgpack int list. Density
+            # gate keeps pathologically sparse sets on the list form.
+            bitmap = reportcodec.nums_to_bitmap(nums)
+            if len(bitmap) <= 2 * len(nums):
+                resume["piece_bitmap"] = bitmap
+                resume["piece_nums"] = []
         stripe = self.dispatcher.stripe
         if stripe is not None:
             resume["stripe"] = {"slice_size": stripe[0],
@@ -1038,7 +1076,9 @@ class PeerTaskConductor:
         loop = asyncio.get_running_loop()
         while True:
             wait = self._last_flush + self._REPORT_FLUSH_S - loop.time()
-            if wait > 0:
+            if wait > 0 and len(self._pending_reports) < self.report_batch:
+                # Under backlog (a full batch already waiting) skip the
+                # coalescing window — it only exists to grow batches.
                 await asyncio.sleep(wait)
             if not await self._flush_reports():
                 # Stream down: reports stay BUFFERED (not dropped) for the
@@ -1048,31 +1088,53 @@ class PeerTaskConductor:
             if not self._pending_reports:
                 return
 
+    def _batch_msg(self, batch: list) -> dict:
+        """The wire form of one report batch: packed columns when the
+        scheduler negotiated them AND the encoder can represent the batch
+        exactly (it refuses anything lossy — see reportcodec); otherwise
+        the legacy per-piece dict list."""
+        if len(batch) == 1:
+            return {"type": "piece_finished", "piece": batch[0]}
+        if self._packed_ok:
+            packed = reportcodec.encode_reports(batch)
+            if packed is not None:
+                return {"type": "pieces_finished", "packed": packed}
+        return {"type": "pieces_finished", "pieces": batch}
+
     async def _flush_reports(self) -> bool:
-        """Send buffered piece reports. Returns False when the stream was
-        down — the batch is RESTORED, not dropped, so the reports survive
-        for the announce-stream recovery path to flush."""
+        """Send buffered piece reports, draining the queue in
+        report_batch-capped messages. Returns False when the stream was
+        down — the unsent batch is RESTORED in order, not dropped, so the
+        reports survive for the announce-stream recovery path to flush."""
         async with self._report_lock:
-            if not self._pending_reports:
-                return True
-            batch, self._pending_reports = self._pending_reports, []
-            self._last_flush = asyncio.get_running_loop().time()
-            try:
-                if len(batch) == 1:
-                    sent = await self._safe_send({"type": "piece_finished",
-                                                  "piece": batch[0]})
-                else:
-                    sent = await self._safe_send({"type": "pieces_finished",
-                                                  "pieces": batch})
-            except BaseException:
-                # A cancellation (teardown racing a flush) must not drop
-                # the popped batch: restore it so the teardown's own final
-                # flush still reports these pieces.
-                self._pending_reports = batch + self._pending_reports
-                raise
-            if not sent:
-                self._pending_reports = batch + self._pending_reports
-            return sent
+            pending = self._pending_reports
+            while pending:
+                cap = min(self.report_batch, len(pending))
+                batch = [pending.popleft() for _ in range(cap)]
+                self._last_flush = asyncio.get_running_loop().time()
+                try:
+                    sent = await self._safe_send(self._batch_msg(batch))
+                except BaseException:
+                    # A cancellation (teardown racing a flush) must not
+                    # drop the popped batch: restore it — in order, O(batch)
+                    # not O(queue) — so the teardown's own final flush
+                    # still reports these pieces.
+                    pending.extendleft(reversed(batch))
+                    raise
+                if not sent:
+                    pending.extendleft(reversed(batch))
+                    return False
+            return True
+
+    @staticmethod
+    def _note_recv(msg: dict) -> None:
+        """Book a received announce message's serialized weight (the
+        recv half of peer_announce_bytes_total)."""
+        try:
+            ANNOUNCE_BYTES.labels("recv").inc(
+                len(msgpack.packb(msg, use_bin_type=True)))
+        except Exception:
+            pass   # accounting must never break the stream
 
     async def _safe_send(self, msg: dict) -> bool:
         """Send on the announce stream; returns False when the stream is
@@ -1102,6 +1164,8 @@ class PeerTaskConductor:
             return False
         try:
             await stream.send(msg)
+            ANNOUNCE_BYTES.labels("sent").inc(
+                len(msgpack.packb(msg, use_bin_type=True)))
             return True
         except DfError:
             return False

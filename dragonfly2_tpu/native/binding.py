@@ -137,6 +137,15 @@ _lib.df_chunk_scan.argtypes = [
 ]
 _lib.df_chunk_scan.restype = ctypes.c_int64
 
+# Output pointers are typed c_void_p, not POINTER(...): report_decode
+# passes raw addresses into one reused scratch buffer (see
+# _report_scratch_for), and int -> void* is the cheapest conversion
+# ctypes has.
+_lib.df_report_decode.argtypes = (
+    [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+     ctypes.c_uint64, ctypes.c_uint64] + [ctypes.c_void_p] * 12)
+_lib.df_report_decode.restype = ctypes.c_int64
+
 _lib.df_ring_create.argtypes = [ctypes.c_uint32]
 _lib.df_ring_create.restype = ctypes.c_int64
 
@@ -438,6 +447,98 @@ def chunk_scan(region, gear: bytes, mask_bits: int, ctx: int) -> list:
         start = done - min(done, _CHUNK_WINDOW - 1)
         cur_ctx = done - start
         base = start
+
+
+# -- packed piece-report batch decoder (src/dfreport.cc) ---------------------
+
+_REPORT_DECODE_ERRORS = {
+    -1: "piece-num varint stream truncated",
+    -2: "trailing bytes after piece-num stream",
+    -3: "negative piece number",
+    -4: "column block length mismatch",
+    -5: "peer intern index out of range",
+}
+
+
+# One grow-only scratch buffer for all report decodes: creating twelve
+# ctypes array TYPES per call ((ctype * n) is a class construction) cost
+# more than the decode itself at announce-storm batch sizes. The C side
+# fully writes every region it reports (aggs are memset there), so reuse
+# is safe; the buffer only ever grows.
+_report_scratch: "tuple | None" = None
+
+
+def _report_scratch_for(n: int, n_peers: int) -> tuple:
+    global _report_scratch
+    if (_report_scratch is not None and _report_scratch[0] >= n
+            and _report_scratch[1] >= n_peers):
+        return _report_scratch
+    cap_n = max(64, 1 << (n - 1).bit_length()) if n else 64
+    cap_p = max(16, 1 << (n_peers - 1).bit_length()) if n_peers else 16
+    # 8-byte sections first, then 4-byte, then 2-byte: every column start
+    # stays aligned for the memoryview casts below.
+    size = 16 * cap_n + 24 * cap_p + 48 + 24 * cap_n + 4 * cap_n
+    buf = bytearray(size)
+    cbuf = (ctypes.c_char * size).from_buffer(buf)
+    _report_scratch = (cap_n, cap_p, buf, ctypes.addressof(cbuf), cbuf)
+    return _report_scratch
+
+
+def report_decode(nums: bytes, cols: bytes, n: int, n_peers: int):
+    """Decode a packed pieces_finished batch (proto/reportcodec layout) in
+    one native call. Returns (nums, costs, starts, sizes, peer_idx, flags,
+    dcn, stall, store, crcs, parent_aggs, totals) — the first ten are
+    per-piece lists, parent_aggs is [[count, cost_sum, bytes], ...] per
+    interned peer, totals is [cost_total, bytes_total, dcn_ms, stall_ms,
+    store_ms, min_cost]. Raises ValueError on malformed input (the ladder
+    maps it to reportcodec.CodecError)."""
+    cap_n, cap_p, buf, base, _keep = _report_scratch_for(n, n_peers)
+    o_nums = 0
+    o_start = 8 * cap_n
+    o_aggs = o_start + 8 * cap_n
+    o_tot = o_aggs + 24 * cap_p
+    o_cost = o_tot + 48
+    o_size = o_cost + 4 * cap_n
+    o_dcn = o_size + 4 * cap_n
+    o_stall = o_dcn + 4 * cap_n
+    o_store = o_stall + 4 * cap_n
+    o_crc = o_store + 4 * cap_n
+    o_peer = o_crc + 4 * cap_n
+    o_flags = o_peer + 2 * cap_n
+    rc = _lib.df_report_decode(
+        nums, len(nums), cols, len(cols), n, n_peers,
+        base + o_nums, base + o_cost, base + o_start, base + o_size,
+        base + o_peer, base + o_flags, base + o_dcn, base + o_stall,
+        base + o_store, base + o_crc, base + o_aggs, base + o_tot)
+    if rc < 0:
+        raise ValueError(_REPORT_DECODE_ERRORS.get(
+            rc, f"packed report decode failed ({rc})"))
+    mv = memoryview(buf)
+    agg_flat = mv[o_aggs:o_aggs + 24 * n_peers].cast("Q").tolist()
+    aggs = [agg_flat[3 * p:3 * p + 3] for p in range(n_peers)]
+
+    def col(off: int, width: int, fmt: str):
+        # Cold columns (everything the scheduler's bulk apply never
+        # touches) come back as int-indexable memoryviews over private
+        # snapshots — one memcpy instead of materializing n Python ints
+        # that the hot path would throw away. The snapshot matters: the
+        # scratch is overwritten by the next decode.
+        return memoryview(bytes(mv[off:off + width * n])).cast(fmt)
+
+    out = (mv[o_nums:o_nums + 8 * n].cast("q").tolist(),
+           mv[o_cost:o_cost + 4 * n].cast("I").tolist(),
+           col(o_start, 8, "Q"),
+           col(o_size, 4, "I"),
+           col(o_peer, 2, "H"),
+           col(o_flags, 2, "H"),
+           col(o_dcn, 4, "I"),
+           col(o_stall, 4, "I"),
+           col(o_store, 4, "I"),
+           col(o_crc, 4, "I"),
+           aggs,
+           mv[o_tot:o_tot + 48].cast("Q").tolist())
+    mv.release()
+    return out
 
 
 # -- batched-IO submission ring (src/dfring.cc) ------------------------------

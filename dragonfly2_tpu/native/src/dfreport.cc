@@ -1,0 +1,136 @@
+// dfreport — packed piece-report batch decoder (the scheduler's announce
+// ingest hot loop).
+//
+// Implements EXACTLY the decode in proto/reportcodec.py: piece numbers
+// arrive as a zigzag-varint delta stream, per-piece columns as fixed
+// 36-byte little-endian records (cost u32, range_start u64, range_size
+// u32, peer_idx u16, flags u16, dcn u32, stall u32, store u32, crc u32).
+// One call decodes the whole batch into caller-provided flat arrays AND
+// folds the aggregates the scheduler's apply path consumes — per-parent
+// [count, cost_sum, bytes] and the phase-attribution sums (untimed
+// pieces book their whole cost as dcn, flags bit0 gates the split) — so
+// Python touches each batch once, not each piece. ctypes releases the
+// GIL for the call's duration.
+//
+// The python/numpy rungs in reportcodec.py are the reference; the probe
+// in _native_decoder() cross-checks this kernel against them before it
+// is ever selected, so a skew here demotes the ladder instead of
+// corrupting scheduler state.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint16_t kFlagTimings = 1;
+constexpr size_t kColSize = 36;
+
+inline uint16_t load_u16(const uint8_t* p) {
+  uint16_t v;
+  std::memcpy(&v, p, 2);
+  return v;
+}
+inline uint32_t load_u32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+inline uint64_t load_u64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode a packed batch of n piece reports. Outputs are caller-allocated:
+// out_nums[n], out_cost[n], out_start[n], out_size[n], out_peer[n],
+// out_flags[n], out_dcn[n], out_stall[n], out_store[n], out_crc[n];
+// peer_aggs[3*n_peers] as (count, cost_sum, bytes) triples; totals[6] as
+// (cost_total, bytes_total, dcn_ms, stall_ms, store_ms, min_cost).
+// Returns 0, or a negative error: -1 varint stream truncated/overlong,
+// -2 trailing bytes after the num stream, -3 negative piece number,
+// -4 column block length mismatch, -5 peer index out of range.
+// (Assumes little-endian columns match host order — x86-64/aarch64.)
+long long df_report_decode(
+    const uint8_t* nums_buf, uint64_t nums_len,
+    const uint8_t* cols, uint64_t cols_len,
+    uint64_t n, uint64_t n_peers,
+    int64_t* out_nums, uint32_t* out_cost, uint64_t* out_start,
+    uint32_t* out_size, uint16_t* out_peer, uint16_t* out_flags,
+    uint32_t* out_dcn, uint32_t* out_stall, uint32_t* out_store,
+    uint32_t* out_crc, uint64_t* peer_aggs, uint64_t* totals) {
+  if (cols_len != n * kColSize) return -4;
+
+  // Piece-num delta stream.
+  uint64_t pos = 0;
+  int64_t prev = 0;
+  for (uint64_t i = 0; i < n; i++) {
+    uint64_t zz = 0;
+    int shift = 0;
+    for (;;) {
+      if (pos >= nums_len || shift > 63) return -1;
+      uint8_t b = nums_buf[pos++];
+      zz |= static_cast<uint64_t>(b & 0x7F) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+    }
+    int64_t delta =
+        static_cast<int64_t>(zz >> 1) ^ -static_cast<int64_t>(zz & 1);
+    prev += delta;
+    if (prev < 0) return -3;
+    out_nums[i] = prev;
+  }
+  if (pos != nums_len) return -2;
+
+  std::memset(peer_aggs, 0, 3 * n_peers * sizeof(uint64_t));
+  uint64_t cost_total = 0, bytes_total = 0;
+  uint64_t dcn_t = 0, stall_t = 0, store_t = 0;
+  uint64_t min_cost = 0;
+  const uint8_t* p = cols;
+  for (uint64_t i = 0; i < n; i++, p += kColSize) {
+    uint32_t cost = load_u32(p);
+    uint64_t start = load_u64(p + 4);
+    uint32_t size = load_u32(p + 12);
+    uint16_t peer = load_u16(p + 16);
+    uint16_t flags = load_u16(p + 18);
+    if (peer >= n_peers) return -5;
+    out_cost[i] = cost;
+    out_start[i] = start;
+    out_size[i] = size;
+    out_peer[i] = peer;
+    out_flags[i] = flags;
+    uint32_t dcn = load_u32(p + 20);
+    uint32_t stall = load_u32(p + 24);
+    uint32_t store = load_u32(p + 28);
+    out_dcn[i] = dcn;
+    out_stall[i] = stall;
+    out_store[i] = store;
+    out_crc[i] = load_u32(p + 32);
+    cost_total += cost;
+    bytes_total += size;
+    if (flags & kFlagTimings) {
+      dcn_t += dcn;
+      stall_t += stall;
+      store_t += store;
+    } else {
+      dcn_t += cost;
+    }
+    uint64_t* agg = peer_aggs + 3 * static_cast<size_t>(peer);
+    agg[0] += 1;
+    agg[1] += cost;
+    agg[2] += size;
+    if (i == 0 || cost < min_cost) min_cost = cost;
+  }
+  totals[0] = cost_total;
+  totals[1] = bytes_total;
+  totals[2] = dcn_t;
+  totals[3] = stall_t;
+  totals[4] = store_t;
+  totals[5] = min_cost;
+  return 0;
+}
+
+}  // extern "C"

@@ -45,6 +45,13 @@ class DAG(Generic[T]):
 
     def __init__(self):
         self._v: dict[str, Vertex[T]] = {}
+        # Sampling index: id list with swap-remove + position map, so
+        # random_vertices costs O(sample), never O(vertices). The
+        # candidate sampler runs on every schedule attempt and a pod
+        # task holds tens of thousands of peer vertices — materializing
+        # the key list per call is an O(n^2) storm tax.
+        self._order: list[str] = []
+        self._pos: dict[str, int] = {}
         self._mu = threading.RLock()
 
     def add_vertex(self, vid: str, value: T) -> None:
@@ -52,12 +59,19 @@ class DAG(Generic[T]):
             if vid in self._v:
                 raise DAGError(f"vertex {vid} exists")
             self._v[vid] = Vertex(vid, value)
+            self._pos[vid] = len(self._order)
+            self._order.append(vid)
 
     def delete_vertex(self, vid: str) -> None:
         with self._mu:
             v = self._v.pop(vid, None)
             if v is None:
                 return
+            i = self._pos.pop(vid)
+            last = self._order.pop()
+            if last != vid:
+                self._order[i] = last
+                self._pos[last] = i
             for p in v.parents.values():
                 p.children.pop(vid, None)
             for c in v.children.values():
@@ -154,12 +168,25 @@ class DAG(Generic[T]):
         """Random sample of vertices (reference dag.go random-sampling API —
         used by FilterParentLimit candidate sampling)."""
         with self._mu:
-            ids = list(self._v.keys())
-            if n >= len(ids):
-                sample = ids
+            m = len(self._order)
+            if n >= m:
+                sample = list(self._order)
             else:
-                sample = random.sample(ids, n)
+                sample = [self._order[i]
+                          for i in random.sample(range(m), n)]
             return [self._v[i] for i in sample]
+
+    def find_value(self, pred) -> "T | None":
+        """First vertex value matching ``pred``, scanning insertion order
+        under the lock with early exit. Availability probes hit on the
+        OLDEST vertices (where finished peers live), so this is O(1) in
+        practice where ``values()`` would materialize every vertex per
+        call; callers must not mutate the DAG from ``pred``."""
+        with self._mu:
+            for v in self._v.values():
+                if pred(v.value):
+                    return v.value
+            return None
 
     def values(self) -> Iterator[T]:
         with self._mu:

@@ -831,6 +831,21 @@ class PodAggregator:
             # transfer time.
             ms["dcn"] += int(cost_ms or 0)
 
+    def note_pieces(self, task_id: str, host_id: str, n: int,
+                    phase_ms) -> None:
+        """Batch form of note_piece for the packed ingest fast path:
+        ``n`` pieces with pre-summed (dcn, stall, store) milliseconds —
+        untimed pieces already folded their whole cost into dcn
+        (proto/reportcodec computes the sums with note_piece's exact
+        semantics, so N note_piece calls and one note_pieces call land
+        the same aggregate)."""
+        h = self._host(task_id, host_id)
+        h["pieces"] += n
+        ms = h["ms"]
+        ms["dcn"] += phase_ms[0]
+        ms["stall"] += phase_ms[1]
+        ms["store"] += phase_ms[2]
+
     def note_failure(self, task_id: str, host_id: str, reason: str) -> None:
         h = self._host(task_id, host_id)
         h["failures"][reason] = h["failures"].get(reason, 0) + 1

@@ -125,6 +125,20 @@ PIECE = Msg(
     timings=F(dict),
 )
 
+# Packed piece-report batch (proto/reportcodec): the negotiated compact
+# alternative to a PIECE dict list — delta-coded piece nums, fixed-width
+# columns, interned dst_peer_id table. Only sent after the scheduler
+# advertised ``packed_reports`` on a stamped answer; structural decode
+# validation (column length, varint bounds, intern indices) lives in
+# reportcodec.decode_packed — the schema only pins the envelope types.
+PACKED_PIECES = Msg(
+    "PackedPieces",
+    v=F(int, required=True), n=F(int, required=True),
+    peers=F(list, required=True, item=F(str)),
+    nums=F(bytes, required=True), cols=F(bytes, required=True),
+    digests=F(dict),
+)
+
 _PERSISTENT_COMMON = dict(
     task_id=F(str, required=True), peer_id=F(str), host=F(dict, spec=HOST),
 )
@@ -150,6 +164,12 @@ CLOCK_SAMPLE = Msg(
 RESUME = Msg(
     "Resume",
     piece_nums=F(list, item=F(int)),
+    # Packed alternative to piece_nums (bit i of byte i>>3 = piece i
+    # landed, proto/reportcodec.nums_to_bitmap): a 64k-host restart storm
+    # re-registers with one bit per piece instead of a msgpack int list.
+    # Negotiated like packed reports; an old scheduler ignores it and the
+    # idempotent recovery re-report rebuilds the same state.
+    piece_bitmap=F(bytes),
     content_length=F(int), piece_size=F(int), total_piece_count=F(int),
     prefix_digest=F(str), pod_broadcast=F(bool), stripe=F(dict),
 )
@@ -356,7 +376,10 @@ STREAM_MSGS: dict[str, dict[str, Msg]] = {
             "PieceFinished", piece=F(dict, required=True, spec=PIECE)),
         "pieces_finished": Msg(
             "PiecesFinished",
-            pieces=F(list, required=True, item=F(dict, spec=PIECE))),
+            # Exactly one of the two forms rides a message: the legacy
+            # per-piece dict list, or the negotiated packed batch.
+            pieces=F(list, item=F(dict, spec=PIECE)),
+            packed=F(dict, spec=PACKED_PIECES)),
         "piece_failed": Msg(
             "PieceFailed", piece_num=F(int), parent_id=F(str),
             temporary=F(bool),

@@ -77,6 +77,11 @@ class Task:
         # relying on a random DAG sample to contain one (at 16 hosts per
         # slice in a 256-host pod the random base rate is ~6%).
         self.slice_index: dict[str, set[str]] = {}
+        # Seed membership index (is_seed is fixed at peer construction):
+        # the scheduler's seed-active probe runs inside every schedule
+        # loop iteration and must not scan the whole peer DAG for the
+        # usually-zero seeds.
+        self.seed_peer_ids: set[str] = set()
 
     def notify_parents_changed(self) -> None:
         """Wake every scheduler retry-loop waiting on this task: a peer
@@ -111,15 +116,20 @@ class Task:
         blocklist = blocklist or set()
         from dragonfly2_tpu.scheduler.resource.peer import PeerState
 
-        for peer in self.dag.values():
+        serving = (PeerState.RUNNING, PeerState.BACK_TO_SOURCE,
+                   PeerState.SUCCEEDED)
+
+        def _available(peer) -> bool:
             if peer.id in blocklist:
-                continue
-            if peer.fsm.current in (PeerState.RUNNING, PeerState.BACK_TO_SOURCE,
-                                    PeerState.SUCCEEDED) and peer.finished_pieces:
+                return False
+            if peer.fsm.current in serving and peer.finished_pieces:
                 return True
-            if peer.fsm.current == PeerState.SUCCEEDED:
-                return True
-        return False
+            return peer.fsm.current == PeerState.SUCCEEDED
+
+        # Early-exit DAG probe: this runs on every register, and the
+        # oldest (first-inserted) peers are exactly the finished ones,
+        # so the steady-state cost is O(1), not O(peers).
+        return self.dag.find_value(_available) is not None
 
     def can_back_to_source(self) -> bool:
         """Bounded number of peers may hit origin
@@ -134,6 +144,8 @@ class Task:
             if peer.host.tpu_slice:
                 self.slice_index.setdefault(
                     peer.host.tpu_slice, set()).add(peer.id)
+            if peer.is_seed:
+                self.seed_peer_ids.add(peer.id)
 
     def _release_upload_slots(self, peer_id: str, *, parents: bool, children: bool) -> None:
         """Upload-concurrency accounting: each parent→child edge holds one
@@ -158,6 +170,7 @@ class Task:
             members = self.slice_index.get(peer.host.tpu_slice)
             if members is not None:
                 members.discard(peer_id)
+        self.seed_peer_ids.discard(peer_id)
         self.dag.delete_vertex(peer_id)
 
     def load_peer(self, peer_id: str):

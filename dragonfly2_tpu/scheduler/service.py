@@ -29,6 +29,7 @@ from dragonfly2_tpu.pkg.errors import Code, DfError
 from dragonfly2_tpu.pkg.fsm import TransitionError
 from dragonfly2_tpu.pkg.piece import PieceInfo, SizeScope
 from dragonfly2_tpu.pkg.types import HostType
+from dragonfly2_tpu.proto import reportcodec
 from dragonfly2_tpu.rpc import RpcContext, ServerStream
 from dragonfly2_tpu.scheduler.config import SchedulerConfig
 from dragonfly2_tpu.scheduler.resource import (
@@ -75,6 +76,18 @@ PEER_REREGISTER_COUNT = metrics.counter(
     "scheduler_peer_reregister_total",
     "Terminal peers replaced by a fresh registration (announce-stream "
     "recovery after a drop)")
+
+REPORT_BATCH_COUNT = metrics.counter(
+    "scheduler_report_batches_total",
+    "Ingested piece-report batches (piece_finished counts as a batch of "
+    "one), by wire encoding: packed (proto/reportcodec columns) or dict "
+    "(legacy per-piece PIECE maps)", ("encoding",))
+
+INGEST_BATCH_PIECES = metrics.histogram(
+    "scheduler_ingest_batch_pieces",
+    "Pieces per ingested report batch — how well the announce wire "
+    "coalesces under load (1 = idle single-piece latency path)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 1024))
 
 STATE_REBUILT_COUNT = metrics.counter(
     "scheduler_state_rebuilt_peers_total",
@@ -460,6 +473,12 @@ class SchedulerService:
         (pkg/podlens.ClockEstimator) shipped back inside the flight
         digest — no extra RPC, the announce stream IS the time source."""
         msg["sched_wall"] = flightlib.anchored_wall()
+        # Capability negotiation rides the same piggyback: this flag
+        # tells the conductor the scheduler decodes packed piece-report
+        # batches and resume bitmaps (proto/reportcodec). The daemon
+        # re-learns it from every reconnect answer, so failover to an
+        # older scheduler downgrades the wire automatically.
+        msg["packed_reports"] = True
         return msg
 
     async def _handle_register(self, task: Task, peer: Peer,
@@ -578,8 +597,10 @@ class SchedulerService:
         )
         if resume.get("pod_broadcast"):
             peer.pod_broadcast = True
-        added = self._apply_resume_pieces(
-            task, peer, resume.get("piece_nums") or [])
+        piece_nums = resume.get("piece_nums")
+        if not piece_nums and resume.get("piece_bitmap"):
+            piece_nums = reportcodec.bitmap_to_nums(resume["piece_bitmap"])
+        added = self._apply_resume_pieces(task, peer, piece_nums or [])
         # Fresh peers walk the normal register→download transitions; a
         # snapshot ghost is already RUNNING; a SUCCEEDED ghost whose
         # daemon says "still running" drops back to RUNNING — the daemon
@@ -652,7 +673,13 @@ class SchedulerService:
         return True
 
     def _seed_active(self, task: Task) -> bool:
-        return any(p.is_seed and not p.is_done() for p in task.peers())
+        # Via the task's seed index, not a full-DAG scan: this probe sits
+        # inside every schedule loop iteration and seeds are usually zero.
+        for pid in task.seed_peer_ids:
+            p = task.load_peer(pid)
+            if p is not None and p.is_seed and not p.is_done():
+                return True
+        return False
 
     async def _schedule_and_send(self, task: Task, peer: Peer, patience: float = 0.0) -> None:
         deadline = asyncio.get_running_loop().time() + patience
@@ -826,9 +853,8 @@ class SchedulerService:
         if not self.config.seed_peer_enabled:
             return False
         # Already seeding?
-        for p in task.peers():
-            if p.is_seed and not p.is_done():
-                return True
+        if self._seed_active(task):
+            return True
         seeds = [h for h in self.hosts.all() if h.is_seed() and h.port > 0]
         if not seeds:
             return False
@@ -864,6 +890,8 @@ class SchedulerService:
         )
 
     def _handle_piece_finished(self, msg: dict, task: Task, peer: Peer) -> None:
+        REPORT_BATCH_COUNT.labels("dict").inc()
+        INGEST_BATCH_PIECES.observe(1)
         self._apply_piece_finished(msg.get("piece") or {}, task, peer)
 
     def _apply_piece_finished(self, p: dict, task: Task, peer: Peer) -> None:
@@ -918,13 +946,109 @@ class SchedulerService:
 
     def _handle_pieces_finished(self, msg: dict, task: Task, peer: Peer) -> None:
         """Coalesced batch (clients flush reports on a short window);
-        semantics identical to N piece_finished in order, but the per-batch
-        bookkeeping — task touch, parent-availability wakeup, parent
-        upload accounting and registry lookups — runs once per batch (or
-        once per distinct parent) instead of once per piece. This is the
-        scheduler's hottest ingest path: a 1024-host fan-out delivers
-        ~hosts x pieces of these."""
+        semantics identical to N piece_finished in order. Two wire forms
+        arrive here: the negotiated packed batch (proto/reportcodec —
+        decoded by the backend ladder in one call, applied in bulk) and
+        the legacy per-piece dict list. Both land the exact same FSM
+        state; the wire bench asserts it byte for byte."""
+        packed = msg.get("packed")
+        if packed is not None:
+            try:
+                batch = reportcodec.decode_packed(packed)
+            except reportcodec.CodecError as e:
+                # Malformed packed body: drop the batch, keep the stream.
+                # Reports are delivered at-least-once (the conductor
+                # restores unsent batches and recovery re-reports all
+                # pieces), so dropping never loses state permanently.
+                log.warning("malformed packed piece report dropped",
+                            peer=peer.id[:24], error=str(e))
+                return
+            REPORT_BATCH_COUNT.labels("packed").inc()
+            INGEST_BATCH_PIECES.observe(batch.n)
+            self._apply_packed_batch(batch, task, peer)
+            return
         pieces = msg.get("pieces") or []
+        REPORT_BATCH_COUNT.labels("dict").inc()
+        INGEST_BATCH_PIECES.observe(len(pieces))
+        self._apply_piece_dicts(pieces, task, peer)
+
+    def _apply_packed_batch(self, batch, task: Task, peer: Peer) -> None:
+        """Bulk-apply a decoded packed batch: set-level dup check, one
+        piece_costs extend, one PodAggregator feed, one fleet step per
+        distinct parent — Python cost per BATCH, not per piece. Eligible
+        only when every piece is new to this peer (the overwhelmingly
+        common case — dup re-delivery happens on flush-restore races and
+        recovery re-reports); anything else bridges to the dict walk,
+        whose per-piece dup handling is the reference semantics."""
+        nums = batch.nums
+        nums_set = set(nums)
+        if len(nums_set) != batch.n \
+                or not peer.finished_pieces.isdisjoint(nums_set):
+            self._apply_piece_dicts(batch.to_dicts(), task, peer)
+            return
+        was_empty = not peer.finished_pieces
+        peer.finished_pieces.update(nums_set)
+        costs = batch.costs
+        if batch.min_cost > 0:
+            peer.piece_costs.extend(costs)
+        elif batch.cost_total:
+            peer.piece_costs.extend(c for c in costs if c > 0)
+        self.pod_flight.note_pieces(task.id, peer.host.id, batch.n,
+                                    batch.phase_ms)
+        # Subset probe first: in the steady state every piece is already
+        # stored (the first reporter paid that), and <= on a keys view
+        # costs one C-level membership sweep with no result-set build.
+        missing = (() if nums_set <= task.pieces.keys()
+                   else nums_set.difference(task.pieces.keys()))
+        if missing:
+            starts, sizes, peer_idx, peers = (
+                batch.starts, batch.sizes, batch.peer_idx, batch.peers)
+            for i, num in enumerate(nums):
+                if num in missing:
+                    task.store_piece(PieceInfo(
+                        piece_num=num, range_start=starts[i],
+                        range_size=sizes[i], digest=batch.digest(i),
+                        download_cost_ms=costs[i],
+                        dst_peer_id=peers[peer_idx[i]]))
+        peer.touch()
+        task.touch()
+        if was_empty and peer.finished_pieces:
+            task.notify_parents_changed()
+        by_parent_host: dict[str, list] = {}
+        my_slice = peer.host.tpu_slice
+        for pidx, (k, cost_sum, nbytes) in enumerate(batch.parent_aggs):
+            if not k:
+                continue
+            parent_id = batch.peers[pidx]
+            parent = self.peers.load(parent_id) if parent_id else None
+            host_key = ""
+            col = fleetlib.C_BYTES_UNLABELED
+            if parent is not None:
+                parent.host.upload_count += k
+                parent.touch()
+                host_key = parent.host.id
+                if my_slice and parent.host.tpu_slice:
+                    col = (fleetlib.C_BYTES_INTRA
+                           if parent.host.tpu_slice == my_slice
+                           else fleetlib.C_BYTES_CROSS)
+            entry = by_parent_host.get(host_key)
+            if entry is None:
+                by_parent_host[host_key] = [k, cost_sum, nbytes, col]
+            else:
+                entry[0] += k
+                entry[1] += cost_sum
+                entry[2] += nbytes
+        if self.fleet is not None and batch.n:
+            self.fleet.note_pieces(peer.host.id, batch.n, batch.cost_total,
+                                   by_parent=by_parent_host)
+
+    def _apply_piece_dicts(self, pieces: list, task: Task, peer: Peer) -> None:
+        """The reference per-piece walk: the per-batch bookkeeping — task
+        touch, parent-availability wakeup, parent upload accounting and
+        registry lookups — runs once per batch (or once per distinct
+        parent) instead of once per piece. This is the scheduler's
+        hottest ingest path: a 1024-host fan-out delivers ~hosts x pieces
+        of these."""
         was_empty = not peer.finished_pieces
         # Per-parent aggregation: one registry lookup, one upload-count
         # update, and ONE fleet serve-EWMA step per DISTINCT parent per

@@ -34,6 +34,8 @@ REQUIRED_CONFIGS = (
     "config11_delta",
     "config12_prof",
     "config13_qos",
+    "config14_wire",
+    "config5_pod_sim_churn_16k",
     "ingest_micro",
 )
 
@@ -411,6 +413,65 @@ def test_qos_entry_paired_shape():
     for tenant, want in acct["expected_bytes"].items():
         assert want > 0
         assert acct["metric_bytes"][tenant] == want, (tenant, acct)
+
+
+def test_wire_entry_paired_shape():
+    """config14_wire is the announce-wire-diet evidence: packed report
+    bytes per host <= 1/3 of the dict wire on the timed (common-case)
+    profile, the resume bitmap well under the int list, and the ingest
+    rounds PAIRED order-alternating (the config9 estimator — recompute
+    the median) with the exactness oracle asserted on both shapes. The
+    storm (task-sized recovery drain) headline needs the native rung,
+    which the publishing box carries."""
+    entry = _load()["published"]["config14_wire"]
+    w = entry["wire"]
+    assert w["packed_bytes_per_host"] > 0
+    assert w["ratio"] == pytest.approx(
+        w["dict_bytes_per_host"] / w["packed_bytes_per_host"], abs=1e-2)
+    assert w["ratio"] >= 3.0, w
+    assert w["plain"]["ratio"] >= 2.5, w["plain"]
+    assert w["resume_ratio"] >= 3.0, w
+    if entry["report_backend"] != "native":
+        # The published baseline comes from a box with the toolchain.
+        pytest.fail(f"published wire entry lacks native rung: {entry}")
+    for name, floor in (("ingest_storm", 5.0), ("ingest_steady", 1.0)):
+        block = entry[name]
+        assert block["state_identical"] is True, name
+        assert block["packed_us_per_piece"] > 0, name
+        assert block["dict_us_per_piece"] > 0, name
+        ratios = sorted(block["pair_ratios"])
+        assert len(ratios) == block["rounds"] >= 5, name
+        mid = len(ratios) // 2
+        median = (ratios[mid - 1] + ratios[mid]) / 2 \
+            if len(ratios) % 2 == 0 else ratios[mid]
+        assert block["ratio_median"] == pytest.approx(median, abs=1e-2), name
+        assert block["ratio_median"] >= floor, (name, block)
+
+
+def test_pod_sim_churn_16k_scale_pair_shape():
+    """config5_pod_sim_churn_16k is the flat-per-event-cost acceptance:
+    16384 hosts under sustained churn on the packed wire, completion
+    1.0, the loop-lag SLO never breached mid-storm, and cpu-per-
+    announce-event within 1.15x of the in-process 4k pair."""
+    entry = _load()["published"]["config5_pod_sim_churn_16k"]
+    assert entry["hosts"] >= 16384
+    assert entry["packed_wire"] is True
+    assert entry["report_batch"] >= 2
+    assert entry["completion_rate"] == 1.0
+    assert entry["origin_fetches"] <= 3
+    assert entry["slo"]["breached"] == [], entry["slo"]
+    pair = entry["pair_4k"]
+    assert pair["hosts"] == 4096
+    assert pair["completion_rate"] == 1.0
+    assert pair["cpu_per_event_us"] > 0
+    assert entry["per_event_ratio_vs_4k"] == pytest.approx(
+        entry["cpu_per_event_us"] / pair["cpu_per_event_us"], abs=1e-2)
+    assert entry["per_event_ratio_vs_4k"] <= 1.15, entry
+    # The churn invariants hold at 16k too.
+    assert entry["straggler_dead_parent_picks"] == 0
+    assert entry["peers_after_gc"] == 0
+    assert entry["tasks_after_gc"] == 0
+    assert entry["hosts_after_gc"] == 0
 
 
 def test_stripe_sim_meets_acceptance_bounds():

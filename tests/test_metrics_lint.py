@@ -37,6 +37,7 @@ METRIC_MODULES = (
     "dragonfly2_tpu.daemon.peer.task_manager",
     "dragonfly2_tpu.daemon.peer.device_sink",
     "dragonfly2_tpu.scheduler.service",
+    "dragonfly2_tpu.proto.reportcodec",
     "dragonfly2_tpu.qos.wfq",
     "dragonfly2_tpu.qos.admission",
     "dragonfly2_tpu.delta.chunker",
@@ -56,8 +57,9 @@ COMPONENTS = ("bufpool", "chaos", "dataset", "delta", "device_sink",
               "scheduler", "storage", "tracing", "upload")
 
 # Histogram families must name their unit; counters use _total; gauges
-# may end in a unit but never _total.
-UNITS = ("seconds", "bytes", "ms")
+# may end in a unit but never _total. "pieces" is a unit here: batch-size
+# histograms (scheduler_ingest_batch_pieces) count pieces, not time/bytes.
+UNITS = ("seconds", "bytes", "ms", "pieces")
 
 DOCS = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "docs", "OBSERVABILITY.md")

@@ -44,7 +44,21 @@ from dragonfly2_tpu.storage import io_ring
 ring = io_ring.ring_backend()
 assert ring in ("threads", "serial"), ring
 
-print("FALLBACK-OK", backend, ring)
+from dragonfly2_tpu.proto import reportcodec
+report = reportcodec.report_backend()
+assert report in ("numpy", "python"), report
+packed = reportcodec.encode_reports([
+    {"piece_num": 4, "range_start": 4096, "range_size": 4096,
+     "digest": "crc32c:00c0ffee", "download_cost_ms": 3,
+     "dst_peer_id": "peer-a"},
+    {"piece_num": 5, "range_start": 8192, "range_size": 512,
+     "download_cost_ms": 0, "dst_peer_id": ""},
+])
+batch = reportcodec.decode_packed(packed)
+assert batch.nums == [4, 5] and batch.cost_total == 3, batch.to_dicts()
+assert batch.to_dicts()[0]["digest"] == "crc32c:00c0ffee"
+
+print("FALLBACK-OK", backend, ring, report)
 """
 
 

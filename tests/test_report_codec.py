@@ -1,0 +1,573 @@
+"""Packed piece-report wire codec (proto/reportcodec) + announce diet.
+
+The packed ``pieces_finished`` form is only allowed to change *speed*:
+- encode → decode must reconstruct the exact dict batch (or the encoder
+  must refuse), fuzzed over seeded random report streams;
+- every decode backend (native / numpy / python) must return the same
+  batch and aggregates;
+- the scheduler's bulk apply must land the exact FSM state the per-piece
+  dict walk lands, fuzzed at SchedulerService level;
+- a malformed packed body is dropped, never a stream-killer;
+- the conductor only emits packed after the scheduler advertised
+  ``packed_reports`` on a stamped answer, and downgrades on failover;
+- a failed flush restores the un-sent batch in order (the deque
+  ``extendleft(reversed(batch))`` pin).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import random
+
+import pytest
+
+from dragonfly2_tpu.proto import reportcodec
+from dragonfly2_tpu.proto.reportcodec import (
+    CodecError,
+    bitmap_to_nums,
+    decode_packed,
+    encode_reports,
+    nums_to_bitmap,
+)
+from dragonfly2_tpu.scheduler.config import SchedulerConfig
+from dragonfly2_tpu.scheduler.service import SchedulerService
+
+
+def mk_body(host: str, peer: str, task: str = "t", slice_: str = "") -> dict:
+    return {
+        "host": {"id": host, "hostname": host, "ip": "10.0.0.1",
+                 "port": 1, "upload_port": 2, "tpu_slice": slice_},
+        "peer_id": peer, "task_id": task, "url": "http://origin/f"}
+
+
+def _normalize(report: dict) -> dict:
+    """What to_dicts() reconstructs: every column present, timings only
+    when the original carried a truthy dict (None values → 0, the dict
+    walk's own coercion)."""
+    d = {"piece_num": report["piece_num"],
+         "range_start": report["range_start"],
+         "range_size": report["range_size"],
+         "digest": report.get("digest", ""),
+         "download_cost_ms": report.get("download_cost_ms", 0),
+         "dst_peer_id": report.get("dst_peer_id", "")}
+    t = report.get("timings")
+    if t:
+        d["timings"] = {k: int(t.get(k) or 0)
+                        for k in ("dcn_ms", "stall_ms", "store_ms")}
+    return d
+
+
+def _rand_report(rng: random.Random, num: int, parents: list) -> dict:
+    r = {"piece_num": num,
+         "range_start": num * 4096,
+         "range_size": rng.choice((0, 512, 4096, (1 << 32) - 1)),
+         "dst_peer_id": rng.choice(parents),
+         "download_cost_ms": rng.choice((0, 1, 7, 25, (1 << 32) - 1))}
+    digest_kind = rng.randrange(4)
+    if digest_kind == 1:
+        r["digest"] = f"crc32c:{rng.randrange(1 << 32):08x}"
+    elif digest_kind == 2:
+        r["digest"] = "sha256:" + "".join(
+            rng.choice("0123456789abcdef") for _ in range(16))
+    elif digest_kind == 3:
+        r["digest"] = f"crc32c:{rng.randrange(1 << 32):08X}"  # spills: uppercase
+    timing_kind = rng.randrange(4)
+    if timing_kind == 1:
+        r["timings"] = {}
+    elif timing_kind == 2:
+        r["timings"] = {"dcn_ms": rng.randrange(1 << 20),
+                        "stall_ms": rng.randrange(100),
+                        "store_ms": rng.randrange(100)}
+    elif timing_kind == 3:
+        r["timings"] = {"dcn_ms": rng.randrange(1 << 20), "stall_ms": None}
+    return r
+
+
+# --------------------------------------------------------------------- #
+# Encode → decode round trip
+# --------------------------------------------------------------------- #
+
+class TestRoundTrip:
+    def test_basic_round_trip(self):
+        reports = [
+            {"piece_num": 5, "range_start": 5 << 20, "range_size": 1 << 20,
+             "digest": "crc32c:00c0ffee", "download_cost_ms": 12,
+             "dst_peer_id": "parent-1",
+             "timings": {"dcn_ms": 9, "stall_ms": 1, "store_ms": 2}},
+            {"piece_num": 2, "range_start": 2 << 20, "range_size": 1 << 20,
+             "digest": "md5:deadbeef", "download_cost_ms": 0,
+             "dst_peer_id": ""},
+            {"piece_num": 6, "range_start": 6 << 20, "range_size": 77,
+             "download_cost_ms": 3, "dst_peer_id": "parent-1"},
+        ]
+        packed = encode_reports(reports)
+        assert packed is not None and packed["v"] == 1 and packed["n"] == 3
+        # The crc32c digest rides the column word, only md5 spills.
+        assert packed["digests"] == {1: "md5:deadbeef"}
+        batch = decode_packed(packed)
+        assert batch.to_dicts() == [_normalize(r) for r in reports]
+        # Batch aggregates match a hand fold.
+        assert batch.cost_total == 15
+        assert batch.bytes_total == (1 << 20) * 2 + 77
+        assert batch.phase_ms == (9 + 0 + 3, 1, 2)   # untimed cost → dcn
+        # parent_aggs in peer-intern order: parent-1 then "".
+        assert batch.peers == ["parent-1", ""]
+        assert batch.parent_aggs == [[2, 15, (1 << 20) + 77],
+                                     [1, 0, 1 << 20]]
+
+    def test_wire_size_beats_dict_form(self):
+        import msgpack
+
+        rng = random.Random(7)
+        reports = [_rand_report(rng, n, ["p-long-peer-id-000001"])
+                   for n in range(256)]
+        for r in reports:       # all crc digests: the common verified case
+            r["digest"] = f"crc32c:{rng.randrange(1 << 32):08x}"
+            r.pop("timings", None)
+        packed = encode_reports(reports)
+        dict_bytes = len(msgpack.packb({"type": "pieces_finished",
+                                        "pieces": reports},
+                                       use_bin_type=True))
+        packed_bytes = len(msgpack.packb({"type": "pieces_finished",
+                                          "packed": packed},
+                                         use_bin_type=True))
+        assert packed_bytes * 3 <= dict_bytes
+
+    @pytest.mark.parametrize("bad", [
+        {"piece_num": 0, "range_start": 0, "range_size": 1, "extra": 1},
+        {"piece_num": 0.0, "range_start": 0, "range_size": 1},
+        {"piece_num": True, "range_start": 0, "range_size": 1},
+        {"piece_num": -1, "range_start": 0, "range_size": 1},
+        {"piece_num": 1 << 63, "range_start": 0, "range_size": 1},
+        {"piece_num": 0, "range_size": 1},                    # no range_start
+        {"piece_num": 0, "range_start": 0},                   # no range_size
+        {"piece_num": 0, "range_start": -1, "range_size": 1},
+        {"piece_num": 0, "range_start": 1 << 64, "range_size": 1},
+        {"piece_num": 0, "range_start": 0, "range_size": 1 << 32},
+        {"piece_num": 0, "range_start": 0, "range_size": 1,
+         "download_cost_ms": 2.5},
+        {"piece_num": 0, "range_start": 0, "range_size": 1,
+         "download_cost_ms": -3},
+        {"piece_num": 0, "range_start": 0, "range_size": 1,
+         "dst_peer_id": 7},
+        {"piece_num": 0, "range_start": 0, "range_size": 1, "digest": 9},
+        {"piece_num": 0, "range_start": 0, "range_size": 1,
+         "timings": {"dcn_ms": 1, "surprise_ms": 2}},
+        {"piece_num": 0, "range_start": 0, "range_size": 1,
+         "timings": {"dcn_ms": 1.5}},
+        {"piece_num": 0, "range_start": 0, "range_size": 1,
+         "timings": [1, 2, 3]},
+        "not-a-dict",
+    ])
+    def test_encoder_refuses_inexact_reports(self, bad):
+        good = {"piece_num": 1, "range_start": 0, "range_size": 4}
+        assert encode_reports([good, bad]) is None
+
+    def test_empty_batch_refused(self):
+        assert encode_reports([]) is None
+
+    def test_peer_intern_table_overflow_refused(self):
+        reports = [{"piece_num": i, "range_start": 0, "range_size": 1,
+                    "dst_peer_id": f"p{i}"} for i in range(0x10000)]
+        assert encode_reports(reports) is None
+        assert encode_reports(reports[:0xFFFF]) is not None
+
+    def test_none_timings_values_coerce_like_dict_walk(self):
+        # The dict walk does int(timings.get(k, 0) or 0): None → 0. The
+        # encoder must represent that exactly, not refuse it.
+        r = {"piece_num": 3, "range_start": 0, "range_size": 8,
+             "timings": {"dcn_ms": 5, "stall_ms": None}}
+        batch = decode_packed(encode_reports([r]))
+        assert batch.to_dicts()[0]["timings"] == {
+            "dcn_ms": 5, "stall_ms": 0, "store_ms": 0}
+
+    def test_empty_timings_dict_treated_as_absent(self):
+        r = {"piece_num": 3, "range_start": 0, "range_size": 8,
+             "download_cost_ms": 4, "timings": {}}
+        batch = decode_packed(encode_reports([r]))
+        assert "timings" not in batch.to_dicts()[0]
+        assert batch.phase_ms == (4, 0, 0)   # whole cost lands in dcn
+
+
+# --------------------------------------------------------------------- #
+# Backend ladder: every rung returns the same batch
+# --------------------------------------------------------------------- #
+
+class TestBackends:
+    def test_a_backend_selected(self):
+        assert reportcodec.report_backend() in ("native", "numpy", "python")
+
+    def test_rungs_agree_on_fuzzed_batches(self):
+        rungs = [("python", reportcodec._decode_python)]
+        if reportcodec.np is not None:
+            rungs.append(("numpy", reportcodec._decode_numpy))
+        native = reportcodec._native_decoder()
+        if native is not None:
+            rungs.append(("native", native))
+        rng = random.Random(0xD1E7)
+        parents = ["", "peer-a", "peer-b", "peer-with-a-long-identity"]
+        for round_no in range(25):
+            n = rng.randrange(1, 200)
+            nums = rng.sample(range(1 << 20), n)
+            reports = [_rand_report(rng, num, parents) for num in nums]
+            packed = encode_reports(reports)
+            assert packed is not None, reports
+            spill = dict(packed.get("digests") or {})
+            ref = None
+            for name, decode in rungs:
+                got = decode(packed["nums"], packed["cols"], packed["n"],
+                             list(packed["peers"]), dict(spill))
+                if ref is None:
+                    ref = got
+                    assert got.to_dicts() == [_normalize(r) for r in reports]
+                    continue
+                assert got.to_dicts() == ref.to_dicts(), (name, round_no)
+                assert got.parent_aggs == ref.parent_aggs, (name, round_no)
+                assert got.phase_ms == ref.phase_ms, (name, round_no)
+                assert (got.cost_total, got.bytes_total, got.min_cost) == (
+                    ref.cost_total, ref.bytes_total, ref.min_cost), name
+
+
+# --------------------------------------------------------------------- #
+# Structural decode rejects (CodecError, never a crash)
+# --------------------------------------------------------------------- #
+
+def _valid_packed() -> dict:
+    return encode_reports([
+        {"piece_num": i, "range_start": i * 64, "range_size": 64,
+         "dst_peer_id": "p", "download_cost_ms": 1} for i in range(4)])
+
+
+class TestDecodeRejects:
+    @pytest.mark.parametrize("mutate", [
+        lambda p: p.update(v=2),
+        lambda p: p.update(n="4"),
+        lambda p: p.update(n=True),
+        lambda p: p.update(n=-1),
+        lambda p: p.update(n=5),                      # cols length mismatch
+        lambda p: p.update(peers=[b"bytes-peer"]),
+        lambda p: p.update(peers="p"),
+        lambda p: p.update(nums="not-bytes"),
+        lambda p: p.update(cols=None),
+        lambda p: p.update(nums=p["nums"][:-1]),      # truncated varint
+        lambda p: p.update(nums=p["nums"] + b"\x00"),  # trailing bytes
+        lambda p: p.update(nums=b"\xff" * 12),        # varint overlong
+        lambda p: p.update(nums=b"\x01" + p["nums"][1:]),  # goes negative
+        lambda p: p.update(cols=p["cols"][:-1]),
+        lambda p: p.update(digests={"0": "x"}),
+        lambda p: p.update(digests={9: "x"}),         # spill index >= n
+        lambda p: p.update(digests=[("a", 1)]),
+    ])
+    def test_malformed_packed_raises_codec_error(self, mutate):
+        packed = _valid_packed()
+        mutate(packed)
+        with pytest.raises(CodecError):
+            decode_packed(packed)
+
+    def test_peer_index_out_of_range(self):
+        packed = _valid_packed()
+        packed["peers"] = []          # every column's peer_idx=0 now dangles
+        with pytest.raises(CodecError):
+            decode_packed(packed)
+
+    def test_non_dict_body(self):
+        with pytest.raises(CodecError):
+            decode_packed("nope")
+
+
+# --------------------------------------------------------------------- #
+# RESUME bitmap
+# --------------------------------------------------------------------- #
+
+class TestBitmap:
+    def test_round_trip_fuzz(self):
+        rng = random.Random(99)
+        for _ in range(50):
+            nums = sorted(rng.sample(range(5000), rng.randrange(1, 300)))
+            bitmap = nums_to_bitmap(nums)
+            assert len(bitmap) == (max(nums) >> 3) + 1
+            assert bitmap_to_nums(bitmap) == nums
+
+    def test_empty(self):
+        assert nums_to_bitmap([]) == b""
+        assert bitmap_to_nums(b"") == []
+        assert bitmap_to_nums(b"\x00\x00") == []
+
+    def test_dense_range_is_one_bit_per_piece(self):
+        nums = list(range(4096))
+        assert len(nums_to_bitmap(nums)) == 512
+
+
+# --------------------------------------------------------------------- #
+# Scheduler FSM equivalence: packed apply ≡ dict walk
+# --------------------------------------------------------------------- #
+
+def _service_with_parents(slices=("s1", "s2", "")):
+    svc = SchedulerService(SchedulerConfig())
+    _h, task, child = svc._resolve(mk_body("host-c", "peer-c", slice_="s1"))
+    parents = []
+    for i, sl in enumerate(slices):
+        _h2, _t, parent = svc._resolve(
+            mk_body(f"host-{i}", f"parent-{i}", slice_=sl))
+        parents.append(parent.id)
+    return svc, task, child, parents
+
+
+def _dump(svc, task, peers_ids):
+    peers = {pid: svc.peers.load(pid) for pid in peers_ids}
+    return {
+        "peers": {pid: {
+            "fin": sorted(p.finished_pieces),
+            "costs": list(p.piece_costs),
+            "upload": p.host.upload_count,
+        } for pid, p in peers.items() if p is not None},
+        "pieces": {num: (pi.range_start, pi.range_size, pi.digest,
+                         pi.download_cost_ms, pi.dst_peer_id)
+                   for num, pi in task.pieces.items()},
+        "pod": {tid: entry["hosts"]
+                for tid, entry in svc.pod_flight._tasks.items()},
+        "fleet": (svc.fleet.series.window(300)["totals"]
+                  if svc.fleet is not None else {}),
+    }
+
+
+class TestFsmEquivalence:
+    def test_fuzz_packed_vs_dict_state(self, run_async):
+        async def body():
+            rng = random.Random(0xBEEF)
+            svc_d, task_d, child_d, parents = _service_with_parents()
+            svc_p, task_p, child_p, parents_p = _service_with_parents()
+            assert parents == parents_p
+            pool = parents + ["", "ghost-peer"]   # unknown parent too
+            all_ids = [child_d.id] + parents
+            seen: list = []
+            for _ in range(20):
+                if seen and rng.random() < 0.3:
+                    # Re-report: dup pieces must bridge to the dict walk
+                    # on the packed side and still match.
+                    nums = rng.sample(seen, min(len(seen), 5))
+                    if rng.random() < 0.5:
+                        nums += rng.sample(
+                            [n for n in range(4000) if n not in seen], 3)
+                else:
+                    nums = rng.sample(
+                        [n for n in range(4000) if n not in seen],
+                        rng.randrange(1, 40))
+                if rng.random() < 0.1 and len(nums) > 2:
+                    nums[1] = nums[0]            # dup WITHIN the batch
+                seen.extend(n for n in nums if n not in seen)
+                reports = [_rand_report(rng, num, pool) for num in nums]
+                packed = encode_reports(reports)
+                assert packed is not None
+                svc_d._handle_pieces_finished(
+                    {"pieces": reports}, task_d, child_d)
+                svc_p._handle_pieces_finished(
+                    {"packed": packed}, task_p, child_p)
+                assert _dump(svc_d, task_d, all_ids) == \
+                    _dump(svc_p, task_p, all_ids)
+
+        run_async(body(), timeout=60)
+
+    def test_malformed_packed_dropped_stream_survives(self, run_async):
+        async def body():
+            svc, task, child, parents = _service_with_parents()
+            packed = _valid_packed()
+            packed["cols"] = packed["cols"][:-1]
+            before = _dump(svc, task, [child.id] + parents)
+            svc._handle_pieces_finished({"packed": packed}, task, child)
+            assert _dump(svc, task, [child.id] + parents) == before
+            # The stream keeps working: a well-formed batch still lands.
+            svc._handle_pieces_finished({"packed": _valid_packed()},
+                                        task, child)
+            assert sorted(child.finished_pieces) == [0, 1, 2, 3]
+
+        run_async(body(), timeout=30)
+
+    def test_resume_register_accepts_bitmap(self, run_async):
+        class _Stream:
+            def __init__(self):
+                self.sent: list = []
+
+            async def send(self, m):
+                self.sent.append(m)
+
+        async def body():
+            svc, task, child, _ = _service_with_parents()
+            nums = [0, 1, 2, 5, 9, 700]
+            _h, t2, p2 = svc._resolve(mk_body("host-r", "peer-r"))
+            p2.announce_stream = _Stream()
+            await svc._handle_resume_register(t2, p2, {
+                "piece_nums": [],
+                "piece_bitmap": nums_to_bitmap(nums),
+                "content_length": 701 * 4, "piece_size": 4,
+                "total_piece_count": 701})
+            assert sorted(p2.finished_pieces) == nums
+            ans = p2.announce_stream.sent[-1]
+            assert ans["type"] == "normal_task"
+            assert ans.get("packed_reports") is True   # capability stamped
+
+        run_async(body(), timeout=30)
+
+
+# --------------------------------------------------------------------- #
+# Conductor: negotiation, adaptive batching, requeue order
+# --------------------------------------------------------------------- #
+
+from dragonfly2_tpu.storage import StorageManager, StorageOption, TaskStoreMetadata  # noqa: E402
+
+
+def _make_conductor(tmp_path, *, pieces=2, piece_size=4, report_batch=32):
+    from dragonfly2_tpu.daemon.peer.conductor import PeerTaskConductor
+    from dragonfly2_tpu.daemon.peer.piece_manager import PieceManager
+
+    sm = StorageManager(StorageOption(data_dir=str(tmp_path / "data")))
+    content_length = pieces * piece_size
+    store = sm.register_task(TaskStoreMetadata(
+        task_id="codec-t", peer_id="p1", url="http://x/f",
+        piece_size=piece_size, content_length=content_length,
+        total_piece_count=math.ceil(content_length / piece_size)))
+    for i in range(pieces):
+        store.write_piece(i, b"a" * piece_size)
+    return PeerTaskConductor(
+        task_id="codec-t", peer_id="p1", url="http://x/f", store=store,
+        scheduler_client=None, piece_manager=PieceManager(),
+        host_info={"id": "h1"}, report_batch=report_batch)
+
+
+class _DeadStream:
+    closed = True
+
+
+class _RecordingStream:
+    closed = False
+
+    def __init__(self):
+        self.sent: list = []
+
+    async def send(self, body):
+        self.sent.append(body)
+
+
+def _report(num: int) -> dict:
+    return {"piece_num": num, "range_start": num * 4, "range_size": 4,
+            "download_cost_ms": 1, "dst_peer_id": "parent-x"}
+
+
+class TestConductorWire:
+    def test_packed_only_after_negotiation(self, run_async, tmp_path):
+        async def body():
+            c = _make_conductor(tmp_path)
+            batch = [_report(0), _report(1)]
+            # Before any stamped answer: legacy dict list.
+            assert "pieces" in c._batch_msg(batch)
+            # Scheduler advertises the capability on a stamped answer.
+            c._note_clock_sample(0.0, {"type": "normal_task",
+                                       "packed_reports": True})
+            msg = c._batch_msg(batch)
+            assert "packed" in msg and "pieces" not in msg
+            assert decode_packed(msg["packed"]).to_dicts() == \
+                [_normalize(r) for r in batch]
+            # Failover to an old scheduler: the next answer lacks the
+            # flag and the conductor downgrades.
+            c._note_clock_sample(0.0, {"type": "normal_task"})
+            assert "pieces" in c._batch_msg(batch)
+
+        run_async(body(), timeout=30)
+
+    def test_single_report_rides_piece_finished(self, run_async, tmp_path):
+        async def body():
+            c = _make_conductor(tmp_path)
+            c._packed_ok = True
+            msg = c._batch_msg([_report(3)])
+            assert msg["type"] == "piece_finished"
+
+        run_async(body(), timeout=30)
+
+    def test_unpackable_batch_falls_back_to_dicts(self, run_async, tmp_path):
+        async def body():
+            c = _make_conductor(tmp_path)
+            c._packed_ok = True
+            batch = [_report(0),
+                     dict(_report(1), download_cost_ms=1.5)]   # float: refuse
+            assert "pieces" in c._batch_msg(batch)
+
+        run_async(body(), timeout=30)
+
+    def test_failed_flush_requeues_in_order(self, run_async, tmp_path):
+        async def body():
+            c = _make_conductor(tmp_path, report_batch=2)
+            c._stream = _DeadStream()
+            reports = [_report(i) for i in range(5)]
+            c._pending_reports.extend(reports)
+            assert await c._flush_reports() is False
+            # The popped batch went back IN ORDER at the head: a resend
+            # after recovery replays reports in original arrival order.
+            assert list(c._pending_reports) == reports
+
+        run_async(body(), timeout=30)
+
+    def test_cancelled_flush_requeues_in_order(self, run_async, tmp_path):
+        async def body():
+            c = _make_conductor(tmp_path, report_batch=8)
+            reports = [_report(i) for i in range(3)]
+            c._pending_reports.extend(reports)
+
+            async def boom(msg):
+                raise asyncio.CancelledError
+
+            c._safe_send = boom
+            with pytest.raises(asyncio.CancelledError):
+                await c._flush_reports()
+            assert list(c._pending_reports) == reports
+
+        run_async(body(), timeout=30)
+
+    def test_flush_drains_in_capped_messages(self, run_async, tmp_path):
+        async def body():
+            c = _make_conductor(tmp_path, report_batch=4)
+            c._packed_ok = True
+            stream = _RecordingStream()
+            c._stream = stream
+            c._pending_reports.extend(_report(i) for i in range(10))
+            assert await c._flush_reports() is True
+            assert not c._pending_reports
+            sizes = []
+            for msg in stream.sent:
+                if msg["type"] == "piece_finished":
+                    sizes.append(1)
+                else:
+                    sizes.append(decode_packed(msg["packed"]).n)
+            assert sizes == [4, 4, 2]
+
+        run_async(body(), timeout=30)
+
+    def test_resume_state_bitmap_negotiated_and_dense(
+            self, run_async, tmp_path):
+        async def body():
+            c = _make_conductor(tmp_path, pieces=24)
+            # Not negotiated: plain int list.
+            resume = c._resume_state()
+            assert resume["piece_nums"] == list(range(24))
+            assert "piece_bitmap" not in resume
+            # Negotiated + dense: the bitmap replaces the list.
+            c._packed_ok = True
+            resume = c._resume_state()
+            assert resume["piece_nums"] == []
+            assert bitmap_to_nums(resume["piece_bitmap"]) == list(range(24))
+
+        run_async(body(), timeout=30)
+
+    def test_resume_state_sparse_set_keeps_list_form(
+            self, run_async, tmp_path):
+        async def body():
+            c = _make_conductor(tmp_path, pieces=2)
+            c._packed_ok = True
+            # Fake a pathologically sparse landed set: bitmap would be
+            # huge, the density gate keeps the int list.
+            c.store.metadata.pieces = {i * 10000: None for i in range(20)}
+            resume = c._resume_state()
+            assert "piece_bitmap" not in resume
+            assert len(resume["piece_nums"]) == 20
+
+        run_async(body(), timeout=30)

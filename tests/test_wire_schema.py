@@ -64,9 +64,21 @@ class TestSchemas:
             wire.validate_stream_msg("Scheduler.AnnouncePeer", {
                 "type": "pieces_finished",
                 "pieces": [{"piece_num": "not-an-int"}]})
-        with pytest.raises(wire.SchemaError, match="pieces"):
+        # Either wire form is schema-legal: the legacy dict list above,
+        # or the negotiated packed batch (envelope types only — the
+        # structural decode lives in proto/reportcodec). A bare message
+        # carries neither and validates as an empty batch.
+        wire.validate_stream_msg("Scheduler.AnnouncePeer", {
+            "type": "pieces_finished",
+            "packed": {"v": 1, "n": 1, "peers": ["p"],
+                       "nums": b"\x00", "cols": b"\x00" * 36}})
+        with pytest.raises(wire.SchemaError, match="packed"):
             wire.validate_stream_msg("Scheduler.AnnouncePeer", {
-                "type": "pieces_finished"})
+                "type": "pieces_finished",
+                "packed": {"v": 1, "n": 1, "peers": [7],
+                           "nums": b"", "cols": b""}})
+        wire.validate_stream_msg("Scheduler.AnnouncePeer", {
+            "type": "pieces_finished"})
 
     def test_every_registered_schema_accepts_empty_optional(self):
         # Optional-only messages validate {} (no accidental requireds).
