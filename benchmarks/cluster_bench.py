@@ -1,0 +1,309 @@
+"""BASELINE config #15: cluster control tower overhead + frame bounds.
+
+The control tower (pkg/cluster) rides the production keepalive path of
+every scheduler and the manager's ingest loop, so its cost must be
+provably negligible and its frames provably bounded. Three parts:
+
+  1. ``storm`` — 16 simulated schedulers each driving the observatory's
+     real batch ingest path (``note_pieces`` + decision feeds), paired
+     on/off: ``on`` additionally builds fleet frames at keepalive
+     cadence and folds them into one manager-side ClusterSeries;
+     ``off`` runs the identical workload alone. The two sides run
+     interleaved at per-scheduler-chunk (~ms) granularity inside each
+     order-alternating round so both sample the same machine
+     contention; overhead = MEDIAN of per-round paired CPU-time ratios
+     (the PR 7 estimator, pairing pushed down to chunk scale). Budget
+     <= 3%, guarded by tests/test_baseline_json.py.
+  2. ``frame_bounds`` — every frame built in (1) must encode under the
+     byte cap; plus a worst-case frame (thousands of straggler /
+     quarantined hosts) proving halving-until-fit holds at the cap.
+  3. ``spool_reopen`` — frames spooled into a real sqlite file survive
+     a close + reopen and restore into a fresh ClusterSeries (the
+     manager-restart path).
+
+Usage:
+  python benchmarks/cluster_bench.py [--rounds 6] [--quick] [--publish]
+
+Publishes BASELINE.json["published"]["config15_cluster"].
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from dragonfly2_tpu.manager.database import Database  # noqa: E402
+from dragonfly2_tpu.pkg import fleet as fleetlib  # noqa: E402
+from dragonfly2_tpu.pkg.cluster import (  # noqa: E402
+    FRAME_MAX_BYTES,
+    ClusterSeries,
+    FrameBuilder,
+    TelemetrySpool,
+)
+
+N_SCHEDULERS = 16
+
+
+def _mk_observatory(i: int) -> fleetlib.FleetObservatory:
+    return fleetlib.FleetObservatory(
+        bucket_s=1.0, buckets=120, decision_cap=1024, max_hosts=256,
+        sampler=lambda: {"hosts_total": 64, "hosts_seed": 2,
+                         "hosts_quarantined": 1, "peers_running": 32,
+                         "tasks_active": 4, "straggler_hosts": 1})
+
+
+def _drive(obs: fleetlib.FleetObservatory, sched: int,
+           batches: int) -> None:
+    """The per-scheduler workload: coalesced piece-report batches plus a
+    decision mix — the same feed mix the DES sim exercises, scaled to a
+    keepalive interval's worth of traffic."""
+    for b in range(batches):
+        host = f"h{sched}-{b % 64}"
+        parent = f"h{sched}-{(b + 1) % 64}"
+        obs.note_pieces(host, 8, 64.0,
+                        by_parent={parent: [8, 64.0, 8 << 20,
+                                            fleetlib.C_BYTES_INTRA]},
+                        timings={"dcn_ms": 4, "stall_ms": 0,
+                                 "store_ms": 1})
+        if b % 8 == 0:
+            obs.note_handout(f"t{b % 4}", f"p{b}", host,
+                             chosen=(parent,), rejected=())
+        if b % 32 == 0:
+            obs.note_back_source(f"t{b % 4}", f"p{b}", host,
+                                 reason="no parents")
+        if b % 64 == 0:
+            obs.note_quarantine(f"t{b % 4}", host, "corrupt")
+
+
+def _paired_round(first_on: bool, batches: int,
+                  frames_per_sched: int) -> tuple[float, float, int, int]:
+    """One paired round at 16 schedulers; returns (cpu_on_s, cpu_off_s,
+    frames_built, frame_bytes_peak).
+
+    The ``on`` workload (observatory feed + frame build at keepalive
+    cadence + manager-side ClusterSeries fold) and the identical ``off``
+    workload (feed alone, its own observatories) run INTERLEAVED at
+    per-scheduler-chunk granularity (~ms), order-alternating within the
+    round (``first_on`` plus a per-scheduler flip). This box's CPU-time
+    readings jitter ~30% between back-to-back multi-hundred-ms passes
+    (shared-machine cache/bandwidth contention), so whole-pass pairing
+    drowns a ~1% signal; millisecond interleave makes both sides sample
+    the same contention and a null round (off vs off) reads 1.00 +- 0.015.
+    """
+    obs_on = [_mk_observatory(i) for i in range(N_SCHEDULERS)]
+    obs_off = [_mk_observatory(i) for i in range(N_SCHEDULERS)]
+    builders = [FrameBuilder(obs, hostname=f"sched{i}",
+                             quarantined=lambda: ["hq-1"])
+                for i, obs in enumerate(obs_on)]
+    series = ClusterSeries()
+    for b in builders:
+        # One cold build outside the clocks: the first build pays the
+        # one-off resident-bytes deep walk (then cached for
+        # RESIDENT_REFRESH_S) — a boot cost, not the steady-state
+        # keepalive price this bench pins.
+        b.build()
+    cpu_on = cpu_off = 0.0
+    frames = 0
+    peak = 0
+    chunk = max(1, batches // frames_per_sched)
+    # Collect, then freeze the collector for the timed region: cyclic-GC
+    # pauses land on whichever side happens to cross a threshold.
+    gc.collect()
+    gc.disable()
+    for start in range(0, batches, chunk):
+        n = min(chunk, batches - start)
+        for i in range(N_SCHEDULERS):
+            sides = (True, False) if first_on ^ (i % 2 == 1) \
+                else (False, True)
+            for on_side in sides:
+                t0 = time.process_time()
+                if on_side:
+                    _drive(obs_on[i], i, n)
+                    frame = builders[i].build()
+                    assert frame is not None
+                    assert frame["bytes"] <= builders[i].max_bytes, frame
+                    peak = max(peak, frame["bytes"])
+                    assert series.ingest(f"sched{i}", f"10.0.0.{i}",
+                                         frame) == 1
+                    frames += 1
+                else:
+                    _drive(obs_off[i], i, n)
+                dt = time.process_time() - t0
+                if on_side:
+                    cpu_on += dt
+                else:
+                    cpu_off += dt
+    gc.enable()
+    report = series.report(3600.0)
+    assert report["totals"].get("pieces_landed", 0) > 0
+    assert len(report["schedulers"]) == N_SCHEDULERS
+    return cpu_on, cpu_off, frames, peak
+
+
+def _median(vals: list) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2.0
+
+
+def run_storm(rounds: int, batches: int,
+              frames_per_sched: int) -> dict:
+    on, off, ratios = [], [], []
+    peak = 0
+    frames = 0
+    _paired_round(True, batches, frames_per_sched)     # warm-up discarded
+    if rounds % 2:
+        rounds += 1               # even rounds: each side leads equally
+    for i in range(rounds):
+        cpu_on, cpu_off, frames, pk = _paired_round(
+            bool(i % 2), batches, frames_per_sched)
+        on.append(cpu_on)
+        off.append(cpu_off)
+        peak = max(peak, pk)
+        ratios.append(cpu_on / cpu_off)
+    return {
+        "schedulers": N_SCHEDULERS,
+        "batches_per_scheduler": batches,
+        "frames_per_scheduler": frames_per_sched,
+        "rounds": rounds,
+        "frames_per_round": frames,
+        "frame_bytes_peak": peak,
+        "frame_bytes_max": FRAME_MAX_BYTES,
+        "runs_cpu_s": {"on": [round(v, 4) for v in on],
+                       "off": [round(v, 4) for v in off]},
+        "pair_ratios": [round(r, 4) for r in ratios],
+        "cpu_overhead_frac": round(_median(ratios) - 1.0, 4),
+    }
+
+
+def run_frame_bounds() -> dict:
+    """Worst case: thousands of flagged/quarantined hosts must still
+    halve down under the cap."""
+    obs = _mk_observatory(0)
+    _drive(obs, 0, 256)
+    obs.scorecards._stragglers.update(
+        f"very-long-host-name-{i:05d}.pod.example" for i in range(4096))
+    builder = FrameBuilder(
+        obs, hostname="worst",
+        quarantined=lambda: [f"quarantined-host-{i:05d}.pod.example"
+                             for i in range(4096)])
+    frame = builder.build()
+    assert frame["bytes"] <= FRAME_MAX_BYTES, frame["bytes"]
+    assert frame.get("truncated") is True
+    return {"hosts_offered": 8192, "frame_bytes": frame["bytes"],
+            "truncated": True,
+            "stragglers_kept": len(frame["stragglers"]),
+            "quarantined_kept": len(frame["quarantined"])}
+
+
+def run_spool_reopen(frames: int = 64) -> dict:
+    """Spool into a real sqlite file, close, reopen, restore — the
+    manager-restart path the e2e drills with processes."""
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "manager.db")
+        db = Database(path)
+        spool = TelemetrySpool(db, max_bytes=256 * 1024)
+        obs = _mk_observatory(0)
+        builder = FrameBuilder(obs, hostname="sched0")
+        for i in range(frames):
+            _drive(obs, 0, 16)
+            spool.store("sched0", "10.0.0.1", builder.build())
+        before = spool.frame_count()
+        bytes_before = spool.bytes
+        db.close()
+
+        db2 = Database(path)
+        series = ClusterSeries(spool=TelemetrySpool(
+            db2, max_bytes=256 * 1024))
+        report = series.report(3600.0)
+        db2.close()
+        return {
+            "frames_stored": frames,
+            "frames_before": before,
+            "bytes_before": bytes_before,
+            "restored_frames": series.restored_frames,
+            "restored_pieces": report["totals"].get("pieces_landed", 0),
+            "survives": series.restored_frames == before > 0,
+        }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=6)
+    ap.add_argument("--batches", type=int, default=4096,
+                    help="piece-report batches per scheduler per round")
+    ap.add_argument("--frames", type=int, default=4,
+                    help="frames per scheduler per round (keepalive "
+                         "cadence vs the report storm: ~1k coalesced "
+                         "batches, ~8k pieces, per frame at defaults)")
+    ap.add_argument("--quick", action="store_true",
+                    help="2048 batches instead of 4096")
+    ap.add_argument("--publish", action="store_true")
+    args = ap.parse_args()
+
+    batches = 2048 if args.quick else args.batches
+
+    storm = run_storm(args.rounds, batches, args.frames)
+    print(json.dumps({"storm": storm}), flush=True)
+    frame_bounds = run_frame_bounds()
+    print(json.dumps({"frame_bounds": frame_bounds}), flush=True)
+    spool_reopen = run_spool_reopen()
+    print(json.dumps({"spool_reopen": spool_reopen}), flush=True)
+
+    result = {
+        "storm": storm,
+        "frame_bounds": frame_bounds,
+        "spool_reopen": spool_reopen,
+        "note": ("paired control-tower on/off at 16 simulated "
+                 "schedulers: on = the observatory report storm PLUS "
+                 "frame builds at keepalive cadence and the manager-side "
+                 "ClusterSeries fold; off = the identical storm alone, "
+                 "interleaved with on at per-scheduler-chunk (~ms) "
+                 "granularity inside each order-alternating round so "
+                 "both sides sample the same machine contention; "
+                 "overhead = MEDIAN of per-round paired CPU-time ratios "
+                 "(the config9 estimator, pairing pushed to chunk "
+                 "scale); every frame asserted under the byte cap "
+                 "(halving-until-fit also proven at 8192 offered "
+                 "hosts); spool_reopen = frames survive a real sqlite "
+                 "close + reopen and restore into a fresh "
+                 "ClusterSeries"),
+    }
+    print(json.dumps(result))
+
+    if storm["cpu_overhead_frac"] > 0.03:
+        print(f"FAIL: control-tower storm overhead "
+              f"{storm['cpu_overhead_frac']:.2%} exceeds the 3% budget",
+              file=sys.stderr)
+        return 1
+    if storm["frame_bytes_peak"] > FRAME_MAX_BYTES:
+        print(f"FAIL: frame bytes {storm['frame_bytes_peak']} exceed "
+              f"the {FRAME_MAX_BYTES} cap", file=sys.stderr)
+        return 1
+    if not spool_reopen["survives"]:
+        print("FAIL: spool did not survive a sqlite reopen",
+              file=sys.stderr)
+        return 1
+
+    if args.publish:
+        path = os.path.join(REPO, "BASELINE.json")
+        doc = json.load(open(path))
+        doc.setdefault("published", {})["config15_cluster"] = result
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -179,6 +179,14 @@ def _add_manager(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--port", type=int, default=8080, help="REST port")
     p.add_argument("--grpc-port", type=int, default=65003, help="drpc port")
     p.add_argument("--db", default=":memory:", help="sqlite path (default in-memory)")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="fixed port for /metrics + /debug/cluster* "
+                        "(0 = ephemeral, negative disables)")
+    p.add_argument("--keepalive-timeout", type=float, default=60.0,
+                   help="seconds before a silent scheduler/seed-peer "
+                        "keepalive flips the row inactive")
+    p.add_argument("--keepalive-gc-interval", type=float, default=30.0,
+                   help="seconds between expire_stale sweeps")
     p.set_defaults(func=_run_manager)
 
 
@@ -190,6 +198,9 @@ def _run_manager(args: argparse.Namespace) -> int:
         server=RestConfig(host=args.host, port=args.port),
         grpc=GrpcConfig(host=args.host, port=args.grpc_port),
         database=DatabaseConfig(path=args.db),
+        keepalive_timeout=args.keepalive_timeout,
+        keepalive_gc_interval=args.keepalive_gc_interval,
+        metrics_port=args.metrics_port,
     )
 
     async def run() -> int:

@@ -63,6 +63,12 @@ def _add_dfget(sub: argparse._SubParsersAction) -> None:
                         "phase bars, slowest host named) — the same "
                         "waterfall /debug/pod/<task_id>/timeline?format="
                         "text renders")
+    p.add_argument("--cluster", action="store_true",
+                   help="with --explain and --manager: also fetch and "
+                        "print the manager's merged cluster control-tower "
+                        "view (per-scheduler fleet rollup, stragglers "
+                        "attributed to their owning scheduler) — the same "
+                        "view /debug/cluster?format=text renders")
     p.add_argument("--recursive", action="store_true")
     p.add_argument("--level", type=int, default=5, help="recursion depth")
     p.add_argument("--timeout", type=float, default=0.0)
@@ -160,6 +166,25 @@ def _run_dfget(args: argparse.Namespace) -> int:
         pod_info = result.get("pod") or {}
         if args.pod and pod_info.get("text"):
             sys.stderr.write(pod_info["text"] + "\n")
+        if args.cluster:
+            if not args.manager:
+                sys.stderr.write("dfget: --cluster needs --manager "
+                                 "host:port\n")
+            else:
+                try:
+                    from dragonfly2_tpu.manager.client import ManagerClient
+                    from dragonfly2_tpu.pkg.types import NetAddr
+
+                    mhost, _, mport = args.manager.rpartition(":")
+                    mc = ManagerClient(NetAddr.tcp(mhost, int(mport)))
+                    try:
+                        view = await mc.cluster_view()
+                    finally:
+                        await mc.close()
+                    sys.stderr.write(view.get("text", "") + "\n")
+                except Exception as e:
+                    sys.stderr.write(f"dfget: cluster view unavailable: "
+                                     f"{e}\n")
         return 0
 
     try:

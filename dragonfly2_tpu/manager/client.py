@@ -8,13 +8,25 @@ keepalive stream on failure; same loop here as an asyncio task).
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Any
 
-from dragonfly2_tpu.pkg import dflog
+from dragonfly2_tpu.pkg import dflog, metrics
 from dragonfly2_tpu.pkg.types import NetAddr
 from dragonfly2_tpu.rpc.client import Client
 
 log = dflog.get("manager.client")
+
+PAYLOAD_COUNT = metrics.counter(
+    "manager_keepalive_payload_total",
+    "Keepalive payload provider outcomes on the client side, by result "
+    "(ok = dict merged, absent = no provider or non-dict, error = "
+    "provider raised — the warn log for errors is rate-limited, this "
+    "counter is the continuous signal)", ("result",))
+
+# A broken payload provider raises every tick forever; warn at most once
+# per this many seconds and let the counter carry the rate.
+_PAYLOAD_WARN_INTERVAL = 60.0
 
 
 class ManagerClient:
@@ -78,6 +90,13 @@ class ManagerClient:
             "Manager.TakeJobTokens",
             {"cluster_ids": cluster_ids, "tokens": tokens}, timeout=10.0)
 
+    async def cluster_view(self, window_s: float = 600.0) -> dict:
+        """The manager's merged cluster control-tower view (pkg/cluster):
+        {"report": {...}, "text": rendered} — what ``dfget --explain
+        --cluster`` prints."""
+        return await self._client.call(
+            "Manager.ClusterView", {"window_s": window_s}, timeout=10.0)
+
     async def complete_job(self, group_id: str, task_uuid: str, state: str,
                            result: dict[str, Any]) -> None:
         await self._client.call("Manager.CompleteJob", {
@@ -101,6 +120,9 @@ class ManagerClient:
     async def _keepalive_loop(self, *, source_type: str, hostname: str, ip: str,
                               cluster_id: int, interval: float,
                               payload=None) -> None:
+        children = {r: PAYLOAD_COUNT.labels(r)
+                    for r in ("ok", "error", "absent")}
+        last_warn = 0.0
         while True:
             try:
                 stream = await self._client.open_stream("Manager.KeepAlive", {
@@ -115,9 +137,21 @@ class ManagerClient:
                                 extra = payload()
                                 if isinstance(extra, dict):
                                     msg.update(extra)
+                                    children["ok"].inc()
+                                else:
+                                    children["absent"].inc()
                             except Exception as e:
-                                log.warning("keepalive payload provider "
-                                            "failed", error=str(e))
+                                children["error"].inc()
+                                now = time.monotonic()
+                                if now - last_warn >= _PAYLOAD_WARN_INTERVAL:
+                                    last_warn = now
+                                    log.warning(
+                                        "keepalive payload provider failed "
+                                        "(warn rate-limited; see manager_"
+                                        "keepalive_payload_total)",
+                                        error=str(e))
+                        else:
+                            children["absent"].inc()
                         await stream.send(msg)
                 finally:
                     await stream.close()

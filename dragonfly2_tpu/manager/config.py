@@ -6,6 +6,8 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass, field
 
+from dragonfly2_tpu.pkg.prof import ProfConfig
+
 
 @dataclass
 class RestConfig:
@@ -26,11 +28,32 @@ class DatabaseConfig:
 
 
 @dataclass
+class ClusterConfig:
+    """Cluster control tower bounds (pkg/cluster): the merged
+    per-scheduler fleet view, its event journal, and the durable
+    telemetry spool in the manager's sqlite."""
+
+    spool_max_bytes: int = 2 * 1024 * 1024   # compressed frame budget
+    event_cap: int = 1024                    # journal ring length
+    frames_per_scheduler: int = 240          # in-memory frames kept
+
+
+@dataclass
 class ManagerConfig:
     server: RestConfig = field(default_factory=RestConfig)
     grpc: GrpcConfig = field(default_factory=GrpcConfig)
     database: DatabaseConfig = field(default_factory=DatabaseConfig)
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    # Runtime observatory (pkg/prof): /debug/prof* on the manager's
+    # metrics server, same arming as the scheduler and daemon roles.
+    prof: ProfConfig = field(default_factory=ProfConfig)
     keepalive_gc_interval: float = 30.0
+    # Liveness window before expire_stale flips a silent instance
+    # inactive (reference manager/rpcserver keepalive TTL).
+    keepalive_timeout: float = 60.0
+    # Prometheus + /debug/cluster* endpoint; 0 = ephemeral port,
+    # negative disables (the scheduler/daemon convention).
+    metrics_port: int = 0
 
     @classmethod
     def from_dict(cls, d: dict) -> "ManagerConfig":
@@ -41,6 +64,11 @@ class ManagerConfig:
             cfg.grpc = GrpcConfig(**d["grpc"])
         if "database" in d:
             cfg.database = DatabaseConfig(**d["database"])
-        cfg.keepalive_gc_interval = d.get(
-            "keepalive_gc_interval", cfg.keepalive_gc_interval)
+        if "cluster" in d:
+            cfg.cluster = ClusterConfig(**d["cluster"])
+        if "prof" in d:
+            cfg.prof = ProfConfig(**d["prof"])
+        for key in ("keepalive_gc_interval", "keepalive_timeout",
+                    "metrics_port"):
+            setattr(cfg, key, d.get(key, getattr(cfg, key)))
         return cfg

@@ -357,6 +357,11 @@ class RestServer:
         if not admitted:
             import math
 
+            # A storm of push-backs edge-triggers ONE admission_burst
+            # event in the cluster journal (pkg/cluster), not one per
+            # denied request.
+            self.service.cluster.note_admission_429(
+                detail.get("tenant", tenant))
             return web.json_response(
                 {"message": "tenant over burn-rate budget",
                  "tenant": detail.get("tenant", tenant),
@@ -375,6 +380,7 @@ class RestServer:
         if not granted:
             import math
 
+            self.service.cluster.note_admission_429(tenant)
             return web.json_response(
                 {"message": "rate limit exceeded",
                  "retry_after_s": round(retry_after, 3)},

@@ -36,6 +36,7 @@ class ManagerRpcServer:
         server.register_unary("Manager.PollJob", self._poll_job)
         server.register_unary("Manager.CompleteJob", self._complete_job)
         server.register_unary("Manager.TakeJobTokens", self._take_job_tokens)
+        server.register_unary("Manager.ClusterView", self._cluster_view)
         server.register_stream("Manager.KeepAlive", self._keep_alive)
 
     async def _get_scheduler(self, body: dict, ctx: RpcContext) -> dict:
@@ -100,6 +101,15 @@ class ManagerRpcServer:
             body.get("cluster_ids") or [], int(body.get("tokens", 1)))
         return {"granted": granted, "retry_after_s": retry_after}
 
+    async def _cluster_view(self, body: dict, ctx: RpcContext) -> dict:
+        """The merged cluster control-tower view (``dfget --explain
+        --cluster``): the report plus its one-true-renderer text."""
+        from dragonfly2_tpu.pkg.cluster import render_cluster
+
+        window = float((body or {}).get("window_s", 600.0))
+        report = self.service.cluster.report(window)
+        return {"report": report, "text": render_cluster(report)}
+
     async def _keep_alive(self, stream: ServerStream, ctx: RpcContext) -> None:
         """Open body: {source_type, hostname, ip, cluster_id}. Each further
         message refreshes liveness; stream close marks the instance inactive
@@ -120,6 +130,17 @@ class ManagerRpcServer:
                     # Scheduler-piggybacked per-tenant burn snapshot
                     # (dragonfly2_tpu/qos) feeding job admission.
                     self.service.ingest_tenant_burn(msg["tenant_burn"])
+                if source_type == "scheduler":
+                    # Cluster control tower: fold the piggybacked fleet
+                    # frame in (fail-open), or mark the scheduler
+                    # no_data when it ships none (older wire) — either
+                    # way liveness above already counted.
+                    if isinstance(msg, dict) and \
+                            msg.get("fleet_frame") is not None:
+                        self.service.ingest_fleet_frame(
+                            hostname, ip, msg["fleet_frame"])
+                    else:
+                        self.service.note_frameless_keepalive(hostname, ip)
         finally:
             self.service.mark_inactive(source_type, hostname, ip, cluster_id,
                                        gen=gen)

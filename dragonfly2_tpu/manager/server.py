@@ -22,13 +22,21 @@ class ManagerServer:
     def __init__(self, config: ManagerConfig | None = None):
         self.config = config or ManagerConfig()
         self.db = Database(self.config.database.path)
-        self.service = ManagerService(self.db)
+        self.service = ManagerService(
+            self.db,
+            keepalive_timeout=self.config.keepalive_timeout,
+            spool_max_bytes=self.config.cluster.spool_max_bytes,
+            cluster_event_cap=self.config.cluster.event_cap,
+            frames_per_scheduler=self.config.cluster.frames_per_scheduler)
         self.rest = RestServer(self.service)
         self.rpc = Server("manager")
         ManagerRpcServer(self.service).register(self.rpc)
         self.gc = GC(log)
         self.gc.add(GCTask("keepalive", self.config.keepalive_gc_interval, 10.0,
                            self._expire))
+        self.metrics = None         # Prometheus + /debug/cluster* endpoint
+        self.prof_obs = None        # runtime observatory (pkg/prof)
+        self._prof_probe = None     # its manager-loop lag probe
         self._stopped = asyncio.Event()
 
     async def _expire(self) -> None:
@@ -39,6 +47,20 @@ class ManagerServer:
     async def start(self) -> None:
         await self.rest.serve(self.config.server.host, self.config.server.port)
         await self.rpc.serve(NetAddr.tcp(self.config.grpc.host, self.config.grpc.port))
+        if self.config.prof.enabled:
+            from dragonfly2_tpu.pkg import prof as proflib
+
+            self.prof_obs = proflib.install(self.config.prof)
+            self._prof_probe = self.prof_obs.arm_loop("manager")
+        if self.config.metrics_port >= 0:
+            from dragonfly2_tpu.pkg.metrics_server import MetricsServer
+
+            # Loopback by default — the cluster control tower serves the
+            # merged per-scheduler fleet view at /debug/cluster*, the
+            # runtime observatory /debug/prof*.
+            self.metrics = MetricsServer(
+                cluster=self.service.cluster, prof=self.prof_obs)
+            await self.metrics.serve("127.0.0.1", self.config.metrics_port)
         self.gc.serve()
         log.info("manager up", rest_port=self.rest.port, grpc_port=self.rpc.port())
 
@@ -53,8 +75,22 @@ class ManagerServer:
     def grpc_port(self) -> int:
         return self.rpc.port()
 
+    def metrics_port(self) -> int:
+        return self.metrics.port if self.metrics is not None else -1
+
     async def stop(self) -> None:
         self.gc.stop()
+        if self.metrics is not None:
+            await self.metrics.close()
+        if self.prof_obs is not None:
+            from dragonfly2_tpu.pkg import prof as proflib
+
+            if self._prof_probe is not None:
+                self._prof_probe.disarm()
+                self.prof_obs.probes.pop(self._prof_probe.name, None)
+                self._prof_probe = None
+            proflib.release(self.prof_obs)
+            self.prof_obs = None
         await self.rest.close()
         await self.rpc.close()
         self.db.close()

@@ -30,8 +30,13 @@ _CACHE_TTL = 10.0
 
 class ManagerService:
     def __init__(self, db: Database | None = None, *,
-                 searcher_plugin: str = ""):
+                 searcher_plugin: str = "",
+                 keepalive_timeout: float = KEEPALIVE_TIMEOUT,
+                 spool_max_bytes: int = 2 * 1024 * 1024,
+                 cluster_event_cap: int = 1024,
+                 frames_per_scheduler: int = 240):
         self.db = db or Database()
+        self.keepalive_timeout = keepalive_timeout
         if searcher_plugin:
             # Plugin-replaceable scheduler-cluster searcher (reference
             # searcher.go:94 New → dfplugin lookup).
@@ -66,6 +71,17 @@ class ManagerService:
         from dragonfly2_tpu.qos import AdmissionController
 
         self.admission = AdmissionController()
+        # Cluster control tower (pkg/cluster): per-scheduler fleet frames
+        # off the keepalive wire merged into /debug/cluster*, an
+        # edge-triggered event journal, and a durable spool in the same
+        # sqlite so the view survives a manager restart.
+        from dragonfly2_tpu.pkg import cluster as clusterlib
+
+        self.cluster = clusterlib.ClusterSeries(
+            journal=clusterlib.ClusterEventJournal(cluster_event_cap),
+            spool=clusterlib.TelemetrySpool(
+                self.db, max_bytes=spool_max_bytes),
+            frames_per_scheduler=frames_per_scheduler)
         self._ensure_defaults()
 
     def _ensure_defaults(self) -> None:
@@ -281,6 +297,11 @@ class ManagerService:
                else "seed_peer_cluster_id")
         row = self.db.find(table, hostname=hostname, ip=ip, **{key: cluster_id})
         if row:
+            if table == "schedulers" and row["state"] == INACTIVE:
+                # Return transition: the lapsed scheduler is back — an
+                # edge event, not a silent row flip (satellite of the
+                # expire_stale lapse event below).
+                self.cluster.note_return(hostname, ip)
             self.db.update(table, row["id"],
                            {"state": ACTIVE, "last_keepalive_at": time.time()})
 
@@ -295,6 +316,8 @@ class ManagerService:
         row = self.db.find(table, hostname=hostname, ip=ip, **{key: cluster_id})
         if row:
             self.db.update(table, row["id"], {"state": INACTIVE})
+            if table == "schedulers":
+                self.cluster.note_lapse(hostname, ip)
 
     # -- tenant QoS admission (dragonfly2_tpu/qos) ------------------------
 
@@ -316,15 +339,41 @@ class ManagerService:
         return self.admission.check(tenant)
 
     def expire_stale(self) -> int:
-        """Flip rows whose keepalive lapsed to inactive (GC task)."""
-        cutoff = time.time() - KEEPALIVE_TIMEOUT
+        """Flip rows whose keepalive lapsed to inactive (GC task). A
+        lapsing SCHEDULER additionally lands in the cluster event journal
+        and the manager_cluster_schedulers{state} gauge — a dead
+        scheduler must be visible without polling the REST list."""
+        cutoff = time.time() - self.keepalive_timeout
         n = 0
         for table in ("schedulers", "seed_peers"):
             for row in self.db.list(table, state=ACTIVE):
                 if row["last_keepalive_at"] < cutoff:
                     self.db.update(table, row["id"], {"state": INACTIVE})
+                    if table == "schedulers":
+                        self.cluster.note_lapse(row["hostname"], row["ip"])
                     n += 1
         return n
+
+    # -- cluster control tower (pkg/cluster) ------------------------------
+
+    def ingest_fleet_frame(self, hostname: str, ip: str, frame: Any) -> int:
+        """Fold a scheduler's keepalive-piggybacked fleet frame into the
+        cluster view. Fail-open like ingest_tenant_burn: a malformed
+        frame is counted and dropped, the keepalive stream never sees an
+        exception."""
+        try:
+            return self.cluster.ingest(hostname, ip, frame)
+        except Exception:
+            return 0
+
+    def note_frameless_keepalive(self, hostname: str, ip: str) -> None:
+        """A scheduler keepalive arrived without a fleet frame (an older
+        wire): full liveness semantics, cluster view shows ``no_data``
+        instead of inventing zeros."""
+        try:
+            self.cluster.mark_seen(hostname, ip)
+        except Exception:
+            pass
 
     # -- dynconfig read paths ---------------------------------------------
 

@@ -274,7 +274,10 @@ class FleetTimeSeries:
         self.bucket(now)               # rotate so stale slots read zero
         want = max(1, min(self.n_buckets, int(seconds / self.bucket_s) + 1))
         cur = int(now / self.bucket_s)
-        idx = [COUNTERS.index(c) for c in columns]
+        # The all-columns call (cluster frame builder, every keepalive)
+        # skips the per-column index scans.
+        idx = range(len(COUNTERS)) if columns is COUNTERS \
+            else [COUNTERS.index(c) for c in columns]
         sums = [0.0] * len(idx)
         for a in range(cur - want + 1, cur + 1):
             slot = a % self.n_buckets
@@ -301,6 +304,24 @@ class FleetTimeSeries:
             if a >= 0 and self._gauge_stamp[slot] == a:
                 out.append(self._gauges[slot][i])
         return out
+
+    def gauges_last(self, seconds: float) -> dict:
+        """Newest sampled gauge row within the trailing window, as
+        {name: value} — {} when the sampler never ran in the window
+        (never fabricates zeros). The sampler stamps every gauge into
+        one bucket, so one reverse scan serves all columns; the cluster
+        frame builder needs this every keepalive and per-column
+        ``gauge_column()`` calls would re-walk the ring once per gauge."""
+        now = self._clock()
+        self.bucket(now)
+        want = max(1, min(self.n_buckets, int(seconds / self.bucket_s) + 1))
+        cur = int(now / self.bucket_s)
+        for a in range(cur, cur - want, -1):
+            slot = a % self.n_buckets
+            if a >= 0 and self._gauge_stamp[slot] == a:
+                grow = self._gauges[slot]
+                return {name: grow[i] for i, name in enumerate(GAUGES)}
+        return {}
 
     def resident_bytes(self) -> int:
         return (_deep_bytes(self._counts) + _deep_bytes(self._gauges)
@@ -552,12 +573,17 @@ class DecisionLog:
     """Bounded ring of decision tuples (one tuple per decision, the
     flight-ring discipline). Query iterates newest-first."""
 
-    __slots__ = ("cap", "_ring", "_n")
+    __slots__ = ("cap", "_ring", "_n", "_kind_counts")
 
     def __init__(self, cap: int = 1024):
         self.cap = cap
         self._ring: list = [None] * cap
         self._n = 0
+        # Lifetime per-kind counts: the cluster frame builder ships
+        # deltas of these (pkg/cluster), which must not scan the ring
+        # and must not read the process-global prometheus counter (it
+        # aggregates every service in the process).
+        self._kind_counts: dict = {}
 
     def record(self, kind: str, *, task: str = "", host: str = "",
                peer: str = "", reason: str = "",
@@ -566,11 +592,16 @@ class DecisionLog:
         self._ring[self._n % self.cap] = (
             time.time(), kind, task, host, peer, reason, chosen, rejected)
         self._n += 1
+        self._kind_counts[kind] = self._kind_counts.get(kind, 0) + 1
         _decision_child(kind).inc()
 
     @property
     def recorded_total(self) -> int:
         return self._n
+
+    @property
+    def kind_counts(self) -> dict:
+        return self._kind_counts
 
     def query(self, *, host: str = "", task: str = "", kind: str = "",
               limit: int = 256, since: float = 0.0,

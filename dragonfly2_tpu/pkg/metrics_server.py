@@ -33,6 +33,16 @@ Routes: GET /metrics (Prometheus text; OpenMetrics via Accept),
         truncated marker), GET /debug/fleet/info (scheduler uptime /
         build / config snapshot). All fleet routes are backed by the
         bounded pkg/fleet observatory the scheduler passes in.
+        GET /debug/cluster[?window=][&format=text] (manager: the merged
+        cluster control-tower view — every scheduler's keepalive fleet
+        frames folded with per-scheduler attribution),
+        GET /debug/cluster/schedulers (per-scheduler state: active /
+        inactive / no_data, frames, latest sets),
+        GET /debug/cluster/slo (per-scheduler SLO condensate + breached
+        union), GET /debug/cluster/events?kind=|scheduler=|n=|since=|
+        before= (the edge-triggered cluster event journal). All cluster
+        routes are backed by the bounded pkg/cluster series the manager
+        passes in.
 
 The route table is a class attribute (``ROUTES``) so tooling and the
 docs lint (tests/test_metrics_lint.py) can introspect every registered
@@ -100,23 +110,30 @@ class MetricsServer:
         ("/debug/fleet/hosts", "_fleet_hosts"),
         ("/debug/fleet/decisions", "_fleet_decisions"),
         ("/debug/fleet/info", "_fleet_info"),
+        ("/debug/cluster", "_cluster_view"),
+        ("/debug/cluster/schedulers", "_cluster_schedulers"),
+        ("/debug/cluster/slo", "_cluster_slo"),
+        ("/debug/cluster/events", "_cluster_events"),
     )
 
     def __init__(self, *, flight: "flightlib.FlightRecorder | None" = None,
                  pod_flight: "flightlib.PodAggregator | None" = None,
-                 fleet=None, slo=None, pod_timeline=None, prof=None):
+                 fleet=None, slo=None, pod_timeline=None, prof=None,
+                 cluster=None):
         # Optional providers: the daemon passes its flight recorder, the
         # scheduler its pod aggregator + fleet observatory + SLO engine
         # + pod-timeline assembler (an async callable task_id -> report,
         # so the on-demand FlightReport pulls stay in the scheduler);
-        # BOTH pass the runtime observatory (pkg/prof) behind the
-        # /debug/prof* family; endpoints 404 without one.
+        # the manager its cluster control tower (pkg/cluster) behind the
+        # /debug/cluster* family; ALL pass the runtime observatory
+        # (pkg/prof) behind /debug/prof*; endpoints 404 without one.
         self._flight = flight
         self._pod_flight = pod_flight
         self._fleet = fleet
         self._slo_engine = slo
         self._pod_timeline_provider = pod_timeline
         self._prof_obs = prof
+        self._cluster = cluster
         self._runner: web.AppRunner | None = None
         self._port = 0
         self._profiling = False
@@ -350,6 +367,70 @@ class MetricsServer:
         """Scheduler identity card: uptime, build, config snapshot, and
         the observatory's own bounds + resident bytes."""
         return web.json_response(self._need_fleet().info())
+
+    def _need_cluster(self):
+        if self._cluster is None:
+            raise web.HTTPNotFound(text="no cluster control tower on this "
+                                        "binary (manager-only)\n")
+        return self._cluster
+
+    async def _cluster_view(self, request: web.Request) -> web.Response:
+        """The merged cluster view (manager binary): every scheduler's
+        keepalive fleet frames folded into cluster totals with
+        per-scheduler straggler/quarantine/breach attribution over the
+        trailing ``?window=`` seconds (default 600). ``?format=text``
+        renders the same view ``dfget --explain --cluster`` prints."""
+        cluster = self._need_cluster()
+        try:
+            window = max(1.0, float(request.query.get("window", "600")))
+        except ValueError:
+            return web.Response(text="bad window value\n", status=400)
+        report = cluster.report(window)
+        if request.query.get("format") == "text":
+            from dragonfly2_tpu.pkg.cluster import render_cluster
+
+            return web.Response(text=render_cluster(report))
+        return web.json_response(report)
+
+    async def _cluster_schedulers(self, request: web.Request) -> web.Response:
+        """Per-scheduler detail: state (active / inactive / no_data —
+        no_data = alive keepalive, no fleet frames), frame counts and
+        age, latest straggler/quarantine sets and gauges."""
+        cluster = self._need_cluster()
+        try:
+            window = max(1.0, float(request.query.get("window", "600")))
+        except ValueError:
+            return web.Response(text="bad window value\n", status=400)
+        return web.json_response(cluster.schedulers_report(window))
+
+    async def _cluster_slo(self, request: web.Request) -> web.Response:
+        """Per-scheduler SLO condensate (worst burn + state per SLO, as
+        shipped in the frames) and the cluster-wide breached union."""
+        cluster = self._need_cluster()
+        try:
+            window = max(1.0, float(request.query.get("window", "600")))
+        except ValueError:
+            return web.Response(text="bad window value\n", status=400)
+        return web.json_response(cluster.slo_report(window))
+
+    async def _cluster_events(self, request: web.Request) -> web.Response:
+        """The cluster event journal, newest first: keepalive lapse /
+        return, slo_breach, straggler, quarantine_storm, admission_burst
+        — filterable by ?kind= / ?scheduler= and bounded by ?since= /
+        ?before= (wall seconds, half-open [since, before)). ?n= caps the
+        page (hard cap 4096); ``truncated: true`` marks a capped page."""
+        cluster = self._need_cluster()
+        try:
+            limit = min(max(int(request.query.get("n", "256")), 1), 4096)
+            since = float(request.query.get("since", "0") or 0)
+            before = float(request.query.get("before", "0") or 0)
+        except ValueError:
+            return web.Response(text="bad n/since/before value\n",
+                                status=400)
+        return web.json_response(cluster.journal.query(
+            kind=request.query.get("kind", ""),
+            scheduler=request.query.get("scheduler", ""),
+            limit=limit, since=since, before=before))
 
     async def _heap(self, request: web.Request) -> web.Response:
         """Heap allocation snapshot via tracemalloc (armed on first call;

@@ -85,6 +85,9 @@ class FleetConfig:
     # Advisory candidate filter: flagged stragglers are dropped from
     # parent candidate sets (each drop is recorded in the decision log).
     straggler_filter: bool = True
+    # Cluster control tower (pkg/cluster): hard byte cap on the fleet
+    # frame each manager keepalive carries (halving-until-fit past it).
+    frame_max_bytes: int = 8192
 
 
 @dataclass
@@ -145,6 +148,12 @@ class SchedulerConfig:
     # metrics server + the loop_lag SLO probe wired into the engine.
     prof: ProfConfig = field(default_factory=ProfConfig)
     manager_addr: str = ""                 # manager drpc for registration
+    # Advertised hostname for manager registration and cluster-frame
+    # attribution; "" = socket.gethostname(). Multi-scheduler tests on
+    # one machine need distinct identities (the manager keys schedulers
+    # by hostname+ip+cluster).
+    hostname: str = ""
+    manager_keepalive_interval: float = 5.0
     cluster_id: int = 1
     # Durable persistent-cache state (reference: Redis-backed
     # scheduler/resource/persistentcache); ":memory:" = tests/dev.

@@ -151,7 +151,11 @@ class SchedulerServer:
         self.announcer = SchedulerAnnouncer(
             self.config.manager_addr, cluster_id=self.config.cluster_id,
             port=self.port(), ip=self.config.server.advertise_ip or "127.0.0.1",
-            qos_payload=self.service.tenant_burn_payload)
+            hostname=self.config.hostname,
+            keepalive_interval=self.config.manager_keepalive_interval,
+            # tenant burn-book snapshot + the cluster fleet frame ride
+            # every keepalive (service.manager_payload).
+            qos_payload=self.service.manager_payload)
         await self.announcer.start()
         self.dynconfig = SchedulerDynconfig(
             self.announcer.client,

@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 
 from dragonfly2_tpu.pkg import aio, dflog
+from dragonfly2_tpu.pkg import cluster as clusterlib
 from dragonfly2_tpu.pkg import fleet as fleetlib
 from dragonfly2_tpu.pkg import flight as flightlib
 from dragonfly2_tpu.pkg import podlens as podlenslib
@@ -198,6 +199,35 @@ class SchedulerService:
             restored = self.restore_from_snapshot()
             if restored:
                 log.info("state restored from snapshot", **restored)
+        # Cluster control tower (pkg/cluster): a bounded fleet frame —
+        # time-series rollup since last ship, SLO burn, straggler /
+        # quarantined sets, decision-kind deltas — rides every manager
+        # keepalive next to tenant_burn (manager_payload below).
+        self.frame_builder: "clusterlib.FrameBuilder | None" = None
+        if self.fleet is not None:
+            self.frame_builder = clusterlib.FrameBuilder(
+                self.fleet, slo=self.slo,
+                hostname=self.config.hostname,
+                quarantined=self._quarantined_hosts,
+                max_bytes=self.config.fleet.frame_max_bytes)
+
+    def _quarantined_hosts(self) -> list:
+        return [h.id for h in self.hosts.all() if h.quarantined()]
+
+    def manager_payload(self) -> dict:
+        """Everything the scheduler piggybacks on its manager keepalive:
+        the tenant burn-book snapshot (job admission) plus the cluster
+        fleet frame. Frame build failures are logged and dropped — a
+        telemetry bug must never stall the liveness wire."""
+        out = self.tenant_burn_payload()
+        if self.frame_builder is not None:
+            try:
+                frame = self.frame_builder.build()
+                if frame is not None:
+                    out["fleet_frame"] = frame
+            except Exception:
+                log.warning("fleet frame build failed", exc_info=True)
+        return out
 
     def tenant_burn_payload(self) -> dict:
         """Keepalive piggyback for the manager's admission controller:

@@ -36,6 +36,7 @@ REQUIRED_CONFIGS = (
     "config13_qos",
     "config14_wire",
     "config5_pod_sim_churn_16k",
+    "config15_cluster",
     "ingest_micro",
 )
 
@@ -472,6 +473,39 @@ def test_pod_sim_churn_16k_scale_pair_shape():
     assert entry["peers_after_gc"] == 0
     assert entry["tasks_after_gc"] == 0
     assert entry["hosts_after_gc"] == 0
+
+
+def test_cluster_entry_paired_shape():
+    """config15_cluster is the control tower's overhead evidence: a
+    PAIRED storm (frame build + manager ingest ON vs the same scheduler
+    churn with no tower) interleaved at per-scheduler-chunk granularity,
+    order-alternating — recompute the median from the published per-
+    round ratios — within the <=3% budget; every frame built in the
+    storm stayed under the wire cap; the frame-bounds round proves the
+    halving-until-fit cap on absurd host sets; and the spool round
+    proves the shipped window survives a real sqlite close/reopen."""
+    entry = _load()["published"]["config15_cluster"]
+    storm = entry["storm"]
+    assert storm["schedulers"] >= 16
+    assert storm["frames_per_round"] > 0
+    runs = storm["runs_cpu_s"]
+    assert len(runs["on"]) == len(runs["off"]) == storm["rounds"]
+    assert all(v > 0 for v in runs["on"] + runs["off"])
+    ratios = sorted(storm["pair_ratios"])
+    assert len(ratios) == storm["rounds"] and len(ratios) % 2 == 0
+    median = (ratios[len(ratios) // 2 - 1] + ratios[len(ratios) // 2]) / 2
+    assert storm["cpu_overhead_frac"] == pytest.approx(
+        median - 1.0, abs=1e-3)
+    assert storm["cpu_overhead_frac"] <= 0.03, storm["cpu_overhead_frac"]
+    # Every frame the storm built fit the keepalive wire cap.
+    assert 0 < storm["frame_bytes_peak"] <= storm["frame_bytes_max"], storm
+    bounds = entry["frame_bounds"]
+    assert bounds["truncated"] is True, "cap never engaged — no evidence"
+    assert 0 < bounds["frame_bytes"] <= storm["frame_bytes_max"], bounds
+    assert bounds["hosts_offered"] > bounds["stragglers_kept"], bounds
+    spool = entry["spool_reopen"]
+    assert spool["survives"] is True, spool
+    assert spool["restored_frames"] == spool["frames_stored"] > 0, spool
 
 
 def test_stripe_sim_meets_acceptance_bounds():

@@ -1,0 +1,512 @@
+"""Cluster control tower units + manager integration: frame builder
+bounds, decision deltas, event journal, burst detector, telemetry spool,
+ClusterSeries merge/attribution/edge events, mixed-version ``no_data``
+degrade over the real keepalive wire, the keepalive payload counter
+satellite, and the manager MetricsServer /debug/cluster* routes
+(including scrape-under-load)."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from dragonfly2_tpu.manager.client import ManagerClient
+from dragonfly2_tpu.manager.config import ManagerConfig
+from dragonfly2_tpu.manager.database import Database
+from dragonfly2_tpu.manager.server import ManagerServer
+from dragonfly2_tpu.pkg import cluster as clusterlib
+from dragonfly2_tpu.pkg import fleet as fleetlib
+from dragonfly2_tpu.pkg import metrics
+from dragonfly2_tpu.pkg.cluster import (
+    FRAME_MAX_BYTES,
+    AdmissionBurstDetector,
+    ClusterEventJournal,
+    ClusterSeries,
+    FrameBuilder,
+    TelemetrySpool,
+    render_cluster,
+)
+from dragonfly2_tpu.pkg.metrics import parse_labeled_samples
+from dragonfly2_tpu.pkg.types import NetAddr
+
+
+def _mk_obs(**kw):
+    kw.setdefault("bucket_s", 0.5)
+    kw.setdefault("buckets", 60)
+    kw.setdefault("sampler", lambda: {"hosts_total": 8, "hosts_seed": 1,
+                                      "peers_running": 3})
+    return fleetlib.FleetObservatory(**kw)
+
+
+def _frame(**kw):
+    f = {"v": 1, "host": "s", "ts": time.time(), "window_s": 1.0,
+         "counters": {}, "gauges": {}, "stragglers": [], "quarantined": [],
+         "decisions": {}, "resident_bytes": 1000}
+    f.update(kw)
+    return f
+
+
+def _samples(full_name: str, label: str) -> dict:
+    return parse_labeled_samples(metrics.render()[0].decode(),
+                                 full_name, label)
+
+
+# -- frame builder ----------------------------------------------------------
+
+class TestFrameBuilder:
+    def test_rollup_and_window(self):
+        obs = _mk_obs()
+        obs.note_pieces("h1", 4, 32.0,
+                        by_parent={"h2": [4, 32.0, 1 << 20,
+                                          fleetlib.C_BYTES_INTRA]},
+                        timings={"dcn_ms": 2, "stall_ms": 0, "store_ms": 1})
+        b = FrameBuilder(obs, hostname="sched-a")
+        frame = b.build()
+        assert frame["v"] == 1 and frame["host"] == "sched-a"
+        assert frame["counters"]["pieces_landed"] == 4
+        # Zero columns are omitted, not shipped as zeros.
+        assert "back_source" not in frame["counters"]
+        assert frame["gauges"]["hosts_total"] == 8
+        assert frame["bytes"] == clusterlib._enc_len(
+            {k: v for k, v in frame.items() if k != "bytes"})
+        assert frame["window_s"] >= obs.series.bucket_s
+        assert frame["resident_bytes"] > 0
+
+    def test_decision_deltas_sum_cleanly(self):
+        obs = _mk_obs()
+        b = FrameBuilder(obs, hostname="s")
+        obs.note_handout("t1", "p1", "h1", chosen=("h2",), rejected=())
+        f1 = b.build()
+        assert f1["decisions"].get("handout") == 1
+        f2 = b.build()                # nothing new since f1
+        assert f2["decisions"] == {}
+        obs.note_handout("t1", "p2", "h1", chosen=("h2",), rejected=())
+        obs.note_handout("t1", "p3", "h1", chosen=("h2",), rejected=())
+        f3 = b.build()
+        assert f3["decisions"]["handout"] == 2
+        total = sum(f["decisions"].get("handout", 0) for f in (f1, f2, f3))
+        assert total == dict(obs.decisions.kind_counts)["handout"] == 3
+
+    def test_cap_halves_host_sets(self):
+        obs = _mk_obs()
+        obs.scorecards._stragglers.update(
+            f"straggler-host-{i:04d}.example" for i in range(512))
+        b = FrameBuilder(
+            obs, hostname="s", max_bytes=2048,
+            quarantined=lambda: [f"bad-host-{i:04d}" for i in range(512)])
+        frame = b.build()
+        assert frame["bytes"] <= 2048
+        assert frame["truncated"] is True
+        assert 0 < len(frame["stragglers"]) < 512
+        assert 0 < len(frame["quarantined"]) < 512
+
+    def test_no_observatory_returns_none(self):
+        assert FrameBuilder(None).build() is None
+
+    def test_resident_bytes_cached_between_builds(self):
+        obs = _mk_obs()
+        clock = [100.0]
+        b = FrameBuilder(obs, hostname="s", clock=lambda: clock[0])
+        calls = []
+        real = obs.resident_bytes
+        obs.resident_bytes = lambda: calls.append(1) or real()
+        b.build()
+        clock[0] += 1.0
+        b.build()                     # inside the refresh window: cached
+        assert len(calls) == 1
+        clock[0] += FrameBuilder.RESIDENT_REFRESH_S
+        b.build()
+        assert len(calls) == 2
+
+
+# -- event journal + burst detector ----------------------------------------
+
+class TestJournal:
+    def test_record_query_filters_and_bounds(self):
+        j = ClusterEventJournal(cap=8)
+        j.record("bogus_kind", scheduler="x")   # rejected, not recorded
+        assert j.recorded_total == 0
+        t0 = time.time()
+        for i in range(12):
+            j.record("lapse" if i % 2 else "straggler",
+                     scheduler=f"sched-{i % 3}", subject=f"h{i}")
+        assert j.recorded_total == 12
+        page = j.query(limit=256)
+        assert len(page["events"]) == 8         # ring cap
+        assert page["dropped"] == 4
+        assert page["events"][0]["subject"] == "h11"   # newest first
+        only = j.query(kind="lapse")
+        assert {e["kind"] for e in only["events"]} == {"lapse"}
+        sched = j.query(scheduler="sched-1")
+        assert all(e["scheduler"] == "sched-1" for e in sched["events"])
+        capped = j.query(limit=3)
+        assert len(capped["events"]) == 3 and capped["truncated"] is True
+        assert j.query(since=time.time() + 60)["events"] == []
+        assert j.query(before=t0)["events"] == []
+
+    def test_admission_burst_edge_triggered(self):
+        j = ClusterEventJournal()
+        clock = [0.0]
+        d = AdmissionBurstDetector(j, threshold=4, window_s=10.0,
+                                   clock=lambda: clock[0])
+        for _ in range(10):
+            d.note_429("tenant-a")
+        assert j.recorded_total == 1            # one event, not one per 429
+        assert j.query()["events"][0]["kind"] == "admission_burst"
+        # Rate falls under half the threshold -> re-arms -> next storm is
+        # a NEW event.
+        clock[0] += 60.0
+        d.note_429()
+        for _ in range(4):
+            d.note_429()
+        assert j.recorded_total == 2
+
+
+# -- telemetry spool --------------------------------------------------------
+
+class TestSpool:
+    def test_store_load_roundtrip_and_prune(self, tmp_path):
+        db = Database(str(tmp_path / "m.db"))
+        spool = TelemetrySpool(db, max_bytes=4096)
+        for i in range(200):
+            spool.store("sched-a", "10.0.0.1",
+                        _frame(ts=1000.0 + i, counters={"pieces_landed": i}))
+        assert spool.bytes <= 4096
+        loaded = spool.load()
+        assert spool.frame_count() == len(loaded) < 200   # oldest pruned
+        # Oldest-first, and the newest frame survived.
+        assert loaded[0][0] < loaded[-1][0]
+        assert loaded[-1][3]["counters"]["pieces_landed"] == 199
+        db.close()
+
+    def test_reopen_restores_without_edge_events(self, tmp_path):
+        path = str(tmp_path / "m.db")
+        db = Database(path)
+        series = ClusterSeries(spool=TelemetrySpool(db))
+        assert series.ingest("sched-a", "10.0.0.1", _frame(
+            stragglers=["h-slow"], breached=["serve_p99"],
+            slo={"serve_p99": {"state": "breach", "burn": 2.0}},
+            counters={"pieces_landed": 7})) == 1
+        events_before = series.journal.recorded_total
+        assert events_before >= 2               # straggler + slo_breach
+        db.close()
+
+        db2 = Database(path)
+        restored = ClusterSeries(spool=TelemetrySpool(db2))
+        assert restored.restored_frames == 1
+        # Restored history is context, not news: no replayed edge events,
+        # and re-ingesting the same straggler stays edge-less.
+        assert restored.journal.recorded_total == 0
+        assert restored.ingest("sched-a", "10.0.0.1", _frame(
+            stragglers=["h-slow"])) == 1
+        assert restored.journal.recorded_total == 0
+        rep = restored.report(3600.0)
+        assert rep["totals"]["pieces_landed"] == 7
+        assert rep["restored_frames"] == 1
+        db2.close()
+
+
+# -- cluster series ---------------------------------------------------------
+
+class TestClusterSeries:
+    def test_merge_totals_and_attribution(self):
+        s = ClusterSeries()
+        s.ingest("sched-a", "10.0.0.1", _frame(
+            counters={"pieces_landed": 10, "back_source": 1},
+            gauges={"hosts_total": 4}, stragglers=["h-slow"],
+            decisions={"handout": 3}))
+        s.ingest("sched-b", "10.0.0.2", _frame(
+            counters={"pieces_landed": 5}, gauges={"hosts_total": 2},
+            quarantined=["h-bad"], decisions={"handout": 2}))
+        rep = s.report(600.0)
+        assert rep["totals"]["pieces_landed"] == 15
+        assert rep["totals"]["back_source"] == 1
+        assert rep["gauges"]["hosts_total"] == 6
+        assert rep["decisions"]["handout"] == 5
+        assert rep["stragglers"] == {"h-slow": "sched-a@10.0.0.1"}
+        assert rep["quarantined"] == {"h-bad": "sched-b@10.0.0.2"}
+        assert [x["scheduler"] for x in rep["schedulers"]] == [
+            "sched-a@10.0.0.1", "sched-b@10.0.0.2"]
+        text = render_cluster(rep)
+        assert "h-slow -> sched-a@10.0.0.1" in text
+        assert "pieces_landed=15" in text
+
+    def test_ingest_fail_open_counts_malformed(self):
+        s = ClusterSeries()
+        before = _samples("dragonfly_tpu_manager_fleet_frames_total",
+                          "result")
+        assert s.ingest("x", "1.2.3.4", None) == 0
+        assert s.ingest("x", "1.2.3.4", "not a dict") == 0
+        assert s.ingest("x", "1.2.3.4", {"v": 99}) == 0
+        after = _samples("dragonfly_tpu_manager_fleet_frames_total",
+                         "result")
+        assert after.get("malformed", 0) - before.get("malformed", 0) == 3
+        assert s.report(60.0)["schedulers"] == []
+
+    def test_edge_events_straggler_slo_quarantine(self):
+        s = ClusterSeries(quarantine_storm=3)
+        s.ingest("a", "", _frame(stragglers=["h1"]))
+        s.ingest("a", "", _frame(stragglers=["h1"]))       # no re-trigger
+        s.ingest("a", "", _frame(stragglers=["h1", "h2"]))  # h2 is new
+        kinds = [e["kind"] for e in s.journal.query()["events"]]
+        assert kinds.count("straggler") == 2
+        s.ingest("a", "", _frame(
+            breached=["serve_p99"],
+            slo={"serve_p99": {"state": "breach", "burn": 3.5}}))
+        ev = s.journal.query(kind="slo_breach")["events"]
+        assert len(ev) == 1 and "3.5" in ev[0]["detail"]
+        s.ingest("a", "", _frame(quarantined=["q1", "q2", "q3", "q4"]))
+        assert len(s.journal.query(kind="quarantine_storm")["events"]) == 1
+
+    def test_lapse_return_events_and_state_gauge(self):
+        s = ClusterSeries()
+        s.ingest("a", "10.0.0.1", _frame())
+        s.note_lapse("a", "10.0.0.1")
+        s.note_lapse("a", "10.0.0.1")    # dedup: one lapse event
+        assert len(s.journal.query(kind="lapse")["events"]) == 1
+        gauge = _samples("dragonfly_tpu_manager_cluster_schedulers",
+                         "state")
+        assert gauge["inactive"] == 1 and gauge["active"] == 0
+        s.note_return("a", "10.0.0.1")
+        assert len(s.journal.query(kind="return")["events"]) == 1
+        gauge = _samples("dragonfly_tpu_manager_cluster_schedulers",
+                         "state")
+        assert gauge["active"] == 1 and gauge["inactive"] == 0
+
+    def test_mixed_version_no_data_never_invents_zeros(self):
+        s = ClusterSeries()
+        s.mark_seen("old-wire", "10.0.0.9")
+        rep = s.report(600.0)
+        assert rep["schedulers"][0]["state"] == "no_data"
+        assert rep["totals"] == {} and rep["gauges"] == {}
+        # A lapse/return cycle keeps no_data (still no frames ever).
+        s.note_lapse("old-wire", "10.0.0.9")
+        s.note_return("old-wire", "10.0.0.9")
+        assert s.report(600.0)["schedulers"][0]["state"] == "no_data"
+        assert s.slo_report(600.0)["schedulers"][
+            "old-wire@10.0.0.9"]["state"] == "no_data"
+
+
+# -- manager integration over the real keepalive wire -----------------------
+
+class TestManagerWire:
+    def test_keepalive_frame_ingest_and_frameless_degrade(self, run_async):
+        run_async(self._frame_ingest_and_degrade(), timeout=60)
+
+    async def _frame_ingest_and_degrade(self):
+        server = ManagerServer(ManagerConfig())
+        await server.start()
+        client = ManagerClient(NetAddr.tcp("127.0.0.1", server.grpc_port()))
+        try:
+            sched = await client.update_scheduler(
+                hostname="sched-new", ip="127.0.0.1", port=8002)
+            cluster_id = sched["scheduler_cluster_id"]
+            await client.update_scheduler(
+                hostname="sched-old", ip="127.0.0.1", port=8003,
+                scheduler_cluster_id=cluster_id)
+
+            # New wire: keepalive carries a fleet frame.
+            s1 = await client._client.open_stream("Manager.KeepAlive", {
+                "source_type": "scheduler", "hostname": "sched-new",
+                "ip": "127.0.0.1", "cluster_id": cluster_id})
+            await s1.send({"fleet_frame": _frame(
+                counters={"pieces_landed": 3}, stragglers=["h-slow"])})
+            # Old wire: same stream shape, no frame — full liveness.
+            s2 = await client._client.open_stream("Manager.KeepAlive", {
+                "source_type": "scheduler", "hostname": "sched-old",
+                "ip": "127.0.0.1", "cluster_id": cluster_id})
+            await s2.send({})
+            await asyncio.sleep(0.2)
+
+            rows = {r["hostname"]: r["state"]
+                    for r in server.db.list("schedulers")}
+            assert rows["sched-new"] == rows["sched-old"] == "active"
+            rep = server.service.cluster.report(600.0)
+            by = {x["hostname"]: x for x in rep["schedulers"]}
+            assert by["sched-new"]["state"] == "active"
+            assert by["sched-old"]["state"] == "no_data"
+            assert "frame_bytes" not in by["sched-old"]
+            assert rep["totals"] == {"pieces_landed": 3}
+            assert rep["stragglers"]["h-slow"] == "sched-new@127.0.0.1"
+            await s1.close()
+            await s2.close()
+        finally:
+            await client.close()
+            await server.stop()
+
+    def test_cluster_view_rpc_renders_text(self, run_async):
+        run_async(self._cluster_view_rpc(), timeout=60)
+
+    async def _cluster_view_rpc(self):
+        server = ManagerServer(ManagerConfig())
+        await server.start()
+        client = ManagerClient(NetAddr.tcp("127.0.0.1", server.grpc_port()))
+        try:
+            server.service.cluster.ingest("sched-a", "10.0.0.1", _frame(
+                counters={"pieces_landed": 4}))
+            view = await client.cluster_view(window_s=300.0)
+            assert view["report"]["totals"]["pieces_landed"] == 4
+            assert view["report"]["window_s"] == 300.0
+            assert "cluster view" in view["text"]
+            assert "sched-a@10.0.0.1" in view["text"]
+        finally:
+            await client.close()
+            await server.stop()
+
+    def test_keepalive_payload_counter_and_rate_limited_warn(
+            self, run_async, monkeypatch):
+        run_async(self._payload_counter(monkeypatch), timeout=60)
+
+    async def _payload_counter(self, monkeypatch):
+        from dragonfly2_tpu.manager import client as mclient
+
+        server = ManagerServer(ManagerConfig())
+        await server.start()
+        client = ManagerClient(NetAddr.tcp("127.0.0.1", server.grpc_port()))
+        warns = []
+        monkeypatch.setattr(
+            mclient.log, "warning",
+            lambda *a, **k: warns.append(a))
+        try:
+            sched = await client.update_scheduler(
+                hostname="sched-err", ip="127.0.0.1", port=8002)
+
+            def bad_payload():
+                raise RuntimeError("boom")
+
+            client.start_keepalive(
+                source_type="scheduler", hostname="sched-err",
+                ip="127.0.0.1",
+                cluster_id=sched["scheduler_cluster_id"],
+                interval=0.05, payload=bad_payload)
+            before = _samples(
+                "dragonfly_tpu_manager_keepalive_payload_total", "result")
+            await asyncio.sleep(0.4)
+            after = _samples(
+                "dragonfly_tpu_manager_keepalive_payload_total", "result")
+            # The provider raised on several ticks: every one counted,
+            # but the per-tick warning collapsed to ONE rate-limited line.
+            assert after.get("error", 0) - before.get("error", 0) >= 3
+            assert len(warns) == 1
+        finally:
+            await client.close()
+            await server.stop()
+
+
+# -- manager MetricsServer routes ------------------------------------------
+
+class TestClusterRoutes:
+    def test_routes_answer_and_404_without_provider(self, run_async):
+        run_async(self._routes(), timeout=60)
+
+    async def _routes(self):
+        import aiohttp
+
+        from dragonfly2_tpu.pkg.metrics_server import MetricsServer
+
+        series = ClusterSeries()
+        series.ingest("sched-a", "10.0.0.1", _frame(
+            counters={"pieces_landed": 2}, stragglers=["h-slow"],
+            breached=["serve_p99"],
+            slo={"serve_p99": {"state": "breach", "burn": 1.5}}))
+        srv = MetricsServer(cluster=series)
+        bare = MetricsServer()          # scheduler/daemon binary: no tower
+        port = await srv.serve("127.0.0.1", 0)
+        bport = await bare.serve("127.0.0.1", 0)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(base + "/debug/cluster?window=60") as r:
+                    assert r.status == 200
+                    rep = await r.json()
+                assert rep["totals"]["pieces_landed"] == 2
+                assert rep["window_s"] == 60.0
+                async with sess.get(
+                        base + "/debug/cluster?format=text") as r:
+                    text = await r.text()
+                assert "cluster view" in text and "h-slow" in text
+                async with sess.get(
+                        base + "/debug/cluster/schedulers") as r:
+                    assert r.status == 200
+                    scheds = (await r.json())["schedulers"]
+                assert scheds[0]["scheduler"] == "sched-a@10.0.0.1"
+                async with sess.get(base + "/debug/cluster/slo") as r:
+                    slo = await r.json()
+                assert slo["breached"] == ["serve_p99"]
+                async with sess.get(
+                        base + "/debug/cluster/events?kind=straggler") as r:
+                    ev = await r.json()
+                assert ev["events"][0]["subject"] == "h-slow"
+                async with sess.get(
+                        base + "/debug/cluster?window=nope") as r:
+                    assert r.status == 400
+                async with sess.get(
+                        base + "/debug/cluster/events?n=nope") as r:
+                    assert r.status == 400
+                for path in ("/debug/cluster", "/debug/cluster/schedulers",
+                             "/debug/cluster/slo", "/debug/cluster/events"):
+                    async with sess.get(
+                            f"http://127.0.0.1:{bport}{path}") as r:
+                        assert r.status == 404, path
+        finally:
+            await srv.close()
+            await bare.close()
+
+    def test_manager_scrape_under_load(self, run_async):
+        run_async(self._scrape_under_load(), timeout=120)
+
+    async def _scrape_under_load(self):
+        """The manager's own metrics surface answers inside the 1s bound
+        while keepalive frames storm in — the TestScrapeUnderLoad
+        discipline extended to the manager binary."""
+        import time as time_mod
+
+        import aiohttp
+
+        cfg = ManagerConfig()
+        cfg.metrics_port = 0            # ephemeral manager MetricsServer
+        server = ManagerServer(cfg)
+        await server.start()
+        assert server.metrics_port() > 0
+        base = f"http://127.0.0.1:{server.metrics_port()}"
+        done = asyncio.Event()
+
+        async def storm(i: int):
+            n = 0
+            while not done.is_set():
+                server.service.ingest_fleet_frame(
+                    f"sched-{i}", "10.0.0.1", _frame(
+                        counters={"pieces_landed": 1},
+                        stragglers=[f"h{n % 7}"]))
+                n += 1
+                await asyncio.sleep(0.002)
+
+        storms = [asyncio.ensure_future(storm(i)) for i in range(8)]
+        await asyncio.sleep(0.1)
+        try:
+            async with aiohttp.ClientSession() as sess:
+                for path, kind in (
+                        ("/metrics", "prom"),
+                        ("/debug/cluster?window=60", "json"),
+                        ("/debug/cluster/schedulers", "json"),
+                        ("/debug/cluster/slo", "json"),
+                        ("/debug/cluster/events?n=64", "json"),
+                        ("/debug/cluster?format=text", "text")):
+                    t0 = time_mod.perf_counter()
+                    async with sess.get(base + path) as r:
+                        assert r.status == 200, path
+                        raw = await r.read()
+                    dt = time_mod.perf_counter() - t0
+                    assert dt < 1.0, f"{path} took {dt:.2f}s under load"
+                    if kind == "json":
+                        import json as json_mod
+
+                        json_mod.loads(raw)
+                    elif kind == "prom":
+                        assert b"dragonfly_tpu" in raw
+        finally:
+            done.set()
+            await asyncio.gather(*storms, return_exceptions=True)
+            await server.stop()

@@ -1,0 +1,348 @@
+"""Cluster control tower E2E: real manager + 2 schedulers + 4 daemons.
+
+The acceptance battery for the manager-side fleet rollup
+(dragonfly2_tpu/pkg/cluster.py): every process is a real
+``python -m dragonfly2_tpu.cli.main`` subprocess on localhost.
+
+One scenario, staged:
+
+1. Serve choreography gives daemon d1 fast serve samples and d2 slow
+   ones (d3 runs under a DF_CHAOS ``piece.body`` stall, so every piece
+   it pulls FROM d2 reports an inflated cost) — the manager's merged
+   ``/debug/cluster`` must attribute the d2 straggler flag to its
+   owning scheduler (sched-a), which it only learned via keepalive
+   fleet frames.
+2. SIGSTOP sched-b: the manager's keepalive GC marks it inactive — a
+   ``lapse`` journal event plus ``manager_cluster_schedulers
+   {state="inactive"}``; SIGCONT brings a ``return`` event.
+3. SIGKILL the manager and respawn it on the same sqlite db and ports:
+   the telemetry spool restores the shipped window
+   (``restored_frames > 0``) before any fresh keepalive arrives.
+4. ``dfget --explain --cluster --manager`` renders the merged text
+   view from the restarted manager over the same drpc wire.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+from aiohttp import ClientSession, ClientTimeout, web
+
+from dragonfly2_tpu.pkg.metrics import parse_labeled_samples
+from dragonfly2_tpu.pkg.piece import Range
+
+# 12 MiB = 3 pieces at the 4 MiB default — enough serve samples per
+# pull without making the battery heavy.
+CONTENT = bytes(random.Random(99).randbytes(12 * 1024 * 1024))
+SHA = hashlib.sha256(CONTENT).hexdigest()
+
+# Every piece d3 pulls stalls this long before the first body chunk —
+# INSIDE the downloader's cost timer, so the parent's serve EWMA (as
+# the scheduler experiences it) inflates by ~350ms/piece.
+STALL_S = 0.35
+CHAOS_SPEC = json.dumps({
+    "seed": 1,
+    "rules": [{"site": "piece.body", "kind": "stall",
+               "rate": 1.0, "stall_s": STALL_S}],
+})
+
+SCHED_YAML = """\
+hostname: {hostname}
+manager_keepalive_interval: 0.5
+fleet:
+  straggler_z: 0.3
+  min_serve_samples: 1
+  min_population: 2
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def _start_origin():
+    async def blob(request: web.Request) -> web.Response:
+        rng = request.headers.get("Range")
+        if rng:
+            r = Range.parse_http(rng, len(CONTENT))
+            data = CONTENT[r.start:r.start + r.length]
+            return web.Response(status=206, body=data, headers={
+                "Accept-Ranges": "bytes",
+                "Content-Range":
+                    f"bytes {r.start}-{r.start + r.length - 1}"
+                    f"/{len(CONTENT)}"})
+        return web.Response(body=CONTENT,
+                            headers={"Accept-Ranges": "bytes"})
+
+    app = web.Application()
+    app.router.add_get("/model.bin", blob)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    return runner, site._server.sockets[0].getsockname()[1]
+
+
+def _spawn(args: list[str], log_path: str,
+           extra_env: "dict | None" = None) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    if extra_env:
+        env.update(extra_env)
+    logf = open(log_path, "w")
+    return subprocess.Popen(
+        [sys.executable, "-m", "dragonfly2_tpu.cli.main", *args],
+        stdout=logf, stderr=subprocess.STDOUT, env=env)
+
+
+def _wait_sock(path: str, timeout: float = 90.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def _tail(path, n: int = 2000) -> str:
+    try:
+        return open(path).read()[-n:]
+    except OSError:
+        return "<no log>"
+
+
+async def _wait_healthy(http: ClientSession, base: str,
+                        log_path: str) -> None:
+    for _ in range(300):
+        try:
+            async with http.get(f"{base}/healthy") as r:
+                if r.status == 200:
+                    return
+        except Exception:
+            pass
+        await asyncio.sleep(0.1)
+    raise AssertionError("manager never healthy: " + _tail(log_path))
+
+
+async def _poll_json(http: ClientSession, url: str, pred,
+                     timeout: float = 30.0, what: str = ""):
+    """Poll ``url`` until ``pred(json)`` is truthy; returns the last
+    body either way so assertion messages show what the manager saw."""
+    deadline = time.monotonic() + timeout
+    body = None
+    while time.monotonic() < deadline:
+        try:
+            async with http.get(url) as r:
+                if r.status == 200:
+                    body = await r.json()
+                    if pred(body):
+                        return body
+        except Exception:
+            pass
+        await asyncio.sleep(0.25)
+    raise AssertionError(f"timeout waiting for {what or url}: {body}")
+
+
+def test_cluster_control_tower_e2e(run_async, tmp_path):
+    async def run():
+        runner, origin_port = await _start_origin()
+        rest_port, grpc_port = _free_port(), _free_port()
+        mgr_metrics = _free_port()
+        mgr_args = [
+            "manager", "--host", "127.0.0.1", "--port", str(rest_port),
+            "--grpc-port", str(grpc_port),
+            "--metrics-port", str(mgr_metrics),
+            "--db", str(tmp_path / "manager.db"),
+            "--keepalive-timeout", "2",
+            "--keepalive-gc-interval", "0.5"]
+        mbase = f"http://127.0.0.1:{mgr_metrics}"
+        procs: dict[str, subprocess.Popen] = {}
+        homes: dict[str, str] = {}
+        try:
+            procs["manager"] = _spawn(mgr_args, str(tmp_path / "manager.log"))
+            async with ClientSession(
+                    timeout=ClientTimeout(total=10)) as http:
+                await _wait_healthy(http, f"http://127.0.0.1:{rest_port}",
+                                    str(tmp_path / "manager.log"))
+
+                # Two schedulers with distinct advertised hostnames and a
+                # straggler config a 2-host population can actually trip.
+                sched_ports = {}
+                for name in ("sched-a", "sched-b"):
+                    cfg_path = str(tmp_path / f"{name}.yaml")
+                    with open(cfg_path, "w") as f:
+                        f.write(SCHED_YAML.format(hostname=name))
+                    port = _free_port()
+                    sched_ports[name] = port
+                    procs[name] = _spawn(
+                        ["scheduler", "--config", cfg_path,
+                         "--host", "127.0.0.1", "--port", str(port),
+                         "--manager", f"127.0.0.1:{grpc_port}"],
+                        str(tmp_path / f"{name}.log"))
+
+                # d1/d2/d3 on sched-a (d3 under a piece.body stall chaos:
+                # its pulls make its PARENTS look slow); d4 on sched-b.
+                for name, sched, env in (
+                        ("d1", "sched-a", None),
+                        ("d2", "sched-a", None),
+                        ("d3", "sched-a", {"DF_CHAOS": CHAOS_SPEC}),
+                        ("d4", "sched-b", None)):
+                    home = str(tmp_path / name)
+                    homes[name] = home
+                    procs[name] = _spawn(
+                        ["daemon", "--work-home", home,
+                         "--hostname", name,
+                         "--scheduler",
+                         f"127.0.0.1:{sched_ports[sched]}"],
+                        str(tmp_path / f"{name}.log"), extra_env=env)
+                for name in ("d1", "d2", "d3", "d4"):
+                    ok = await asyncio.to_thread(
+                        _wait_sock, f"{homes[name]}/run/dfdaemon.sock")
+                    assert ok, _tail(tmp_path / f"{name}.log")
+
+                def url(v: int) -> str:
+                    return (f"http://127.0.0.1:{origin_port}"
+                            f"/model.bin?v={v}")
+
+                async def dfget(name: str, v: int, out: str,
+                                extra: "list | None" = None) -> str:
+                    p = _spawn(
+                        ["dfget", url(v), "-O", out,
+                         "--work-home", homes[name], "--no-daemon",
+                         *(extra or [])], out + ".log")
+                    rc = await asyncio.to_thread(p.wait, 120)
+                    assert rc == 0, _tail(out + ".log")
+                    with open(out, "rb") as f:
+                        got = hashlib.sha256(f.read()).hexdigest()
+                    assert got == SHA, f"{name} v{v} sha mismatch"
+                    return _tail(out + ".log")
+
+                # Stage 1 — serve choreography. t1: d1 back-sources, d2
+                # pulls from it (fast serve samples for d1). t2: d2
+                # back-sources, d3 pulls from it through the stall (slow
+                # samples for d2). t3 after the 2s recompute cadence:
+                # one more clean pull re-triggers the straggler sweep
+                # with both hosts scored.
+                await dfget("d1", 1, str(tmp_path / "t1a.bin"))
+                await dfget("d2", 1, str(tmp_path / "t1b.bin"))
+                await dfget("d2", 2, str(tmp_path / "t2a.bin"))
+                await dfget("d3", 2, str(tmp_path / "t2b.bin"))
+                await asyncio.sleep(2.1)
+                await dfget("d1", 3, str(tmp_path / "t3a.bin"))
+                await dfget("d2", 3, str(tmp_path / "t3b.bin"))
+
+                # The merged view must attribute the d2 flag to sched-a:
+                # that mapping only exists if keepalive fleet frames
+                # carried the scorecard verdict into the manager.
+                def straggler_attributed(rep) -> bool:
+                    return any(
+                        h.startswith("d2-") and s.startswith("sched-a@")
+                        for h, s in (rep.get("stragglers") or {}).items())
+
+                rep = await _poll_json(
+                    http, f"{mbase}/debug/cluster?window=600",
+                    straggler_attributed, timeout=40.0,
+                    what="d2 straggler attributed to sched-a")
+                assert rep["totals"].get("pieces_landed", 0) >= 1, rep
+                assert not any(h.startswith(("d1-", "d3-"))
+                               for h in rep["stragglers"]), rep
+
+                scheds = await _poll_json(
+                    http, f"{mbase}/debug/cluster/schedulers",
+                    lambda r: {s["scheduler"].split("@")[0]
+                               for s in r["schedulers"]
+                               if s["state"] == "active"}
+                    >= {"sched-a", "sched-b"},
+                    what="both schedulers active with frames")
+                by_name = {s["scheduler"].split("@")[0]: s
+                           for s in scheds["schedulers"]}
+                assert by_name["sched-a"]["frames"] >= 1, scheds
+                ev = await _poll_json(
+                    http, f"{mbase}/debug/cluster/events?kind=straggler",
+                    lambda r: any(e["subject"].startswith("d2-")
+                                  for e in r["events"]),
+                    what="straggler journal event for d2")
+                assert all(e["kind"] == "straggler" for e in ev["events"])
+
+                # Stage 2 — keepalive lapse. Freeze sched-b: its
+                # keepalives stop but the process (and TCP stream) stay
+                # up, exactly the silence the manager GC must call.
+                procs["sched-b"].send_signal(signal.SIGSTOP)
+                await _poll_json(
+                    http, f"{mbase}/debug/cluster/events?kind=lapse",
+                    lambda r: any(
+                        e["scheduler"].startswith("sched-b@")
+                        for e in r["events"]),
+                    timeout=20.0, what="lapse event for sched-b")
+                async with http.get(f"{mbase}/metrics") as r:
+                    assert r.status == 200
+                    states = parse_labeled_samples(
+                        await r.text(),
+                        "dragonfly_tpu_manager_cluster_schedulers",
+                        "state")
+                assert states.get("inactive", 0) >= 1, states
+                procs["sched-b"].send_signal(signal.SIGCONT)
+                await _poll_json(
+                    http, f"{mbase}/debug/cluster/events?kind=return",
+                    lambda r: any(
+                        e["scheduler"].startswith("sched-b@")
+                        for e in r["events"]),
+                    timeout=20.0, what="return event for sched-b")
+
+                # Stage 3 — manager restart. SIGKILL + respawn on the
+                # same db/ports: the spool must hand the restarted
+                # process its shipped window before any fresh keepalive.
+                procs["manager"].kill()
+                await asyncio.to_thread(procs["manager"].wait, 15)
+                procs["manager"] = _spawn(
+                    mgr_args, str(tmp_path / "manager2.log"))
+                await _wait_healthy(http, f"http://127.0.0.1:{rest_port}",
+                                    str(tmp_path / "manager2.log"))
+                rep = await _poll_json(
+                    http, f"{mbase}/debug/cluster?window=600",
+                    lambda r: r.get("restored_frames", 0) >= 1
+                    and any(k.startswith("sched-a@")
+                            for k in (r.get("stragglers") or {}).values()),
+                    timeout=30.0,
+                    what="spool-restored view after manager restart")
+                assert straggler_attributed(rep), rep
+
+                # Stage 4 — the operator wire: dfget renders the SAME
+                # merged view over drpc from the restarted manager.
+                log4 = await dfget(
+                    "d1", 1, str(tmp_path / "t4.bin"),
+                    extra=["--explain", "--cluster",
+                           "--manager", f"127.0.0.1:{grpc_port}"])
+                assert "cluster view" in log4, log4
+                assert "sched-a" in log4, log4
+                assert "restored from spool" in log4, log4
+        finally:
+            for p in procs.values():
+                if p.poll() is None:
+                    p.send_signal(signal.SIGCONT)
+                    p.send_signal(signal.SIGTERM)
+            for p in procs.values():
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+            await runner.cleanup()
+
+    run_async(run(), timeout=300)

@@ -27,6 +27,7 @@ METRIC_MODULES = (
     "dragonfly2_tpu.pkg.chaos",
     "dragonfly2_tpu.pkg.flight",
     "dragonfly2_tpu.pkg.fleet",
+    "dragonfly2_tpu.pkg.cluster",
     "dragonfly2_tpu.pkg.prof",
     "dragonfly2_tpu.pkg.slo",
     "dragonfly2_tpu.pkg.tracing",
@@ -37,6 +38,7 @@ METRIC_MODULES = (
     "dragonfly2_tpu.daemon.peer.task_manager",
     "dragonfly2_tpu.daemon.peer.device_sink",
     "dragonfly2_tpu.scheduler.service",
+    "dragonfly2_tpu.manager.client",
     "dragonfly2_tpu.proto.reportcodec",
     "dragonfly2_tpu.qos.wfq",
     "dragonfly2_tpu.qos.admission",
@@ -53,8 +55,8 @@ METRIC_MODULES = (
 # The documented component vocabulary (docs/OBSERVABILITY.md "Metric
 # families"). Adding a component means documenting it there first.
 COMPONENTS = ("bufpool", "chaos", "dataset", "delta", "device_sink",
-              "fleet", "objectstorage", "peer", "proxy", "qos", "runtime",
-              "scheduler", "storage", "tracing", "upload")
+              "fleet", "manager", "objectstorage", "peer", "proxy", "qos",
+              "runtime", "scheduler", "storage", "tracing", "upload")
 
 # Histogram families must name their unit; counters use _total; gauges
 # may end in a unit but never _total. "pieces" is a unit here: batch-size
